@@ -1,0 +1,63 @@
+"""mmlspark_tpu — a TPU-native machine-learning pipeline framework.
+
+A brand-new framework with the capabilities of MMLSpark (Microsoft Machine
+Learning for Apache Spark), re-designed TPU-first on JAX/XLA/Pallas/pjit:
+
+- Columnar :class:`~mmlspark_tpu.data.Table` replaces Spark DataFrames; columns
+  live in host numpy and move to TPU HBM in large batched transfers.
+- ``Estimator.fit`` / ``Transformer.transform`` / ``Pipeline`` compose exactly
+  like SparkML stages (reference: ``core/contracts/Params.scala``), but all
+  heavy compute is jitted XLA running on a ``jax.sharding.Mesh`` of TPU chips.
+- Distributed training replaces socket/spanning-tree allreduce with
+  ``lax.psum`` over the ICI mesh (reference: ``lightgbm/LightGBMUtils.scala``,
+  ``vw/VowpalWabbitBase.scala``).
+
+Subpackages mirror the reference's component inventory (SURVEY.md §2):
+
+- ``core``      — params/pipeline contracts, serialization, schema, topology
+- ``data``      — columnar Table, readers, partitioning
+- ``parallel``  — mesh construction, sharding helpers, collectives, ring attention
+- ``ops``       — hashing, histograms, image kernels (XLA + Pallas)
+- ``lightgbm``  — histogram GBDT learners (LightGBM-on-Spark equivalent)
+- ``vw``        — online linear learners (VowpalWabbit-on-Spark equivalent)
+- ``nn_models`` — deep-model inference, ImageFeaturizer (CNTKModel equivalent)
+- ``stages``    — generic pipeline stages
+- ``featurize`` — auto-featurization, text featurization
+- ``train``     — simplified train/eval API + model statistics
+- ``automl``    — hyperparameter search, best-model selection
+- ``knn``       — (conditional) nearest neighbors
+- ``recommendation`` — SAR, ranking evaluation
+- ``lime``      — model-agnostic interpretability
+- ``isolationforest`` — anomaly detection
+- ``io``        — HTTP-on-TPU client stack + low-latency serving
+- ``cognitive`` — REST cognitive-service transformers
+- ``downloader`` — pretrained model repository
+"""
+
+__version__ = "0.1.0"
+
+from mmlspark_tpu.core.params import Param, Params
+from mmlspark_tpu.core.pipeline import (
+    Estimator,
+    Evaluator,
+    Model,
+    Pipeline,
+    PipelineModel,
+    PipelineStage,
+    Transformer,
+)
+from mmlspark_tpu.data.table import Table
+
+__all__ = [
+    "Param",
+    "Params",
+    "PipelineStage",
+    "Transformer",
+    "Estimator",
+    "Model",
+    "Pipeline",
+    "PipelineModel",
+    "Evaluator",
+    "Table",
+    "__version__",
+]

@@ -1,0 +1,1 @@
+"""Core contracts: params, pipeline stages, serialization, schema, topology."""

@@ -1,0 +1,383 @@
+"""Typed parameter system for pipeline stages.
+
+TPU-native re-design of the SparkML ``Params`` contract used throughout the
+reference (``core/contracts/Params.scala:8-216``): every stage declares typed
+:class:`Param` descriptors; values are stored per-instance in a param map with
+class-level defaults. Accessors (``setFoo``/``getFoo``) are generated
+automatically at class-definition time — this replaces the reference's
+reflection-driven wrapper codegen (``codegen/PySparkWrapper.scala``) with
+plain Python metaprogramming: the Python API *is* the native API, so no
+binding generation step is needed.
+
+Complex (non-JSON) param values — arrays, pytrees, nested stages, tables,
+functions — are handled by :mod:`mmlspark_tpu.core.serialize`'s type registry,
+mirroring ``ComplexParam`` (``core/serialize/ComplexParam.scala:13-34``) and
+``Serializer.typeToSerializer`` (``org/apache/spark/ml/Serializer.scala:21-130``).
+"""
+
+from __future__ import annotations
+
+import copy as _copy
+import uuid
+from typing import Any, Callable, Dict, Optional
+
+
+class _NoDefault:
+    """Sentinel for 'no default value'."""
+
+    _instance: Optional["_NoDefault"] = None
+
+    def __new__(cls) -> "_NoDefault":
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+        return cls._instance
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return "<no default>"
+
+
+NO_DEFAULT = _NoDefault()
+
+
+def gen_uid(cls_name: str) -> str:
+    """Generate a unique, human-readable stage uid like ``LightGBMClassifier_a1b2c3``."""
+    return f"{cls_name}_{uuid.uuid4().hex[:8]}"
+
+
+class Param:
+    """A typed parameter declared on a :class:`Params` subclass.
+
+    Parameters
+    ----------
+    doc: human-readable description (surfaced by ``explainParams``).
+    default: class-level default; omit for a required param.
+    validator: callable ``value -> bool``; a falsy return raises ``ValueError``.
+    converter: callable applied to the value on ``set`` (type coercion).
+    is_complex: value is not JSON-serializable; routed through the complex
+        serializer registry on save/load (ComplexParam equivalent).
+    """
+
+    __slots__ = ("name", "doc", "default", "validator", "converter", "is_complex", "owner")
+
+    def __init__(
+        self,
+        doc: str = "",
+        default: Any = NO_DEFAULT,
+        validator: Optional[Callable[[Any], bool]] = None,
+        converter: Optional[Callable[[Any], Any]] = None,
+        is_complex: bool = False,
+    ):
+        self.name: str = ""  # filled by __set_name__
+        self.doc = doc
+        self.default = default
+        self.validator = validator
+        self.converter = converter
+        self.is_complex = is_complex
+        self.owner: Optional[type] = None
+
+    def __set_name__(self, owner: type, name: str) -> None:
+        self.name = name
+        self.owner = owner
+
+    # Descriptor access: ``stage.inputCol`` reads the current value.
+    def __get__(self, instance: Any, owner: Optional[type] = None) -> Any:
+        if instance is None:
+            return self
+        return instance.getOrDefault(self.name)
+
+    def __set__(self, instance: Any, value: Any) -> None:
+        instance.set(self.name, value)
+
+    def __repr__(self) -> str:
+        return f"Param({self.name!r})"
+
+
+# ---------------------------------------------------------------------------
+# Common converters / validators (TypeConverters analogue)
+# ---------------------------------------------------------------------------
+
+def to_int(v: Any) -> int:
+    if isinstance(v, bool):
+        raise TypeError(f"expected int, got bool {v!r}")
+    return int(v)
+
+
+def to_float(v: Any) -> float:
+    return float(v)
+
+
+def to_str(v: Any) -> str:
+    if not isinstance(v, str):
+        raise TypeError(f"expected str, got {type(v).__name__}")
+    return v
+
+
+def to_bool(v: Any) -> bool:
+    if not isinstance(v, bool):
+        raise TypeError(f"expected bool, got {type(v).__name__}")
+    return v
+
+
+def to_list_str(v: Any) -> list:
+    return [to_str(x) for x in v]
+
+
+def in_range(lo: float, hi: float) -> Callable[[Any], bool]:
+    return lambda v: lo <= v <= hi
+
+
+def gt(lo: float) -> Callable[[Any], bool]:
+    return lambda v: v > lo
+
+
+def ge(lo: float) -> Callable[[Any], bool]:
+    return lambda v: v >= lo
+
+
+def one_of(*allowed: Any) -> Callable[[Any], bool]:
+    allowed_set = set(allowed)
+    return lambda v: v in allowed_set
+
+
+# ---------------------------------------------------------------------------
+# Params base
+# ---------------------------------------------------------------------------
+
+def _accessor_suffix(name: str) -> str:
+    return name[0].upper() + name[1:]
+
+
+class Params:
+    """Base class for anything carrying :class:`Param` declarations.
+
+    Subclasses get ``setX``/``getX`` accessors generated for every Param
+    ``x`` unless hand-written, a collected ``params`` mapping, and
+    keyword-argument construction: ``LightGBMClassifier(numIterations=10)``.
+    """
+
+    # name -> Param, collected across the MRO (populated per-subclass).
+    _param_specs: Dict[str, Param] = {}
+
+    def __init_subclass__(cls, **kwargs: Any) -> None:
+        super().__init_subclass__(**kwargs)
+        specs: Dict[str, Param] = {}
+        for klass in reversed(cls.__mro__):
+            for k, v in vars(klass).items():
+                if isinstance(v, Param):
+                    specs[k] = v
+        cls._param_specs = specs
+        # Generate accessors for params that don't already have them.
+        for name in specs:
+            suffix = _accessor_suffix(name)
+            getter, setter = f"get{suffix}", f"set{suffix}"
+            if not hasattr(cls, getter):
+                setattr(cls, getter, _make_getter(name))
+            if not hasattr(cls, setter):
+                setattr(cls, setter, _make_setter(name))
+        _STAGE_REGISTRY[f"{cls.__module__}.{cls.__qualname__}"] = cls
+
+    def __init__(self, **kwargs: Any):
+        self.uid = kwargs.pop("uid", None) or gen_uid(type(self).__name__)
+        self._paramMap: Dict[str, Any] = {}
+        self.setParams(**kwargs)
+
+    # -- core access --------------------------------------------------------
+
+    @property
+    def params(self) -> Dict[str, Param]:
+        return dict(self._param_specs)
+
+    def _resolve(self, param: Any) -> str:
+        name = param.name if isinstance(param, Param) else param
+        if name not in self._param_specs:
+            raise KeyError(f"{type(self).__name__} has no param {name!r}")
+        return name
+
+    def set(self, param: Any, value: Any) -> "Params":
+        name = self._resolve(param)
+        spec = self._param_specs[name]
+        if value is not None:
+            if spec.converter is not None:
+                value = spec.converter(value)
+            if spec.validator is not None and not spec.validator(value):
+                raise ValueError(
+                    f"{type(self).__name__}.{name}: invalid value {value!r}"
+                )
+        self._paramMap[name] = value
+        return self
+
+    def setParams(self, **kwargs: Any) -> "Params":
+        for k, v in kwargs.items():
+            self.set(k, v)
+        return self
+
+    def get(self, param: Any) -> Any:
+        return self._paramMap[self._resolve(param)]
+
+    def getOrDefault(self, param: Any) -> Any:
+        name = self._resolve(param)
+        if name in self._paramMap:
+            return self._paramMap[name]
+        default = self._param_specs[name].default
+        if default is NO_DEFAULT:
+            raise KeyError(
+                f"{type(self).__name__}.{name} is not set and has no default"
+            )
+        # Copy mutable defaults so instances don't share state.
+        if isinstance(default, (list, dict, set)):
+            default = _copy.copy(default)
+        return default
+
+    def isSet(self, param: Any) -> bool:
+        return self._resolve(param) in self._paramMap
+
+    def isDefined(self, param: Any) -> bool:
+        name = self._resolve(param)
+        return name in self._paramMap or self._param_specs[name].default is not NO_DEFAULT
+
+    def hasParam(self, name: str) -> bool:
+        return name in self._param_specs
+
+    def clear(self, param: Any) -> "Params":
+        self._paramMap.pop(self._resolve(param), None)
+        return self
+
+    # -- convenience --------------------------------------------------------
+
+    def copy(self, extra: Optional[Dict[str, Any]] = None) -> "Params":
+        that = _copy.copy(self)
+        that._paramMap = dict(self._paramMap)
+        if extra:
+            for k, v in extra.items():
+                that.set(k, v)
+        return that
+
+    def explainParams(self) -> str:
+        lines = []
+        for name, spec in sorted(self._param_specs.items()):
+            cur = self._paramMap.get(name, "undefined")
+            dflt = spec.default if spec.default is not NO_DEFAULT else "undefined"
+            lines.append(f"{name}: {spec.doc} (default: {dflt!r}, current: {cur!r})")
+        return "\n".join(lines)
+
+    def extractParamMap(self) -> Dict[str, Any]:
+        out = {}
+        for name, spec in self._param_specs.items():
+            if name in self._paramMap or spec.default is not NO_DEFAULT:
+                out[name] = self.getOrDefault(name)
+        return out
+
+    def __repr__(self) -> str:
+        set_params = ", ".join(f"{k}={v!r}" for k, v in sorted(self._paramMap.items()))
+        return f"{type(self).__name__}({set_params})"
+
+
+def _make_getter(name: str) -> Callable[[Params], Any]:
+    def getter(self: Params) -> Any:
+        return self.getOrDefault(name)
+
+    getter.__name__ = f"get{_accessor_suffix(name)}"
+    getter.__doc__ = f"Get the value of param ``{name}``."
+    return getter
+
+
+def _make_setter(name: str) -> Callable[..., Params]:
+    def setter(self: Params, value: Any) -> Params:
+        return self.set(name, value)
+
+    setter.__name__ = f"set{_accessor_suffix(name)}"
+    setter.__doc__ = f"Set param ``{name}``. Returns self for chaining."
+    return setter
+
+
+# ---------------------------------------------------------------------------
+# Stage registry — replaces reflection over the jar (JarLoadingUtils.scala:106):
+# every Params subclass self-registers, powering the fuzzing meta-test and
+# load-by-classname deserialization.
+# ---------------------------------------------------------------------------
+
+_STAGE_REGISTRY: Dict[str, type] = {}
+
+
+def registered_classes() -> Dict[str, type]:
+    return dict(_STAGE_REGISTRY)
+
+
+def lookup_class(qualified_name: str) -> type:
+    if qualified_name in _STAGE_REGISTRY:
+        return _STAGE_REGISTRY[qualified_name]
+    # Import the module to trigger registration, then retry.
+    module_name = qualified_name.rsplit(".", 1)[0]
+    import importlib
+
+    importlib.import_module(module_name)
+    return _STAGE_REGISTRY[qualified_name]
+
+
+# ---------------------------------------------------------------------------
+# Shared column-param mixins (core/contracts/Params.scala:17-216)
+# ---------------------------------------------------------------------------
+
+
+class HasInputCol(Params):
+    inputCol = Param("The name of the input column", converter=to_str)
+
+
+class HasOutputCol(Params):
+    outputCol = Param("The name of the output column", converter=to_str)
+
+
+class HasInputCols(Params):
+    inputCols = Param("The names of the input columns", converter=to_list_str)
+
+
+class HasOutputCols(Params):
+    outputCols = Param("The names of the output columns", converter=to_list_str)
+
+
+class HasLabelCol(Params):
+    labelCol = Param("The name of the label column", default="label", converter=to_str)
+
+
+class HasFeaturesCol(Params):
+    featuresCol = Param(
+        "The name of the features column", default="features", converter=to_str
+    )
+
+
+class HasPredictionCol(Params):
+    predictionCol = Param(
+        "The name of the prediction column", default="prediction", converter=to_str
+    )
+
+
+class HasWeightCol(Params):
+    weightCol = Param("The name of the instance-weight column", converter=to_str)
+
+
+class HasInitScoreCol(Params):
+    initScoreCol = Param(
+        "The name of the initial-score (margin) column for warm start",
+        converter=to_str,
+    )
+
+
+class HasGroupCol(Params):
+    groupCol = Param("The name of the query-group column (ranking)", converter=to_str)
+
+
+class HasValidationIndicatorCol(Params):
+    validationIndicatorCol = Param(
+        "Boolean column marking rows used for validation / early stopping",
+        converter=to_str,
+    )
+
+
+class HasBatchSize(Params):
+    batchSize = Param(
+        "Rows per device mini-batch", default=1024, converter=to_int, validator=gt(0)
+    )
+
+
+class HasSeed(Params):
+    seed = Param("Random seed", default=0, converter=to_int)

@@ -1,0 +1,128 @@
+"""Pipeline stage contracts: Transformer / Estimator / Pipeline / Evaluator.
+
+The composability layer of the framework — same shape as SparkML's
+(every reference feature is packaged as a ``Transformer``/``Estimator``;
+SURVEY.md §1), but operating on :class:`~mmlspark_tpu.data.table.Table` and
+dispatching heavy compute to jitted JAX programs on the TPU mesh.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from mmlspark_tpu.core.params import Param, Params
+from mmlspark_tpu.data.table import Table
+
+
+class PipelineStage(Params):
+    """Base of all stages. Adds persistence (save/load)."""
+
+    def transform_schema(self, schema: Dict[str, Any]) -> Dict[str, Any]:
+        """Best-effort schema propagation; stages may override."""
+        return dict(schema)
+
+    # -- persistence (ComplexParamsWritable/Readable analogue) ---------------
+
+    def save(self, path: str, overwrite: bool = True) -> None:
+        from mmlspark_tpu.core import serialize
+
+        serialize.save_stage(self, path, overwrite=overwrite)
+
+    @classmethod
+    def load(cls, path: str) -> "PipelineStage":
+        from mmlspark_tpu.core import serialize
+
+        stage = serialize.load_stage(path)
+        if cls is not PipelineStage and not isinstance(stage, cls):
+            raise TypeError(f"loaded {type(stage).__name__}, expected {cls.__name__}")
+        return stage
+
+    def _save_extra(self, path: str) -> None:
+        """Hook for non-param state (e.g. fitted model arrays)."""
+
+    def _load_extra(self, path: str) -> None:
+        pass
+
+
+class Transformer(PipelineStage):
+    def transform(self, table: Table) -> Table:
+        raise NotImplementedError
+
+    def __call__(self, table: Table) -> Table:
+        return self.transform(table)
+
+
+class Estimator(PipelineStage):
+    def fit(self, table: Table, params: Optional[Dict[str, Any]] = None) -> "Model":
+        if params:
+            return self.copy(params)._fit(table)
+        return self._fit(table)
+
+    def _fit(self, table: Table) -> "Model":
+        raise NotImplementedError
+
+
+class Model(Transformer):
+    """A fitted Transformer produced by an Estimator."""
+
+    parent: Optional[Estimator] = None
+
+
+class Evaluator(Params):
+    """Computes a scalar metric from a transformed table
+    (SparkML ``Evaluator`` shape; cf. ``automl/FindBestModel.scala:55``)."""
+
+    def evaluate(self, table: Table) -> float:
+        raise NotImplementedError
+
+    def is_larger_better(self) -> bool:
+        return True
+
+
+class Pipeline(Estimator):
+    """Chain of stages; Estimators are fitted in sequence, Transformers pass
+    through — identical semantics to SparkML ``Pipeline.fit``."""
+
+    stages = Param("The chain of pipeline stages", default=[], is_complex=True)
+
+    def _fit(self, table: Table) -> "PipelineModel":
+        fitted: List[Transformer] = []
+        cur = table
+        stages = self.getStages()
+        for i, stage in enumerate(stages):
+            if isinstance(stage, Estimator):
+                model = stage.fit(cur)
+                fitted.append(model)
+                if i < len(stages) - 1:
+                    cur = model.transform(cur)
+            elif isinstance(stage, Transformer):
+                fitted.append(stage)
+                if i < len(stages) - 1:
+                    cur = stage.transform(cur)
+            else:
+                raise TypeError(f"stage {stage!r} is neither Estimator nor Transformer")
+        model = PipelineModel(stages=fitted)
+        model.parent = self
+        return model
+
+
+class PipelineModel(Model):
+    stages = Param("The fitted pipeline stages", default=[], is_complex=True)
+
+    def transform(self, table: Table) -> Table:
+        for stage in self.getStages():
+            table = stage.transform(table)
+        return table
+
+
+def make_pipeline_model(*stages: Transformer) -> PipelineModel:
+    """Assemble transformers into an anonymous PipelineModel
+    (``NamespaceInjections.pipelineModel``, ``org/apache/spark/ml/NamespaceInjections.scala:23``)."""
+    return PipelineModel(stages=list(stages))
+
+
+def ml_transform(table: Table, *stages: Transformer) -> Table:
+    """``df.mlTransform(t1, t2)`` fluent sugar (``core/spark/FluentAPI.scala:13-30``)."""
+    for s in stages:
+        table = s.transform(table)
+    return table

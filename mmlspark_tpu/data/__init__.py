@@ -1,0 +1,5 @@
+"""Columnar data substrate: Table, readers, partitioning."""
+
+from mmlspark_tpu.data.table import Table
+
+__all__ = ["Table"]

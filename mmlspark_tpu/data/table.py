@@ -1,0 +1,310 @@
+"""Columnar, immutable Table — the framework's DataFrame.
+
+TPU-native replacement for Spark DataFrames: instead of row-wise JVM objects
+crossed per-row into native code (the reference's UDF pattern, e.g.
+``opencv/ImageTransformer.scala``), a Table holds whole columns as host numpy
+arrays. Stages transform entire columns at once, so device work is a handful
+of large HBM transfers + one jitted XLA program — the layout the MXU wants.
+
+Columns may be:
+- 1-D numpy arrays (numeric, bool, or object dtype for strings),
+- 2-D numpy arrays (fixed-width "vector" columns, like SparkML VectorUDT),
+- object arrays of variable-length sequences (ragged; e.g. token lists).
+
+``num_partitions`` is a logical hint mapping rows onto mesh data-parallel
+shards — the analogue of Spark partitioning consumed by
+``ClusterUtil.getNumExecutorCores`` / coalesce in the reference
+(``lightgbm/LightGBMBase.scala:94-130``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Iterator, List, Mapping, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+ColumnLike = Union[np.ndarray, Sequence[Any]]
+
+
+def _as_column(values: ColumnLike) -> np.ndarray:
+    if isinstance(values, np.ndarray):
+        return values
+    try:
+        import jax
+
+        if isinstance(values, jax.Array):
+            return np.asarray(values)
+    except ImportError:  # pragma: no cover
+        pass
+    values = list(values)
+    if values and isinstance(values[0], str):
+        return np.array(values, dtype=object)
+    if values and isinstance(values[0], (list, tuple, np.ndarray)):
+        lengths = {len(v) for v in values}
+        if len(lengths) == 1:
+            arr = np.asarray(values)
+            if arr.dtype != object:
+                return arr
+        out = np.empty(len(values), dtype=object)
+        for i, v in enumerate(values):
+            out[i] = v
+        return out
+    return np.asarray(values)
+
+
+class Table:
+    """An immutable, ordered collection of named columns of equal length."""
+
+    __slots__ = ("_columns", "_num_rows", "_metadata", "num_partitions")
+
+    def __init__(
+        self,
+        columns: Mapping[str, ColumnLike],
+        metadata: Optional[Dict[str, Dict[str, Any]]] = None,
+        num_partitions: int = 1,
+    ):
+        cols: Dict[str, np.ndarray] = {}
+        n: Optional[int] = None
+        for name, values in columns.items():
+            arr = _as_column(values)
+            if n is None:
+                n = len(arr)
+            elif len(arr) != n:
+                raise ValueError(
+                    f"column {name!r} has length {len(arr)}, expected {n}"
+                )
+            cols[name] = arr
+        self._columns = cols
+        self._num_rows = n or 0
+        self._metadata = dict(metadata or {})
+        self.num_partitions = max(1, int(num_partitions))
+
+    # -- construction -------------------------------------------------------
+
+    @staticmethod
+    def from_pandas(df: Any, num_partitions: int = 1) -> "Table":
+        cols = {}
+        for name in df.columns:
+            s = df[name]
+            if s.dtype == object:
+                cols[name] = s.to_numpy(dtype=object)
+            else:
+                cols[name] = s.to_numpy()
+        return Table(cols, num_partitions=num_partitions)
+
+    @staticmethod
+    def from_rows(rows: Sequence[Mapping[str, Any]], num_partitions: int = 1) -> "Table":
+        if not rows:
+            return Table({})
+        names = list(rows[0].keys())
+        return Table(
+            {n: [r[n] for r in rows] for n in names}, num_partitions=num_partitions
+        )
+
+    # -- basic properties ----------------------------------------------------
+
+    @property
+    def columns(self) -> List[str]:
+        return list(self._columns.keys())
+
+    @property
+    def num_rows(self) -> int:
+        return self._num_rows
+
+    def __len__(self) -> int:
+        return self._num_rows
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._columns
+
+    def __getitem__(self, name: str) -> np.ndarray:
+        return self._columns[name]
+
+    def column(self, name: str) -> np.ndarray:
+        if name not in self._columns:
+            raise KeyError(
+                f"no column {name!r}; available: {sorted(self._columns)}"
+            )
+        return self._columns[name]
+
+    @property
+    def schema(self) -> Dict[str, np.dtype]:
+        return {k: v.dtype for k, v in self._columns.items()}
+
+    def metadata(self, name: str) -> Dict[str, Any]:
+        return self._metadata.get(name, {})
+
+    # -- functional updates (all return new Tables) --------------------------
+
+    def _derive(
+        self,
+        columns: Dict[str, np.ndarray],
+        metadata: Optional[Dict[str, Dict[str, Any]]] = None,
+    ) -> "Table":
+        t = Table.__new__(Table)
+        t._columns = columns
+        t._num_rows = len(next(iter(columns.values()))) if columns else 0
+        t._metadata = metadata if metadata is not None else dict(self._metadata)
+        t.num_partitions = self.num_partitions
+        return t
+
+    def with_column(
+        self, name: str, values: ColumnLike, metadata: Optional[Dict[str, Any]] = None
+    ) -> "Table":
+        arr = _as_column(values)
+        if self._columns and len(arr) != self._num_rows:
+            raise ValueError(
+                f"column {name!r} has length {len(arr)}, expected {self._num_rows}"
+            )
+        cols = dict(self._columns)
+        cols[name] = arr
+        meta = dict(self._metadata)
+        if metadata is not None:
+            meta[name] = metadata
+        return self._derive(cols, meta)
+
+    def with_columns(self, updates: Mapping[str, ColumnLike]) -> "Table":
+        out = self
+        for k, v in updates.items():
+            out = out.with_column(k, v)
+        return out
+
+    def with_metadata(self, name: str, metadata: Dict[str, Any]) -> "Table":
+        meta = dict(self._metadata)
+        meta[name] = metadata
+        return self._derive(dict(self._columns), meta)
+
+    def select(self, *names: str) -> "Table":
+        missing = [n for n in names if n not in self._columns]
+        if missing:
+            raise KeyError(f"no columns {missing}; available: {sorted(self._columns)}")
+        return self._derive({n: self._columns[n] for n in names})
+
+    def drop(self, *names: str) -> "Table":
+        return self._derive(
+            {k: v for k, v in self._columns.items() if k not in set(names)}
+        )
+
+    def rename(self, old: str, new: str) -> "Table":
+        if old not in self._columns:
+            raise KeyError(old)
+        cols = {(new if k == old else k): v for k, v in self._columns.items()}
+        meta = dict(self._metadata)
+        if old in meta:
+            meta[new] = meta.pop(old)
+        return self._derive(cols, meta)
+
+    def filter(self, mask: ColumnLike) -> "Table":
+        mask = np.asarray(mask, dtype=bool)
+        return self._derive({k: v[mask] for k, v in self._columns.items()})
+
+    def take(self, indices: ColumnLike) -> "Table":
+        idx = np.asarray(indices)
+        return self._derive({k: v[idx] for k, v in self._columns.items()})
+
+    def head(self, n: int = 5) -> "Table":
+        return self._derive({k: v[:n] for k, v in self._columns.items()})
+
+    def slice(self, start: int, stop: int) -> "Table":
+        return self._derive({k: v[start:stop] for k, v in self._columns.items()})
+
+    def sort_by(self, name: str, ascending: bool = True) -> "Table":
+        """Stable sort by one column (ties keep row order, both directions)."""
+        col = self.column(name)
+        if ascending:
+            order = np.argsort(col, kind="stable")
+        else:
+            # Stable descending: stable-ascending argsort of the reversed
+            # column, mapped back to original indices, then reversed.
+            n = len(col)
+            order = (n - 1 - np.argsort(col[::-1], kind="stable"))[::-1]
+        return self.take(order)
+
+    def sample(self, fraction: float, seed: int = 0) -> "Table":
+        rng = np.random.default_rng(seed)
+        mask = rng.random(self._num_rows) < fraction
+        return self.filter(mask)
+
+    def random_split(
+        self, weights: Sequence[float], seed: int = 0
+    ) -> List["Table"]:
+        rng = np.random.default_rng(seed)
+        w = np.asarray(weights, dtype=float)
+        w = w / w.sum()
+        assignment = rng.choice(len(w), size=self._num_rows, p=w)
+        return [self.filter(assignment == i) for i in range(len(w))]
+
+    @staticmethod
+    def concat(tables: Sequence["Table"]) -> "Table":
+        tables = [t for t in tables if t.num_rows > 0] or list(tables[:1])
+        if not tables:
+            return Table({})
+        names = tables[0].columns
+        cols = {}
+        for n in names:
+            parts = [t.column(n) for t in tables]
+            if any(p.dtype == object for p in parts):
+                merged = np.empty(sum(len(p) for p in parts), dtype=object)
+                i = 0
+                for p in parts:
+                    merged[i : i + len(p)] = p
+                    i += len(p)
+                cols[n] = merged
+            else:
+                cols[n] = np.concatenate(parts)
+        out = Table(cols, metadata=dict(tables[0]._metadata))
+        out.num_partitions = tables[0].num_partitions
+        return out
+
+    # -- partitioning (Spark-partition analogue) -----------------------------
+
+    def repartition(self, n: int) -> "Table":
+        out = self._derive(dict(self._columns))
+        out.num_partitions = max(1, int(n))
+        return out
+
+    def coalesce(self, n: int) -> "Table":
+        return self.repartition(min(self.num_partitions, n))
+
+    def partition_bounds(self) -> List[Tuple[int, int]]:
+        """Row ranges of each logical partition (balanced contiguous split)."""
+        n, p = self._num_rows, self.num_partitions
+        edges = np.linspace(0, n, p + 1).astype(int)
+        return [(int(edges[i]), int(edges[i + 1])) for i in range(p)]
+
+    def partitions(self) -> Iterator["Table"]:
+        for lo, hi in self.partition_bounds():
+            yield self.slice(lo, hi)
+
+    # -- export --------------------------------------------------------------
+
+    def to_pandas(self) -> Any:
+        import pandas as pd
+
+        return pd.DataFrame({k: list(v) if v.ndim > 1 else v for k, v in self._columns.items()})
+
+    def to_dict(self) -> Dict[str, np.ndarray]:
+        return dict(self._columns)
+
+    def rows(self) -> Iterator[Dict[str, Any]]:
+        names = self.columns
+        for i in range(self._num_rows):
+            yield {n: self._columns[n][i] for n in names}
+
+    def __repr__(self) -> str:
+        parts = ", ".join(
+            f"{k}: {v.dtype}{list(v.shape[1:]) if v.ndim > 1 else ''}"
+            for k, v in self._columns.items()
+        )
+        return f"Table[{self._num_rows} rows, {self.num_partitions} partitions]({parts})"
+
+
+def find_unused_column_name(prefix: str, table: Table) -> str:
+    """Analogue of ``DatasetExtensions.findUnusedColumnName``
+    (``core/schema/DatasetExtensions.scala:71``)."""
+    name = prefix
+    i = 1
+    while name in table:
+        name = f"{prefix}_{i}"
+        i += 1
+    return name

@@ -1,0 +1,5 @@
+"""Mesh construction, topology discovery, sharding helpers, collectives."""
+
+from mmlspark_tpu.parallel.mesh import MeshConfig, best_mesh, get_topology, make_mesh
+
+__all__ = ["MeshConfig", "make_mesh", "best_mesh", "get_topology"]

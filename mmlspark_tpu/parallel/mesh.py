@@ -1,0 +1,149 @@
+"""TPU topology discovery and mesh construction.
+
+TPU-native replacement for ``ClusterUtil`` (``core/utils/ClusterUtil.scala:13-177``)
+and the driver socket rendezvous (``lightgbm/LightGBMUtils.scala:117-186``):
+instead of discovering executor cores and exchanging host:port lists over a
+``ServerSocket``, we discover the chip topology from the JAX runtime and build
+a ``jax.sharding.Mesh``. Rendezvous/collective bring-up is the JAX runtime's
+job (``jax.distributed`` + ICI); the "driver" only decides the mesh shape and
+the partition→device assignment.
+
+Axis convention (used across the framework):
+- ``data``  — data parallel (batch/rows; the LightGBM ``data_parallel`` axis)
+- ``model`` — tensor/feature parallel (feature-parallel histograms, TP matmuls)
+- ``seq``   — sequence/context parallel (ring attention)
+- ``pipe``  — pipeline parallel stages
+- ``expert``— expert parallel (MoE)
+Axes of size 1 cost nothing under XLA, so a single config covers 1 chip → pods.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+AXIS_DATA = "data"
+AXIS_MODEL = "model"
+AXIS_SEQ = "seq"
+AXIS_PIPE = "pipe"
+AXIS_EXPERT = "expert"
+
+ALL_AXES = (AXIS_DATA, AXIS_MODEL, AXIS_SEQ, AXIS_PIPE, AXIS_EXPERT)
+
+
+@dataclasses.dataclass(frozen=True)
+class Topology:
+    """What ``ClusterUtil`` discovered on Spark, re-expressed for TPU."""
+
+    num_devices: int
+    num_hosts: int
+    devices_per_host: int
+    platform: str
+    device_kind: str
+
+    @property
+    def multi_host(self) -> bool:
+        return self.num_hosts > 1
+
+
+def get_topology() -> Topology:
+    import jax
+
+    devices = jax.devices()
+    hosts = {d.process_index for d in devices}
+    return Topology(
+        num_devices=len(devices),
+        num_hosts=len(hosts),
+        devices_per_host=len(devices) // max(1, len(hosts)),
+        platform=devices[0].platform,
+        device_kind=devices[0].device_kind,
+    )
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Declarative mesh shape. -1 on ``data`` means 'absorb remaining devices'."""
+
+    data: int = -1
+    model: int = 1
+    seq: int = 1
+    pipe: int = 1
+    expert: int = 1
+
+    def resolve(self, num_devices: int) -> Dict[str, int]:
+        fixed = self.model * self.seq * self.pipe * self.expert
+        if num_devices % fixed != 0:
+            raise ValueError(
+                f"{num_devices} devices not divisible by model*seq*pipe*expert={fixed}"
+            )
+        data = self.data if self.data != -1 else num_devices // fixed
+        if data * fixed != num_devices:
+            raise ValueError(
+                f"mesh {data}x{fixed} != {num_devices} devices"
+            )
+        return {
+            AXIS_DATA: data,
+            AXIS_MODEL: self.model,
+            AXIS_SEQ: self.seq,
+            AXIS_PIPE: self.pipe,
+            AXIS_EXPERT: self.expert,
+        }
+
+
+def make_mesh(
+    config: Optional[MeshConfig] = None,
+    devices: Optional[Sequence[Any]] = None,
+    axis_names: Optional[Sequence[str]] = None,
+):
+    """Build a ``jax.sharding.Mesh`` over all (or given) devices.
+
+    Device order follows ``jax.devices()``, which JAX already orders for ICI
+    locality; inner-most mesh axes therefore get the tightest rings, so put
+    the heavy-traffic axis (``model``/``seq``) last when customizing.
+    """
+    import jax
+    from jax.sharding import Mesh
+
+    config = config or MeshConfig()
+    devices = list(devices if devices is not None else jax.devices())
+    sizes = config.resolve(len(devices))
+    names = tuple(axis_names or ALL_AXES)
+    shape = tuple(sizes[n] for n in names)
+    dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, names)
+
+
+def best_mesh(num_devices: Optional[int] = None):
+    """A sensible default: everything on the data axis (the reference's only
+    distribution mode is data parallel — SURVEY.md §5)."""
+    import jax
+
+    devices = jax.devices()
+    if num_devices is not None:
+        devices = devices[:num_devices]
+    return make_mesh(MeshConfig(), devices=devices)
+
+
+def data_sharding(mesh):
+    """NamedSharding that shards dim 0 over the ``data`` axis only, replicating
+    across model/seq/pipe/expert groups and all other dims."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return NamedSharding(mesh, P(AXIS_DATA))
+
+
+def replicated(mesh):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return NamedSharding(mesh, P())
+
+
+def pad_to_multiple(
+    n: int, multiple: int
+) -> Tuple[int, int]:
+    """Rows to pad so n divides the mesh/data axis. Returns (padded_n, pad)."""
+    padded = int(math.ceil(n / multiple) * multiple)
+    return padded, padded - n

@@ -1,0 +1,79 @@
+"""Test harness configuration.
+
+Forces an 8-virtual-device CPU platform so every distributed code path
+(shard_map/psum over the mesh) is exercised without TPU hardware — the
+analogue of the reference running multi-worker LightGBM on `local[*]`
+partitions (SURVEY.md §4 "Distributed behavior without a real cluster").
+
+Must run before any jax import, hence the env mutation at module import time.
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+# The container's sitecustomize may have already initialized a TPU backend at
+# interpreter startup; tear it down and re-point JAX at the virtual-CPU fleet.
+import jax  # noqa: E402
+from jax._src import xla_bridge  # noqa: E402
+
+xla_bridge._clear_backends()
+jax.config.update("jax_platforms", "cpu")
+assert len(jax.devices()) == 8, jax.devices()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="session")
+def mesh8():
+    from mmlspark_tpu.parallel import make_mesh
+
+    return make_mesh()
+
+
+@pytest.fixture()
+def rng():
+    return np.random.default_rng(42)
+
+
+def assert_tables_equal(a, b, rtol=1e-5, atol=1e-6):
+    """Tolerant Table equality — the `DataFrameEquality` analogue
+    (reference `core/test/base/TestBase.scala:244-316`)."""
+    assert a.columns == b.columns, f"{a.columns} != {b.columns}"
+    assert a.num_rows == b.num_rows
+    for name in a.columns:
+        ca, cb = a[name], b[name]
+        if ca.dtype == object or cb.dtype == object:
+            assert list(map(str, ca.ravel())) == list(map(str, cb.ravel())), name
+        elif np.issubdtype(ca.dtype, np.floating):
+            np.testing.assert_allclose(
+                ca.astype(float), cb.astype(float), rtol=rtol, atol=atol, err_msg=name
+            )
+        else:
+            np.testing.assert_array_equal(ca, cb, err_msg=name)
+
+
+@pytest.fixture()
+def table_equal():
+    return assert_tables_equal
+
+
+@pytest.fixture()
+def basic_table():
+    """`makeBasicDF` fixture analogue (TestBase.scala:191-205)."""
+    from mmlspark_tpu.data.table import Table
+
+    return Table(
+        {
+            "numbers": np.array([0, 1, 2, 3], dtype=np.int64),
+            "doubles": np.array([0.0, 1.5, 2.5, 3.5]),
+            "words": np.array(["guitars", "drums", "bass", "keys"], dtype=object),
+        }
+    )

@@ -1,0 +1,149 @@
+"""Params/pipeline contract tests (reference: core/contracts + fuzzing suites)."""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core.params import (
+    HasInputCol,
+    HasOutputCol,
+    Param,
+    Params,
+    gt,
+    one_of,
+    to_int,
+    to_str,
+)
+from mmlspark_tpu.core.pipeline import (
+    Estimator,
+    Model,
+    Pipeline,
+    PipelineModel,
+    Transformer,
+    ml_transform,
+)
+from mmlspark_tpu.data.table import Table
+
+
+class DummyStage(HasInputCol, HasOutputCol, Transformer):
+    scale = Param("multiplier", default=2.0, converter=float, validator=gt(0))
+    mode = Param("mode", default="fast", converter=to_str, validator=one_of("fast", "slow"))
+
+    def transform(self, table):
+        return table.with_column(
+            self.getOutputCol(), table.column(self.getInputCol()) * self.getScale()
+        )
+
+
+class DoublerEstimator(HasInputCol, HasOutputCol, Estimator):
+    def _fit(self, table):
+        m = DoublerModel(
+            inputCol=self.getInputCol(),
+            outputCol=self.getOutputCol(),
+            offset=float(np.mean(table.column(self.getInputCol()))),
+        )
+        m.parent = self
+        return m
+
+
+class DoublerModel(HasInputCol, HasOutputCol, Model):
+    offset = Param("learned offset", default=0.0, converter=float)
+
+    def transform(self, table):
+        return table.with_column(
+            self.getOutputCol(), table.column(self.getInputCol()) + self.getOffset()
+        )
+
+
+def test_param_defaults_and_accessors():
+    s = DummyStage(inputCol="a", outputCol="b")
+    assert s.getScale() == 2.0
+    assert s.getInputCol() == "a"
+    s.setScale(3)
+    assert s.getScale() == 3.0 and isinstance(s.getScale(), float)
+    assert s.scale == 3.0  # descriptor read
+
+
+def test_param_validation():
+    s = DummyStage(inputCol="a", outputCol="b")
+    with pytest.raises(ValueError):
+        s.setScale(-1)
+    with pytest.raises(ValueError):
+        s.setMode("medium")
+    with pytest.raises(KeyError):
+        s.set("nonexistent", 1)
+
+
+def test_kwargs_construction_and_copy():
+    s = DummyStage(inputCol="x", outputCol="y", scale=5)
+    s2 = s.copy({"scale": 7})
+    assert s.getScale() == 5 and s2.getScale() == 7
+    assert s2.uid == s.uid
+    assert "multiplier" in s.explainParams()
+
+
+def test_transform(basic_table):
+    s = DummyStage(inputCol="doubles", outputCol="out", scale=2)
+    out = s.transform(basic_table)
+    np.testing.assert_allclose(out["out"], basic_table["doubles"] * 2)
+    # input untouched (immutability)
+    assert "out" not in basic_table
+
+
+def test_pipeline_fit_transform(basic_table):
+    pipe = Pipeline(
+        stages=[
+            DummyStage(inputCol="doubles", outputCol="mid", scale=2),
+            DoublerEstimator(inputCol="mid", outputCol="out"),
+        ]
+    )
+    model = pipe.fit(basic_table)
+    assert isinstance(model, PipelineModel)
+    out = model.transform(basic_table)
+    mid = basic_table["doubles"] * 2
+    np.testing.assert_allclose(out["out"], mid + np.mean(mid))
+
+
+def test_ml_transform_sugar(basic_table):
+    out = ml_transform(
+        basic_table,
+        DummyStage(inputCol="doubles", outputCol="a2", scale=2),
+        DummyStage(inputCol="a2", outputCol="a4", scale=2),
+    )
+    np.testing.assert_allclose(out["a4"], basic_table["doubles"] * 4)
+
+
+def test_save_load_roundtrip(tmp_path, basic_table, table_equal):
+    s = DummyStage(inputCol="doubles", outputCol="out", scale=3)
+    p = str(tmp_path / "stage")
+    s.save(p)
+    s2 = DummyStage.load(p)
+    assert s2.uid == s.uid and s2.getScale() == 3.0
+    table_equal(s.transform(basic_table), s2.transform(basic_table))
+
+
+def test_pipeline_model_save_load(tmp_path, basic_table, table_equal):
+    pipe = Pipeline(
+        stages=[
+            DummyStage(inputCol="doubles", outputCol="mid", scale=2),
+            DoublerEstimator(inputCol="mid", outputCol="out"),
+        ]
+    )
+    model = pipe.fit(basic_table)
+    p = str(tmp_path / "pm")
+    model.save(p)
+    loaded = PipelineModel.load(p)
+    table_equal(model.transform(basic_table), loaded.transform(basic_table))
+
+
+def test_complex_param_array_roundtrip(tmp_path):
+    class ArrayHolder(Transformer):
+        weights = Param("array param", is_complex=True)
+
+        def transform(self, table):
+            return table
+
+    h = ArrayHolder(weights=np.arange(6.0).reshape(2, 3))
+    p = str(tmp_path / "h")
+    h.save(p)
+    h2 = ArrayHolder.load(p)
+    np.testing.assert_array_equal(h2.getWeights(), h.getWeights())

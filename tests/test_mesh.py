@@ -1,0 +1,55 @@
+"""Mesh/topology tests — run on the 8-virtual-device CPU platform."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mmlspark_tpu.parallel.mesh import (
+    MeshConfig,
+    get_topology,
+    make_mesh,
+    pad_to_multiple,
+)
+
+
+def test_topology_discovery():
+    topo = get_topology()
+    assert topo.num_devices == 8
+    assert topo.platform == "cpu"
+
+
+def test_default_mesh_all_data():
+    mesh = make_mesh()
+    assert mesh.shape["data"] == 8
+    assert mesh.shape["model"] == 1
+
+
+def test_mesh_config_resolution():
+    cfg = MeshConfig(model=2)
+    sizes = cfg.resolve(8)
+    assert sizes["data"] == 4 and sizes["model"] == 2
+    with pytest.raises(ValueError):
+        MeshConfig(model=3).resolve(8)
+    with pytest.raises(ValueError):
+        MeshConfig(data=3, model=2).resolve(8)
+
+
+def test_psum_over_mesh():
+    from jax.sharding import PartitionSpec as P
+
+    mesh = make_mesh()
+    x = jnp.arange(8.0)
+
+    def f(x):
+        return jax.lax.psum(x, "data")
+
+    out = jax.jit(
+        jax.shard_map(f, mesh=mesh, in_specs=P("data"), out_specs=P())
+    )(x)
+    assert float(out[0]) == 28.0
+
+
+def test_pad_to_multiple():
+    assert pad_to_multiple(10, 8) == (16, 6)
+    assert pad_to_multiple(16, 8) == (16, 0)

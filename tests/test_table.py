@@ -1,0 +1,83 @@
+"""Table substrate tests."""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.data.table import Table, find_unused_column_name
+
+
+def test_construction_and_schema(basic_table):
+    assert basic_table.num_rows == 4
+    assert basic_table.columns == ["numbers", "doubles", "words"]
+    assert basic_table.schema["doubles"] == np.float64
+
+
+def test_length_mismatch():
+    with pytest.raises(ValueError):
+        Table({"a": [1, 2], "b": [1, 2, 3]})
+
+
+def test_vector_column():
+    t = Table({"features": [[1.0, 2.0], [3.0, 4.0]]})
+    assert t["features"].shape == (2, 2)
+
+
+def test_ragged_column():
+    t = Table({"tokens": [["a", "b"], ["c"]]})
+    assert t["tokens"].dtype == object
+    assert list(t["tokens"][1]) == ["c"]
+
+
+def test_select_drop_rename(basic_table):
+    assert basic_table.select("numbers").columns == ["numbers"]
+    assert "words" not in basic_table.drop("words")
+    r = basic_table.rename("words", "instruments")
+    assert "instruments" in r and "words" not in r
+    with pytest.raises(KeyError):
+        basic_table.select("nope")
+
+
+def test_filter_take_sort(basic_table):
+    f = basic_table.filter(basic_table["numbers"] >= 2)
+    assert f.num_rows == 2
+    t = basic_table.take([3, 0])
+    assert list(t["numbers"]) == [3, 0]
+    s = basic_table.sort_by("doubles", ascending=False)
+    assert list(s["doubles"]) == [3.5, 2.5, 1.5, 0.0]
+
+
+def test_partitions():
+    t = Table({"x": np.arange(10)}).repartition(3)
+    bounds = t.partition_bounds()
+    assert len(bounds) == 3
+    assert sum(hi - lo for lo, hi in bounds) == 10
+    parts = list(t.partitions())
+    assert sum(p.num_rows for p in parts) == 10
+
+
+def test_concat_and_split():
+    t = Table({"x": np.arange(20.0), "s": np.array([f"r{i}" for i in range(20)], dtype=object)})
+    a, b = t.random_split([0.5, 0.5], seed=1)
+    assert a.num_rows + b.num_rows == 20
+    back = Table.concat([a, b])
+    assert back.num_rows == 20
+    assert set(back["s"]) == set(t["s"])
+
+
+def test_pandas_roundtrip(basic_table):
+    df = basic_table.to_pandas()
+    t2 = Table.from_pandas(df)
+    assert t2.columns == basic_table.columns
+    np.testing.assert_allclose(t2["doubles"], basic_table["doubles"])
+
+
+def test_find_unused_column_name(basic_table):
+    assert find_unused_column_name("words", basic_table) == "words_1"
+    assert find_unused_column_name("fresh", basic_table) == "fresh"
+
+
+def test_metadata_propagation(basic_table):
+    t = basic_table.with_metadata("words", {"categorical": True})
+    assert t.metadata("words") == {"categorical": True}
+    assert t.select("words").metadata("words") == {"categorical": True}
+    assert t.rename("words", "w").metadata("w") == {"categorical": True}
