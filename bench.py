@@ -1,0 +1,126 @@
+"""Headline benchmark: GBDT training throughput on TPU vs host CPU.
+
+Prints ONE JSON line:
+  {"metric": "...", "value": N, "unit": "...", "vs_baseline": N}
+
+Workload: binary-classification boosting on a Higgs-like dense matrix
+(BASELINE.json config 3's shape at bench-friendly scale). ``value`` is
+TPU row-iterations/sec (rows × boosting iterations / wall time, steady
+state, compile excluded). ``vs_baseline`` is the speedup over the same
+jitted program on the host CPU backend — the reference's LightGBM runs
+on CPU, and BASELINE.md's north-star target is ≥10× CPU rows/sec.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+N_ROWS = int(os.environ.get("BENCH_ROWS", 400_000))
+N_FEATURES = int(os.environ.get("BENCH_FEATURES", 28))
+N_ITERS = int(os.environ.get("BENCH_ITERS", 10))
+N_WARMUP = 2
+CPU_ROWS = min(N_ROWS, 100_000)  # CPU baseline measured at reduced scale
+
+
+def _make_data(n, f, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f))
+    logit = X[:, 0] * 1.5 + X[:, 1] * X[:, 2] + 0.5 * rng.normal(size=n)
+    y = (logit > 0).astype(np.float64)
+    return X, y
+
+
+def _throughput(n_rows, n_feat, iters, warmup):
+    """Steady-state row-iterations/sec of the jitted boosting step on the
+    current JAX backend."""
+    import jax
+
+    from mmlspark_tpu.lightgbm.binning import bin_dataset
+    from mmlspark_tpu.lightgbm.objectives import get_objective
+    from mmlspark_tpu.lightgbm.train import TrainOptions, _make_step
+
+    X, y = _make_data(n_rows, n_feat)
+    bins, mapper = bin_dataset(X)
+    opts = TrainOptions(objective="binary", num_leaves=31)
+    objective = get_objective("binary")
+    num_bins = opts.max_bin + 1
+    step = _make_step(opts, objective, num_bins)
+
+    import jax.numpy as jnp
+
+    edges = np.where(np.isfinite(mapper.edges), mapper.edges, np.finfo(np.float32).max)
+    bins_d = jnp.asarray(bins, dtype=jnp.int32)
+    y_d = jnp.asarray(y, dtype=jnp.float32)
+    w_d = jnp.ones(n_rows, dtype=jnp.float32)
+    edges_d = jnp.asarray(edges, dtype=jnp.float32)
+    bag = jnp.ones(n_rows, dtype=jnp.float32)
+    fm = jnp.ones(n_feat, dtype=jnp.float32)
+    init = objective.init_score(y, 1, np.ones(n_rows))
+    margins = jnp.broadcast_to(jnp.asarray(init)[None, :], (n_rows, 1)).astype(jnp.float32)
+
+    for _ in range(warmup):
+        sf, sb, st, lv, margins = step(bins_d, y_d, w_d, margins, edges_d, bag, fm)
+    jax.block_until_ready(margins)
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        sf, sb, st, lv, margins = step(bins_d, y_d, w_d, margins, edges_d, bag, fm)
+    jax.block_until_ready(margins)
+    dt = time.perf_counter() - t0
+    return n_rows * iters / dt
+
+
+def _cpu_baseline_subprocess() -> float:
+    """Measure the CPU baseline in a clean subprocess: once TPU compute has
+    run in a process, backend switching silently keeps dispatching to TPU,
+    so an in-process 'CPU' measurement would be bogus."""
+    import subprocess
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--cpu-baseline"],
+        capture_output=True, text=True, env=env, timeout=1800,
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+    )
+    for line in out.stdout.strip().splitlines()[::-1]:
+        try:
+            return float(line)
+        except ValueError:
+            continue
+    raise RuntimeError(f"cpu baseline failed: {out.stderr[-500:]}")
+
+
+def main():
+    if "--cpu-baseline" in sys.argv:
+        print(_throughput(CPU_ROWS, N_FEATURES, 3, 1))
+        return
+
+    import jax
+
+    tpu_backend = jax.default_backend()
+    tpu_tput = _throughput(N_ROWS, N_FEATURES, N_ITERS, N_WARMUP)
+
+    try:
+        cpu_tput = _cpu_baseline_subprocess()
+        vs_baseline = tpu_tput / cpu_tput
+    except Exception as e:  # pragma: no cover
+        print(f"cpu baseline failed: {e}", file=sys.stderr)
+        vs_baseline = 0.0
+
+    print(
+        json.dumps(
+            {
+                "metric": f"gbdt_train_row_iterations_per_sec_{tpu_backend}",
+                "value": round(tpu_tput, 1),
+                "unit": "rows*iters/sec",
+                "vs_baseline": round(vs_baseline, 3) if vs_baseline else 0.0,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,30 @@
+"""Histogram gradient-boosted decision trees, TPU-native.
+
+Same capability surface as LightGBM-on-Spark (reference ``lightgbm/``,
+SURVEY.md §2.2) — ``LightGBMClassifier`` / ``LightGBMRegressor`` /
+``LightGBMRanker`` estimators with boosters, early stopping, bagging,
+warm start — but the native C++ core (``lightgbmlib`` SWIG jar) and its
+socket-mesh allreduce (``LGBM_NetworkInit``) are replaced by jitted XLA:
+
+- feature values quantile-binned to uint8 on the host (C++-ready layout),
+- per-depth histogram building as one dense pass (segment-sum / one-hot
+  matmul onto the MXU) instead of per-leaf scatter loops,
+- split search as pure array ops over the (node, feature, bin) lattice,
+- data-parallel training by shard-by-rows + ``lax.psum`` of histograms
+  over the ICI mesh — the ``tree_learner=data_parallel`` equivalent.
+"""
+
+from mmlspark_tpu.lightgbm.classifier import LightGBMClassificationModel, LightGBMClassifier
+from mmlspark_tpu.lightgbm.regressor import LightGBMRegressionModel, LightGBMRegressor
+from mmlspark_tpu.lightgbm.ranker import LightGBMRanker, LightGBMRankerModel
+from mmlspark_tpu.lightgbm.booster import Booster
+
+__all__ = [
+    "LightGBMClassifier",
+    "LightGBMClassificationModel",
+    "LightGBMRegressor",
+    "LightGBMRegressionModel",
+    "LightGBMRanker",
+    "LightGBMRankerModel",
+    "Booster",
+]

@@ -1,0 +1,328 @@
+"""Shared LightGBM-style estimator machinery: param surface + train flow.
+
+Param names/defaults mirror ``lightgbm/LightGBMParams.scala:13-251`` so a
+reference user finds the identical knobs. The train flow re-creates
+``LightGBMBase.train``/``innerTrain`` (``lightgbm/LightGBMBase.scala:26-213``):
+column extraction, validation-indicator split, batch-mode chaining
+(``numBatches``), and worker/mesh selection — minus everything the TPU
+runtime makes obsolete (socket rendezvous, barrier mode, Kryo reduce).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Tuple
+
+import numpy as np
+
+from mmlspark_tpu.core.params import (
+    HasFeaturesCol,
+    HasInitScoreCol,
+    HasLabelCol,
+    HasPredictionCol,
+    HasValidationIndicatorCol,
+    HasWeightCol,
+    Param,
+    Params,
+    ge,
+    gt,
+    in_range,
+    one_of,
+    to_bool,
+    to_float,
+    to_int,
+    to_str,
+)
+from mmlspark_tpu.core.pipeline import Estimator, Model
+from mmlspark_tpu.data.table import Table
+from mmlspark_tpu.lightgbm.binning import BinMapper, bin_dataset
+from mmlspark_tpu.lightgbm.booster import Booster
+from mmlspark_tpu.lightgbm.train import TrainOptions, TrainResult, train
+
+
+class LightGBMParams(
+    HasFeaturesCol,
+    HasLabelCol,
+    HasPredictionCol,
+    HasWeightCol,
+    HasInitScoreCol,
+    HasValidationIndicatorCol,
+    Params,
+):
+    """The shared knob surface (LightGBMParams.scala)."""
+
+    numIterations = Param("Number of boosting iterations", default=100, converter=to_int, validator=gt(0))
+    learningRate = Param("Shrinkage rate", default=0.1, converter=to_float, validator=gt(0))
+    numLeaves = Param("Max leaves per tree", default=31, converter=to_int, validator=gt(1))
+    maxDepth = Param("Max tree depth (-1 = derive from numLeaves)", default=-1, converter=to_int)
+    maxBin = Param("Max number of feature bins", default=255, converter=to_int, validator=gt(1))
+    baggingFraction = Param("Row subsample fraction", default=1.0, converter=to_float, validator=in_range(0, 1))
+    baggingFreq = Param("Resample bagging mask every k iterations (0=off)", default=0, converter=to_int, validator=ge(0))
+    baggingSeed = Param("Bagging seed", default=3, converter=to_int)
+    featureFraction = Param("Feature subsample fraction per tree", default=1.0, converter=to_float, validator=in_range(0, 1))
+    lambdaL1 = Param("L1 regularization", default=0.0, converter=to_float, validator=ge(0))
+    lambdaL2 = Param("L2 regularization", default=0.0, converter=to_float, validator=ge(0))
+    minSumHessianInLeaf = Param("Minimum hessian sum per leaf", default=1e-3, converter=to_float, validator=ge(0))
+    minDataInLeaf = Param("Minimum rows per leaf", default=20, converter=to_int, validator=ge(0))
+    minGainToSplit = Param("Minimum gain to split", default=0.0, converter=to_float, validator=ge(0))
+    maxDeltaStep = Param("Max leaf output magnitude (0=off)", default=0.0, converter=to_float, validator=ge(0))
+    boostingType = Param(
+        "gbdt, rf, dart, or goss", default="gbdt",
+        converter=to_str, validator=one_of("gbdt", "rf", "dart", "goss"),
+    )
+    earlyStoppingRound = Param("Stop after k rounds without improvement (0=off)", default=0, converter=to_int, validator=ge(0))
+    improvementTolerance = Param("Minimal delta counted as improvement", default=0.0, converter=to_float, validator=ge(0))
+    metric = Param("Eval metric name ('' = objective default)", default="", converter=to_str)
+    parallelism = Param(
+        "data_parallel, voting_parallel, or serial",
+        default="data_parallel", converter=to_str,
+        validator=one_of("data_parallel", "voting_parallel", "serial"),
+    )
+    topK = Param("Top features for voting parallel", default=20, converter=to_int, validator=gt(0))
+    numBatches = Param("Split training into sequential batches (0=off)", default=0, converter=to_int, validator=ge(0))
+    modelString = Param("Warm-start booster string", default="", converter=to_str)
+    verbosity = Param("Verbosity", default=-1, converter=to_int)
+    seed = Param("Master seed", default=0, converter=to_int)
+    featuresShapCol = Param("Output column for SHAP values ('' = off)", default="", converter=to_str)
+    leafPredictionCol = Param("Output column for leaf indices ('' = off)", default="", converter=to_str)
+    useSingleDatasetMode = Param("Accepted for API parity (dataset is always host-resident)", default=True, converter=to_bool)
+    numTasks = Param("Override number of mesh shards (0 = all devices)", default=0, converter=to_int, validator=ge(0))
+
+    def _objective_name(self) -> str:
+        raise NotImplementedError
+
+    def _extra_train_options(self) -> dict:
+        return {}
+
+    def _make_options(self, num_class: int = 1) -> TrainOptions:
+        kwargs = dict(
+            objective=self._objective_name(),
+            num_iterations=self.getNumIterations(),
+            learning_rate=self.getLearningRate(),
+            num_leaves=self.getNumLeaves(),
+            max_depth=self.getMaxDepth(),
+            max_bin=self.getMaxBin(),
+            lambda_l1=self.getLambdaL1(),
+            lambda_l2=self.getLambdaL2(),
+            min_data_in_leaf=self.getMinDataInLeaf(),
+            min_sum_hessian_in_leaf=self.getMinSumHessianInLeaf(),
+            min_gain_to_split=self.getMinGainToSplit(),
+            bagging_fraction=self.getBaggingFraction(),
+            bagging_freq=self.getBaggingFreq(),
+            feature_fraction=self.getFeatureFraction(),
+            max_delta_step=self.getMaxDeltaStep(),
+            num_class=num_class,
+            boosting_type=self.getBoostingType(),
+            metric=self.getMetric() or None,
+            early_stopping_round=self.getEarlyStoppingRound(),
+            improvement_tolerance=self.getImprovementTolerance(),
+            seed=self.getSeed(),
+        )
+        kwargs.update(self._extra_train_options())
+        return TrainOptions(**kwargs)
+
+
+def extract_features(table: Table, features_col: str) -> np.ndarray:
+    feats = table.column(features_col)
+    if feats.dtype == object:
+        feats = np.stack([np.asarray(row, dtype=np.float64) for row in feats])
+    return np.asarray(feats, dtype=np.float64)
+
+
+class LightGBMBase(LightGBMParams, Estimator):
+    """Shared fit flow (LightGBMBase.scala:26-213)."""
+
+    def _num_classes(self, y: np.ndarray) -> int:
+        return 1
+
+    def _select_mesh(self):
+        """Mesh selection = the ClusterUtil worker-count computation
+        (LightGBMBase.scala:166-176): all devices on the data axis unless
+        `numTasks` caps it or parallelism is serial."""
+        import jax
+
+        if self.getParallelism() == "serial":
+            return None
+        n = len(jax.devices())
+        if self.getNumTasks() > 0:
+            n = min(n, self.getNumTasks())
+        if n <= 1:
+            return None
+        from mmlspark_tpu.parallel.mesh import best_mesh
+
+        return best_mesh(n)
+
+    def _prepare(self, table: Table):
+        X = extract_features(table, self.getFeaturesCol())
+        y = np.asarray(table.column(self.getLabelCol()), dtype=np.float64)
+        w = None
+        if self.isSet("weightCol"):
+            w = np.asarray(table.column(self.getWeightCol()), dtype=np.float64)
+        init = None
+        if self.isSet("initScoreCol"):
+            init = np.asarray(table.column(self.getInitScoreCol()), dtype=np.float64)
+        return X, y, w, init
+
+    def _fit(self, table: Table) -> "LightGBMModelBase":
+        # Validation split by indicator column (LightGBMBase.scala:196-197).
+        valid_table = None
+        if self.isSet("validationIndicatorCol"):
+            ind = np.asarray(table.column(self.getValidationIndicatorCol()), dtype=bool)
+            valid_table, table = table.filter(ind), table.filter(~ind)
+
+        X, y, w, init = self._prepare(table)
+        num_class = self._num_classes(y)
+        opts = self._make_options(num_class)
+
+        bins, mapper = bin_dataset(X, max_bin=opts.max_bin)
+        valid_sets = []
+        if valid_table is not None and valid_table.num_rows > 0:
+            Xv, yv, wv, _ = self._prepare(valid_table)
+            bv, _ = bin_dataset(Xv, mapper=mapper)
+            valid_sets.append(("valid_0", bv, yv, wv))
+
+        mesh = self._select_mesh()
+        init_margins = None
+        if init is not None:
+            init_margins = np.asarray(init, dtype=np.float32)
+            if init_margins.ndim == 1:
+                init_margins = init_margins[:, None]
+        warm = self.getModelString()
+        if warm:
+            prev = Booster.from_string(warm)
+            init_margins = prev.raw_margin(X)
+
+        num_batches = self.getNumBatches()
+        feature_names = [f"f{i}" for i in range(X.shape[1])]
+        if num_batches and num_batches > 1:
+            result = self._fit_batches(
+                bins, y, w, init_margins, opts, mapper, mesh, valid_sets, feature_names,
+                num_batches,
+            )
+        else:
+            result = train(
+                bins, y, opts, w=w, init_margins=init_margins,
+                valid_sets=valid_sets, mapper=mapper, mesh=mesh,
+                feature_names=feature_names,
+            )
+        model = self._make_model(result)
+        model.parent = self
+        return model
+
+    def _fit_batches(
+        self, bins, y, w, init_margins, opts, mapper, mesh, valid_sets,
+        feature_names, num_batches,
+    ) -> TrainResult:
+        """Batch-mode training: boosters chained across row batches with
+        margin carry-over (LightGBMBase.scala:26-48)."""
+        n = len(y)
+        edges = np.linspace(0, n, num_batches + 1).astype(int)
+        boosters: List[Booster] = []
+        result = None
+        for bi in range(num_batches):
+            lo, hi = edges[bi], edges[bi + 1]
+            if hi <= lo:
+                continue
+            im = None if init_margins is None else init_margins[lo:hi]
+            if boosters:
+                # margins of previous ensemble on this batch's rows
+                im = _ensemble_margin(boosters, bins[lo:hi], mapper)
+            result = train(
+                bins[lo:hi], y[lo:hi], opts,
+                w=None if w is None else w[lo:hi],
+                init_margins=im, valid_sets=valid_sets, mapper=mapper, mesh=mesh,
+                feature_names=feature_names,
+            )
+            boosters.append(result.booster)
+        merged = _merge_boosters(boosters)
+        return TrainResult(booster=merged, evals=result.evals, best_iteration=result.best_iteration)
+
+    def _make_model(self, result: TrainResult) -> "LightGBMModelBase":
+        raise NotImplementedError
+
+
+def _ensemble_margin(boosters: List[Booster], bins: np.ndarray, mapper: BinMapper) -> np.ndarray:
+    import jax.numpy as jnp
+
+    from mmlspark_tpu.lightgbm.train import _route_binned
+
+    total = None
+    for b in boosters:
+        # Route in bin space (bins built with the shared mapper).
+        import jax
+
+        def margin_fn(bv):
+            m = jnp.broadcast_to(
+                jnp.asarray(b.init_score)[None, :], (bv.shape[0], b.num_classes)
+            )
+            for t in range(b.num_trees):
+                leaf = _route_binned(
+                    bv, jnp.asarray(b.split_feature[t]), jnp.asarray(b.split_bin[t]),
+                    b.max_depth,
+                )
+                m = m.at[:, t % b.num_classes].add(jnp.asarray(b.leaf_values[t])[leaf])
+            return m
+
+        m = np.asarray(jax.jit(margin_fn)(jnp.asarray(bins, dtype=jnp.int32)))
+        total = m if total is None else total + m
+    return total
+
+
+def _merge_boosters(boosters: List[Booster]) -> Booster:
+    """Concatenate chained batch boosters into one additive model
+    (the `LGBM_BoosterMerge` analogue, TrainUtils.scala:165-167)."""
+    if len(boosters) == 1:
+        return boosters[0]
+    first = boosters[0]
+    return Booster(
+        split_feature=np.concatenate([b.split_feature for b in boosters]),
+        split_bin=np.concatenate([b.split_bin for b in boosters]),
+        split_threshold=np.concatenate([b.split_threshold for b in boosters]),
+        leaf_values=np.concatenate([b.leaf_values for b in boosters]),
+        init_score=first.init_score,
+        num_classes=first.num_classes,
+        objective=first.objective,
+        max_depth=first.max_depth,
+        best_iteration=-1,
+        feature_names=first.feature_names,
+        bin_edges=first.bin_edges,
+    )
+
+
+class LightGBMModelBase(HasFeaturesCol, HasPredictionCol, Model):
+    """Shared model surface: booster access, native-model serde, leaf output."""
+
+    boosterData = Param("Fitted booster state", is_complex=True)
+    leafPredictionCol = Param("Output column for leaf indices ('' = off)", default="", converter=to_str)
+    featuresShapCol = Param("Output column for SHAP values ('' = off)", default="", converter=to_str)
+
+    @property
+    def booster(self) -> Booster:
+        return Booster.from_dict(self.getBoosterData())
+
+    def set_booster(self, booster: Booster) -> None:
+        self.set("boosterData", booster.to_dict())
+
+    def get_model_string(self) -> str:
+        return self.booster.model_to_string()
+
+    def save_native_model(self, path: str) -> None:
+        """`saveNativeModel` (LightGBMClassifier.scala:172-180)."""
+        with open(path, "w") as f:
+            f.write(self.get_model_string())
+
+    @classmethod
+    def load_native_model(cls, path: str, **kwargs) -> "LightGBMModelBase":
+        with open(path) as f:
+            booster = Booster.from_string(f.read())
+        m = cls(**kwargs)
+        m.set_booster(booster)
+        return m
+
+    def get_feature_importances(self, importance_type: str = "split") -> np.ndarray:
+        return self.booster.feature_importances(importance_type)
+
+    def _with_leaf_col(self, table: Table, X: np.ndarray) -> Table:
+        if self.getLeafPredictionCol():
+            leaves = self.booster.predict_leaf(X).astype(np.float64)
+            table = table.with_column(self.getLeafPredictionCol(), leaves)
+        return table

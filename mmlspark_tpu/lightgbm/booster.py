@@ -1,0 +1,194 @@
+"""Booster: the trained forest, with jitted batch predict and model serde.
+
+Equivalent of ``LightGBMBooster`` (reference ``lightgbm/LightGBMBooster.scala``):
+score / predictLeaf / raw-margin output, iteration slicing for early stopping,
+string serde. Instead of per-row JNI calls with ThreadLocal native buffers
+(``LightGBMBooster.scala:37-128``), prediction is one jitted XLA program over
+the whole batch; trees are dense implicit-heap arrays so traversal is D
+gathers per tree — no data-dependent control flow.
+
+Tree layout (depth D, per tree):
+- ``split_feature``  (2^D - 1,) int32   — heap order; dead nodes = 0
+- ``split_threshold``(2^D - 1,) float32 — raw-value "go left if x <= t or NaN";
+                                           dead nodes = +inf (all rows left)
+- ``split_bin``      (2^D - 1,) int32   — binned-space threshold (training path)
+- ``leaf_values``    (2^D,)    float32  — learning-rate-scaled outputs
+
+Forest arrays stack trees as (num_trees, ...) where tree ``i*C + c`` is
+iteration i, class c (LightGBM's tree ordering).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from functools import partial
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mmlspark_tpu.lightgbm.binning import BinMapper
+
+
+@dataclasses.dataclass
+class Booster:
+    split_feature: np.ndarray  # (T, I)
+    split_threshold: np.ndarray  # (T, I)
+    split_bin: np.ndarray  # (T, I)
+    leaf_values: np.ndarray  # (T, L)
+    init_score: np.ndarray  # (C,)
+    num_classes: int  # margin columns C
+    objective: str
+    max_depth: int
+    best_iteration: int = -1  # -1 = use all
+    feature_names: Optional[list] = None
+    bin_edges: Optional[np.ndarray] = None  # (F, max_bin-1) for re-binning
+
+    @property
+    def num_trees(self) -> int:
+        return self.split_feature.shape[0]
+
+    @property
+    def num_iterations(self) -> int:
+        return self.num_trees // self.num_classes
+
+    def _used_trees(self, num_iteration: Optional[int] = None) -> int:
+        it = num_iteration
+        if it is None:
+            it = self.best_iteration if self.best_iteration > 0 else self.num_iterations
+        return min(it, self.num_iterations) * self.num_classes
+
+    # -- predict -------------------------------------------------------------
+
+    def raw_margin(
+        self, X: np.ndarray, num_iteration: Optional[int] = None
+    ) -> np.ndarray:
+        """(N, C) raw margins (init_score + sum of tree outputs)."""
+        t = self._used_trees(num_iteration)
+        if t == 0:
+            return np.broadcast_to(
+                self.init_score[None, :], (X.shape[0], self.num_classes)
+            ).copy()
+        out = _predict_margin_jit(
+            jnp.asarray(X, dtype=jnp.float32),
+            jnp.asarray(self.split_feature[:t]),
+            jnp.asarray(self.split_threshold[:t]),
+            jnp.asarray(self.leaf_values[:t]),
+            jnp.asarray(self.init_score),
+            self.num_classes,
+        )
+        return np.asarray(out)
+
+    def predict_leaf(
+        self, X: np.ndarray, num_iteration: Optional[int] = None
+    ) -> np.ndarray:
+        """(N, T) leaf index per tree (``predictLeaf``, LightGBMBooster.scala:240+)."""
+        t = self._used_trees(num_iteration)
+        out = _predict_leaf_jit(
+            jnp.asarray(X, dtype=jnp.float32),
+            jnp.asarray(self.split_feature[:t]),
+            jnp.asarray(self.split_threshold[:t]),
+        )
+        return np.asarray(out)
+
+    # -- serde ---------------------------------------------------------------
+
+    def to_dict(self) -> Dict[str, Any]:
+        d = dataclasses.asdict(self)
+        return d
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "Booster":
+        d = dict(d)
+        for k in ("split_feature", "split_bin"):
+            d[k] = np.asarray(d[k], dtype=np.int32)
+        for k in ("split_threshold", "leaf_values", "init_score"):
+            d[k] = np.asarray(d[k], dtype=np.float32)
+        if d.get("bin_edges") is not None:
+            d["bin_edges"] = np.asarray(d["bin_edges"], dtype=np.float64)
+        return Booster(**d)
+
+    def model_to_string(self) -> str:
+        """Textual model dump (``saveNativeModel`` analogue; our own JSON
+        format — LightGBM text-format interop is tracked as a gap)."""
+        d = self.to_dict()
+        for k, v in d.items():
+            if isinstance(v, np.ndarray):
+                d[k] = {"__nd__": v.tolist(), "dtype": str(v.dtype), "shape": v.shape}
+        return json.dumps(d)
+
+    @staticmethod
+    def from_string(s: str) -> "Booster":
+        d = json.loads(s)
+        for k, v in list(d.items()):
+            if isinstance(v, dict) and "__nd__" in v:
+                d[k] = np.asarray(v["__nd__"], dtype=v["dtype"]).reshape(v["shape"])
+        return Booster.from_dict(d)
+
+    def feature_importances(self, importance_type: str = "split") -> np.ndarray:
+        """Split-count or total-gain-free importance
+        (``getFeatureImportances``, LightGBMBooster.scala:295-310)."""
+        alive = np.isfinite(self.split_threshold)
+        feats = self.split_feature[alive]
+        num_features = (
+            len(self.feature_names)
+            if self.feature_names
+            else (int(feats.max()) + 1 if feats.size else 0)
+        )
+        return np.bincount(feats.ravel(), minlength=num_features).astype(np.float64)
+
+
+# ---------------------------------------------------------------------------
+# Jitted predict kernels
+# ---------------------------------------------------------------------------
+
+
+def _route_rows(X, feat, thr):
+    """One tree, all rows: D gather steps through the implicit heap.
+    X (N,F) raw float32; feat/thr (I,). Returns final leaf index (N,)."""
+    n = X.shape[0]
+    num_internal = feat.shape[0]
+    depth = int(np.log2(num_internal + 1))
+    node = jnp.zeros(n, dtype=jnp.int32)
+    for _ in range(depth):
+        f = feat[node]  # (N,)
+        t = thr[node]
+        x = jnp.take_along_axis(X, f[:, None], axis=1)[:, 0]
+        go_right = jnp.logical_not(jnp.isnan(x) | (x <= t))
+        node = 2 * node + 1 + go_right.astype(jnp.int32)
+    return node - num_internal  # leaf index in [0, 2^D)
+
+
+@partial(jax.jit, static_argnames=("num_classes",))
+def _predict_margin_jit(X, feat, thr, leaf_vals, init_score, num_classes):
+    t = feat.shape[0]
+    rounds = t // num_classes
+    featr = feat.reshape(rounds, num_classes, -1)
+    thrr = thr.reshape(rounds, num_classes, -1)
+    lvr = leaf_vals.reshape(rounds, num_classes, -1)
+    n = X.shape[0]
+
+    def one_round(margins, tree):
+        f, th, lv = tree
+
+        def one_class(c):
+            leaf = _route_rows(X, f[c], th[c])
+            return lv[c][leaf]
+
+        contrib = jax.vmap(one_class, out_axes=1)(jnp.arange(num_classes))
+        return margins + contrib, None
+
+    init = jnp.broadcast_to(init_score[None, :], (n, num_classes))
+    margins, _ = jax.lax.scan(one_round, init, (featr, thrr, lvr))
+    return margins
+
+
+@jax.jit
+def _predict_leaf_jit(X, feat, thr):
+    def one_tree(tree):
+        f, th = tree
+        return _route_rows(X, f, th)
+
+    return jax.vmap(one_tree, out_axes=1)((feat, thr))

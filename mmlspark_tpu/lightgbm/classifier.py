@@ -1,0 +1,84 @@
+"""LightGBMClassifier — binary & multiclass GBDT classification.
+
+API parity with the reference ``lightgbm/LightGBMClassifier.scala:24-142``:
+infers ``actualNumClasses`` from labels, emits rawPrediction / probability /
+prediction columns, optional leaf-index output, ``saveNativeModel`` serde.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from mmlspark_tpu.core.params import Param, to_int, to_str
+from mmlspark_tpu.data.table import Table
+from mmlspark_tpu.lightgbm.base import (
+    LightGBMBase,
+    LightGBMModelBase,
+    extract_features,
+)
+from mmlspark_tpu.lightgbm.train import TrainResult
+
+
+class LightGBMClassifier(LightGBMBase):
+    objective = Param(
+        "binary or multiclass ('' = infer from label arity)",
+        default="", converter=to_str,
+    )
+    rawPredictionCol = Param("Raw margin output column", default="rawPrediction", converter=to_str)
+    probabilityCol = Param("Probability output column", default="probability", converter=to_str)
+
+    _inferred_classes: int = 2
+
+    def _num_classes(self, y: np.ndarray) -> int:
+        # actualNumClasses inference (LightGBMClassifier.scala:38-52)
+        n = int(np.max(y)) + 1 if len(y) else 2
+        self._inferred_classes = max(2, n)
+        return self._inferred_classes
+
+    def _objective_name(self) -> str:
+        obj = self.getObjective()
+        if obj:
+            return obj
+        return "binary" if self._inferred_classes <= 2 else "multiclass"
+
+    def _make_model(self, result: TrainResult) -> "LightGBMClassificationModel":
+        return LightGBMClassificationModel(
+            featuresCol=self.getFeaturesCol(),
+            predictionCol=self.getPredictionCol(),
+            rawPredictionCol=self.getRawPredictionCol(),
+            probabilityCol=self.getProbabilityCol(),
+            leafPredictionCol=self.getLeafPredictionCol(),
+            featuresShapCol=self.getFeaturesShapCol(),
+            numClasses=self._inferred_classes,
+            boosterData=result.booster.to_dict(),
+        )
+
+
+class LightGBMClassificationModel(LightGBMModelBase):
+    rawPredictionCol = Param("Raw margin output column", default="rawPrediction", converter=to_str)
+    probabilityCol = Param("Probability output column", default="probability", converter=to_str)
+    numClasses = Param("Number of classes", default=2, converter=to_int)
+
+    def transform(self, table: Table) -> Table:
+        X = extract_features(table, self.getFeaturesCol())
+        booster = self.booster
+        margins = booster.raw_margin(X)  # (N, C)
+        if booster.num_classes == 1:
+            # binary: sigmoid fixup (LightGBMBooster.scala:312-328)
+            p1 = 1.0 / (1.0 + np.exp(-margins[:, 0]))
+            probs = np.stack([1.0 - p1, p1], axis=1)
+            raw = np.stack([-margins[:, 0], margins[:, 0]], axis=1)
+        else:
+            m = margins - margins.max(axis=1, keepdims=True)
+            e = np.exp(m)
+            probs = e / e.sum(axis=1, keepdims=True)
+            raw = margins
+        pred = probs.argmax(axis=1).astype(np.float64)
+        out = (
+            table.with_column(self.getRawPredictionCol(), raw)
+            .with_column(self.getProbabilityCol(), probs)
+            .with_column(self.getPredictionCol(), pred)
+        )
+        return self._with_leaf_col(out, X)

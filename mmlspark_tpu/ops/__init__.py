@@ -1,0 +1,1 @@
+"""Compute ops: histograms, hashing, image kernels (XLA + Pallas paths)."""

@@ -1,0 +1,75 @@
+"""Gradient/hessian/count histogram building over (node, feature, bin).
+
+The hot op of GBDT training — the TPU replacement for LightGBM's native
+per-leaf histogram construction (``LGBM_BoosterUpdateOneIter``'s inner loop,
+reference ``lightgbm/TrainUtils.scala:220-315``). Two implementations:
+
+- ``segment``: flat ``segment_sum`` scatter-add. Fast on CPU; on TPU XLA
+  lowers it to serialized scatters, so it is the fallback path.
+- ``onehot``: per-feature one-hot matmul ``one_hot(node*B + bin) @ [g,h,c]``.
+  Dense MXU work with static shapes — the TPU-first formulation: ~N*K*3
+  FLOPs per feature beat sparse scatter on the systolic array.
+
+Distribution: callers shard rows across the mesh ``data`` axis; the
+histogram is a sum over rows, so under jit XLA inserts the cross-device
+``all-reduce`` automatically — this *is* the ``data_parallel`` histogram
+allreduce that LightGBM runs over its socket mesh.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _default_method() -> str:
+    return "onehot" if jax.default_backend() in ("tpu", "axon") else "segment"
+
+
+def build_histograms(
+    bins: jax.Array,  # (N, F) integer bin indices
+    grad: jax.Array,  # (N,)
+    hess: jax.Array,  # (N,)
+    count: jax.Array,  # (N,) sample weight-of-presence (0/1 under bagging)
+    node: jax.Array,  # (N,) int32 local node index in [0, num_nodes)
+    num_nodes: int,
+    num_bins: int,
+    method: Optional[str] = None,
+) -> jax.Array:
+    """Returns (num_nodes, F, num_bins, 3) float32: per-cell [sum_g, sum_h, count]."""
+    method = method or _default_method()
+    n, f = bins.shape
+    bins = bins.astype(jnp.int32)
+    node = node.astype(jnp.int32)
+    data = jnp.stack(
+        [grad.astype(jnp.float32), hess.astype(jnp.float32), count.astype(jnp.float32)],
+        axis=-1,
+    )  # (N, 3)
+
+    if method == "segment":
+        # ids[i, j] = ((node_i * F) + j) * B + bins[i, j]
+        ids = (node[:, None] * f + jnp.arange(f, dtype=jnp.int32)[None, :]) * num_bins + bins
+        flat_ids = ids.reshape(-1)
+        flat_data = jnp.broadcast_to(data[:, None, :], (n, f, 3)).reshape(-1, 3)
+        seg = jax.ops.segment_sum(
+            flat_data, flat_ids, num_segments=num_nodes * f * num_bins
+        )
+        return seg.reshape(num_nodes, f, num_bins, 3)
+
+    if method == "onehot":
+        k = num_nodes * num_bins
+        base = node * num_bins  # (N,)
+
+        def per_feature(_, feat_col):
+            # feat_col: (N,) bins of one feature
+            oh = jax.nn.one_hot(base + feat_col, k, dtype=jnp.float32)  # (N, K)
+            return None, oh.T @ data  # (K, 3) — MXU matmul
+
+        _, hists = lax.scan(per_feature, None, bins.T)  # (F, K, 3)
+        return hists.reshape(f, num_nodes, num_bins, 3).transpose(1, 0, 2, 3)
+
+    raise ValueError(f"unknown histogram method {method!r}")

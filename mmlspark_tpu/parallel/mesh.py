@@ -147,3 +147,60 @@ def pad_to_multiple(
     """Rows to pad so n divides the mesh/data axis. Returns (padded_n, pad)."""
     padded = int(math.ceil(n / multiple) * multiple)
     return padded, padded - n
+
+
+def force_platform(platform: str, min_devices: int = 1) -> None:
+    """Re-point JAX at a platform mid-process, tearing down already-initialized
+    backends (the container sitecustomize pre-creates a TPU client at
+    interpreter startup, so env vars alone are too late). For ``cpu`` with
+    ``min_devices > 1`` the host-platform device-count flag is injected —
+    it must be set before the first CPU client is created.
+
+    WARNING: only reliable before the first jit execution in the process;
+    after real compute has run, dispatch can silently stick to the old
+    backend. Use a fresh subprocess to benchmark a second platform."""
+    import os
+    import re
+
+    if platform == "cpu" and min_devices > 1:
+        flags = os.environ.get("XLA_FLAGS", "")
+        m = re.search(r"--xla_force_host_platform_device_count=(\d+)", flags)
+        if m is None:
+            flags = (flags + f" --xla_force_host_platform_device_count={min_devices}").strip()
+        elif int(m.group(1)) < min_devices:
+            flags = flags.replace(
+                m.group(0), f"--xla_force_host_platform_device_count={min_devices}"
+            )
+        os.environ["XLA_FLAGS"] = flags
+
+    import jax
+    from jax._src import xla_bridge
+
+    # Inspect only already-initialized backends — querying jax.devices() here
+    # would instantiate the CURRENT platform's client (claiming the TPU relay,
+    # the very thing this function exists to avoid).
+    initialized = dict(getattr(xla_bridge, "_backends", {}) or {})
+    current_ok = (
+        platform in initialized
+        and xla_bridge._default_backend is not None
+        and xla_bridge._default_backend.platform == platform
+        and len(initialized[platform].devices()) >= min_devices
+    )
+    if current_ok:
+        return
+    if initialized:
+        if not hasattr(xla_bridge, "_clear_backends"):
+            raise RuntimeError(
+                "jax backends already initialized and this jax version has no "
+                "_clear_backends hook; restart the process with "
+                f"JAX_PLATFORMS={platform}"
+            )
+        xla_bridge._clear_backends()
+        if hasattr(xla_bridge.get_backend, "cache_clear"):
+            xla_bridge.get_backend.cache_clear()
+    jax.config.update("jax_platforms", platform)
+    if len(jax.devices()) < min_devices:
+        raise RuntimeError(
+            f"could not materialize {min_devices} {platform} devices; "
+            f"got {jax.devices()}"
+        )

@@ -12,20 +12,18 @@ import os
 import sys
 
 os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 # The container's sitecustomize may have already initialized a TPU backend at
 # interpreter startup; tear it down and re-point JAX at the virtual-CPU fleet.
+from mmlspark_tpu.parallel.mesh import force_platform  # noqa: E402
+
+force_platform("cpu", min_devices=8)
+
 import jax  # noqa: E402
-from jax._src import xla_bridge  # noqa: E402
 
-xla_bridge._clear_backends()
-jax.config.update("jax_platforms", "cpu")
 assert len(jax.devices()) == 8, jax.devices()
-
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
 import pytest
