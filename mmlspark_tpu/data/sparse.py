@@ -1,0 +1,109 @@
+"""Padded sparse-feature batches — the TPU sparse format.
+
+TPU kernels want static shapes, so a batch of hashed sparse rows is stored as
+two dense (N, K) arrays — feature indices (padded with 0) and values (padded
+with 0.0) — where K is the max active features per row. Zero-valued padding
+is exact for linear models: gathers/scatters on index 0 with value 0
+contribute nothing. This replaces JVM SparseVector columns
+(``vw/VowpalWabbitFeaturizer.scala`` output).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class SparseBatch:
+    indices: np.ndarray  # (N, K) int32
+    values: np.ndarray  # (N, K) float32
+    dim: int  # feature-space size (1 << num_bits)
+
+    @property
+    def num_rows(self) -> int:
+        return self.indices.shape[0]
+
+    @property
+    def max_active(self) -> int:
+        return self.indices.shape[1]
+
+    def to_dense(self) -> np.ndarray:
+        out = np.zeros((self.num_rows, self.dim), dtype=np.float32)
+        rows = np.repeat(np.arange(self.num_rows), self.max_active)
+        np.add.at(out, (rows, self.indices.reshape(-1)), self.values.reshape(-1))
+        return out
+
+
+def from_lists(
+    index_lists: Sequence[np.ndarray],
+    value_lists: Sequence[np.ndarray],
+    dim: int,
+    sum_collisions: bool = True,
+    pad_to: int = 0,
+) -> SparseBatch:
+    """Assemble per-row (indices, values) into a padded batch, combining
+    duplicate indices within a row (``sumCollisions`` semantics)."""
+    combined: List[Tuple[np.ndarray, np.ndarray]] = []
+    max_k = 1
+    for idx, val in zip(index_lists, value_lists):
+        idx = np.asarray(idx, dtype=np.int64)
+        val = np.asarray(val, dtype=np.float32)
+        if len(idx):
+            uniq, inv = np.unique(idx, return_inverse=True)
+            if len(uniq) < len(idx):
+                if sum_collisions:
+                    summed = np.zeros(len(uniq), dtype=np.float32)
+                    np.add.at(summed, inv, val)
+                    idx, val = uniq, summed
+                else:
+                    # keep first occurrence per index
+                    first = np.full(len(uniq), -1, dtype=np.int64)
+                    for pos, u in enumerate(inv):
+                        if first[u] < 0:
+                            first[u] = pos
+                    idx, val = uniq, val[first]
+        combined.append((idx, val))
+        max_k = max(max_k, len(idx))
+    k = max(max_k, pad_to)
+    n = len(combined)
+    indices = np.zeros((n, k), dtype=np.int32)
+    values = np.zeros((n, k), dtype=np.float32)
+    for i, (idx, val) in enumerate(combined):
+        indices[i, : len(idx)] = idx
+        values[i, : len(val)] = val
+    return SparseBatch(indices=indices, values=values, dim=dim)
+
+
+def dense_to_batch(dense: np.ndarray, dim: int) -> SparseBatch:
+    """View a dense (N, F) matrix as a SparseBatch whose feature j is index j.
+    ``dim`` must be > F; the extra tail slots are free for e.g. a bias term."""
+    dense = np.asarray(dense, dtype=np.float32)
+    n, f = dense.shape
+    if dim <= f:
+        raise ValueError(f"dim {dim} must exceed feature count {f}")
+    return SparseBatch(
+        indices=np.broadcast_to(np.arange(f, dtype=np.int32), (n, f)).copy(),
+        values=dense,
+        dim=dim,
+    )
+
+
+def column_to_batch(column: np.ndarray, dim: int) -> SparseBatch:
+    """Interpret an object column of (indices, values) tuples as a SparseBatch."""
+    idx_lists = [np.asarray(x[0]) for x in column]
+    val_lists = [np.asarray(x[1]) for x in column]
+    return from_lists(idx_lists, val_lists, dim)
+
+
+def batch_to_column(batch: SparseBatch) -> np.ndarray:
+    """Store a SparseBatch as an object column of (indices, values) tuples,
+    trimming per-row padding."""
+    out = np.empty(batch.num_rows, dtype=object)
+    for i in range(batch.num_rows):
+        mask = batch.values[i] != 0
+        # keep index-0 entries only if they carry value; padding has value 0
+        out[i] = (batch.indices[i][mask].copy(), batch.values[i][mask].copy())
+    return out

@@ -1,0 +1,105 @@
+"""MurmurHash3 (x86 32-bit) — vectorized host hashing for VW-style featurization.
+
+Re-implements the hashing the reference does JVM-side for performance
+(``VowpalWabbitMurmurWithPrefix``, ``vw/VowpalWabbitMurmurWithPrefix.scala``;
+Spark-side featurizer hashing in ``vw/VowpalWabbitFeaturizer.scala``):
+keeping hashing out of the native hot loop was their "major performance
+improvement" (docs/vw.md) — here it runs vectorized in numpy on the host
+(C++ drop-in planned; same layout), and only integer indices reach the TPU.
+
+``murmur32_ints`` matches VW's hashing of integer feature indices;
+``murmur32_bytes`` hashes utf-8 feature-name strings; a prefix-seeded
+variant mirrors the reference's prefix optimization (hash the namespace
+once, reuse as seed).
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List, Optional, Sequence
+
+import numpy as np
+
+_C1 = np.uint32(0xCC9E2D51)
+_C2 = np.uint32(0x1B873593)
+
+
+def _rotl32(x: np.ndarray, r: int) -> np.ndarray:
+    return (x << np.uint32(r)) | (x >> np.uint32(32 - r))
+
+
+def _mix_k(k: np.ndarray) -> np.ndarray:
+    k = (k * _C1).astype(np.uint32)
+    k = _rotl32(k, 15)
+    return (k * _C2).astype(np.uint32)
+
+
+def _mix_h(h: np.ndarray, k: np.ndarray) -> np.ndarray:
+    h = h ^ k
+    h = _rotl32(h, 13)
+    return (h * np.uint32(5) + np.uint32(0xE6546B64)).astype(np.uint32)
+
+
+def _fmix(h: np.ndarray) -> np.ndarray:
+    h = h ^ (h >> np.uint32(16))
+    h = (h * np.uint32(0x85EBCA6B)).astype(np.uint32)
+    h = h ^ (h >> np.uint32(13))
+    h = (h * np.uint32(0xC2B2AE35)).astype(np.uint32)
+    return h ^ (h >> np.uint32(16))
+
+
+def murmur32_ints(values: np.ndarray, seed: int = 0) -> np.ndarray:
+    """Hash each int32/uint32 value as a 4-byte murmur3 block (VW's
+    ``hash_uniform`` over integer feature ids). Vectorized."""
+    with np.errstate(over="ignore"):
+        k = np.asarray(values, dtype=np.uint32)
+        h = np.full(k.shape, np.uint32(seed & 0xFFFFFFFF), dtype=np.uint32)
+        h = _mix_h(h, _mix_k(k))
+        h = h ^ np.uint32(4)  # length
+        return _fmix(h)
+
+
+def murmur32_bytes(data: bytes, seed: int = 0) -> int:
+    """Scalar murmur3_x86_32 over a byte string (feature-name hashing)."""
+    with np.errstate(over="ignore"):
+        h = np.uint32(seed & 0xFFFFFFFF)
+        n = len(data)
+        nblocks = n // 4
+        for i in range(nblocks):
+            k = np.uint32(int.from_bytes(data[4 * i : 4 * i + 4], "little"))
+            h = _mix_h(np.asarray(h), _mix_k(np.asarray(k)))
+        k = np.uint32(0)
+        tail = data[nblocks * 4 :]
+        for i, b in enumerate(tail):
+            k = k ^ np.uint32(b << (8 * i))
+        if tail:
+            h = np.asarray(h) ^ _mix_k(np.asarray(k))
+        h = np.asarray(h) ^ np.uint32(n)
+        return int(_fmix(h))
+
+
+def murmur32_strings(
+    values: Iterable[str], seed: int = 0, cache: Optional[dict] = None
+) -> np.ndarray:
+    """Hash an iterable of strings (object column). Pass a ``cache`` dict to
+    memoize across calls — per-row callers (the featurizer) reuse one cache
+    per column so recurring tokens hash once for the whole table."""
+    if cache is None:
+        cache = {}
+    out = []
+    for v in values:
+        h = cache.get(v)
+        if h is None:
+            h = murmur32_bytes(str(v).encode("utf-8"), seed)
+            cache[v] = h
+        out.append(h)
+    return np.asarray(out, dtype=np.uint32)
+
+
+def namespace_seed(namespace: str, seed: int = 0) -> int:
+    """Prefix-hash a namespace once and reuse as the seed for its features —
+    the ``VowpalWabbitMurmurWithPrefix`` trick."""
+    return murmur32_bytes(namespace.encode("utf-8"), seed)
+
+
+def mask_bits(h: np.ndarray, num_bits: int) -> np.ndarray:
+    return (h & np.uint32((1 << num_bits) - 1)).astype(np.int32)

@@ -1,0 +1,23 @@
+"""Online linear learning, TPU-native (VowpalWabbit-on-Spark equivalent).
+
+Same capability surface as the reference's ``vw/`` package (SURVEY.md §2.3):
+hashing featurizer with namespaces and interactions, online linear learners
+with adaptive updates, distributed training — but the native VW core and its
+spanning-tree allreduce (``ClusterSpanningTree``) are replaced by jitted
+adagrad-SGD scans per mesh shard with ``lax.pmean`` weight averaging at each
+pass boundary (the ``endPass`` allreduce equivalent).
+"""
+
+from mmlspark_tpu.vw.featurizer import VowpalWabbitFeaturizer
+from mmlspark_tpu.vw.interactions import VowpalWabbitInteractions
+from mmlspark_tpu.vw.classifier import VowpalWabbitClassifier, VowpalWabbitClassificationModel
+from mmlspark_tpu.vw.regressor import VowpalWabbitRegressor, VowpalWabbitRegressionModel
+
+__all__ = [
+    "VowpalWabbitFeaturizer",
+    "VowpalWabbitInteractions",
+    "VowpalWabbitClassifier",
+    "VowpalWabbitClassificationModel",
+    "VowpalWabbitRegressor",
+    "VowpalWabbitRegressionModel",
+]

@@ -1,0 +1,353 @@
+"""Shared VW learner machinery: params + the jitted adagrad-SGD train loop.
+
+Re-design of ``vw/VowpalWabbitBase.scala:238-442``: the native
+``VowpalWabbitNative.learn()`` per-example hot loop becomes a ``lax.scan``
+over padded minibatches (gather weights → margin → loss gradient →
+scatter-add adagrad update), and the spanning-tree allreduce
+(``trainInternalDistributed`` ``:337-365``) becomes ``lax.pmean`` weight
+averaging at each pass boundary inside one ``shard_map`` over the mesh
+``data`` axis — VW's ``endPass`` synchronization, ICI-native.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Optional, Tuple
+
+import numpy as np
+
+from mmlspark_tpu.core.params import (
+    HasFeaturesCol,
+    HasLabelCol,
+    HasPredictionCol,
+    HasWeightCol,
+    Param,
+    Params,
+    ge,
+    gt,
+    in_range,
+    to_bool,
+    to_float,
+    to_int,
+    to_str,
+)
+from mmlspark_tpu.core.pipeline import Estimator, Model
+from mmlspark_tpu.core.utils import StopWatch
+from mmlspark_tpu.data.sparse import SparseBatch, column_to_batch, dense_to_batch
+from mmlspark_tpu.data.table import Table
+from mmlspark_tpu.ops.hashing import mask_bits, murmur32_bytes
+
+#: VW's implicit constant (bias) feature, hashed from the literal "Constant".
+CONSTANT_FEATURE = b"Constant"
+
+
+def _loss_grad(loss: str, margin, y, quantile_tau: float):
+    """d loss / d margin. Labels: classifier y in {-1, +1}; regressor real."""
+    import jax
+    import jax.numpy as jnp
+
+    if loss == "logistic":
+        return -y * jax.nn.sigmoid(-y * margin)
+    if loss == "squared":
+        return margin - y
+    if loss == "hinge":
+        return jnp.where(y * margin < 1.0, -y, 0.0)
+    if loss == "quantile":
+        return jnp.where(margin > y, 1.0 - quantile_tau, -quantile_tau)
+    raise ValueError(f"unknown loss {loss!r}")
+
+
+@dataclasses.dataclass
+class VWTrainResult:
+    weights: np.ndarray
+    stats: dict
+
+
+class VowpalWabbitBaseParams(
+    HasFeaturesCol, HasLabelCol, HasPredictionCol, HasWeightCol, Params
+):
+    numPasses = Param("Training passes over the data", default=1, converter=to_int, validator=gt(0))
+    learningRate = Param("Base learning rate", default=0.5, converter=to_float, validator=gt(0))
+    powerT = Param("Learning-rate decay exponent", default=0.5, converter=to_float, validator=ge(0))
+    l1 = Param("L1 regularization (lazy, applied at pass end)", default=0.0, converter=to_float, validator=ge(0))
+    l2 = Param("L2 regularization", default=0.0, converter=to_float, validator=ge(0))
+    numBits = Param("log2 feature-space size (when features are dense)", default=18, converter=to_int, validator=in_range(1, 30))
+    batchSize = Param("Rows per SGD minibatch", default=64, converter=to_int, validator=gt(0))
+    hashSeed = Param("Hash seed for the constant feature", default=0, converter=to_int)
+    passThroughArgs = Param("VW-style CLI arg string (parsed for known flags)", default="", converter=to_str)
+    useBarrierExecutionMode = Param("Accepted for API parity (SPMD is always synchronous)", default=True, converter=to_bool)
+    initialModel = Param("Warm-start weights", is_complex=True)
+    interactions = Param("Namespace interaction pairs (handled by VowpalWabbitInteractions)", default=[], is_complex=False)
+
+    def _parse_args(self) -> dict:
+        """Parse the few VW CLI flags users commonly pass through
+        (``appendParamIfNotThere`` analogue, VowpalWabbitBase.scala:140-159)."""
+        out = {}
+        toks = self.getPassThroughArgs().split()
+        i = 0
+        while i < len(toks):
+            t = toks[i]
+
+            def val():
+                if i + 1 >= len(toks):
+                    raise ValueError(f"passThroughArgs flag {t!r} expects a value")
+                return toks[i + 1]
+
+            if t in ("--loss_function",):
+                out["loss"] = val()
+                i += 2
+            elif t in ("--learning_rate", "-l"):
+                out["learning_rate"] = float(val())
+                i += 2
+            elif t == "--passes":
+                out["passes"] = int(val())
+                i += 2
+            elif t in ("--l1", "--l2", "--power_t"):
+                out[t[2:]] = float(val())
+                i += 2
+            elif t in ("-b", "--bit_precision"):
+                out["num_bits"] = int(val())
+                i += 2
+            elif t == "--quantile_tau":
+                out["quantile_tau"] = float(val())
+                i += 2
+            else:
+                i += 1
+        return out
+
+
+class VowpalWabbitBase(VowpalWabbitBaseParams, Estimator):
+    _default_loss = "squared"
+
+    def _label_transform(self, y: np.ndarray) -> np.ndarray:
+        return y.astype(np.float32)
+
+    def _get_batch(self, table: Table) -> Tuple[SparseBatch, bool]:
+        """Returns (batch, is_hashed_space)."""
+        col = table.column(self.getFeaturesCol())
+        if col.dtype == object:
+            dim = table.metadata(self.getFeaturesCol()).get("sparse_dim")
+            if dim is None:
+                dim = 1 << self.getNumBits()
+            return column_to_batch(col, dim), True
+        # dense vector column: positions are the features; slot f is the bias
+        dense = np.asarray(col, dtype=np.float32)
+        return dense_to_batch(dense, dense.shape[1] + 1), False
+
+    def _fit(self, table: Table) -> "VowpalWabbitModelBase":
+        args = self._parse_args()
+        batch, is_hashed = self._get_batch(table)
+        y = self._label_transform(
+            np.asarray(table.column(self.getLabelCol()), dtype=np.float64)
+        )
+        w = (
+            np.asarray(table.column(self.getWeightCol()), dtype=np.float32)
+            if self.isSet("weightCol")
+            else np.ones(batch.num_rows, dtype=np.float32)
+        )
+        if is_hashed:
+            # hashed feature space: the constant feature hashes like any other
+            const_idx = int(
+                mask_bits(
+                    np.asarray([murmur32_bytes(CONSTANT_FEATURE, self.getHashSeed())]),
+                    int(np.log2(batch.dim)),
+                )[0]
+            )
+        else:
+            # dense feature space: the reserved last slot is the bias
+            const_idx = batch.dim - 1
+
+        init = None
+        if self.isSet("initialModel"):
+            init = np.asarray(self.getInitialModel(), dtype=np.float32)
+
+        result = train_linear(
+            batch,
+            y,
+            w,
+            loss=args.get("loss", self._default_loss),
+            num_passes=args.get("passes", self.getNumPasses()),
+            learning_rate=args.get("learning_rate", self.getLearningRate()),
+            power_t=args.get("power_t", self.getPowerT()),
+            l1=args.get("l1", self.getL1()),
+            l2=args.get("l2", self.getL2()),
+            batch_size=self.getBatchSize(),
+            constant_index=const_idx,
+            initial_weights=init,
+            quantile_tau=args.get("quantile_tau", 0.5),
+            mesh=self._select_mesh(),
+        )
+        model = self._make_model(result, batch.dim, const_idx)
+        model.parent = self
+        return model
+
+    def _select_mesh(self):
+        import jax
+
+        if len(jax.devices()) <= 1:
+            return None
+        from mmlspark_tpu.parallel.mesh import best_mesh
+
+        return best_mesh()
+
+    def _make_model(self, result: VWTrainResult, dim: int, const_idx: int):
+        raise NotImplementedError
+
+
+def train_linear(
+    batch: SparseBatch,
+    y: np.ndarray,
+    sample_weight: np.ndarray,
+    *,
+    loss: str,
+    num_passes: int,
+    learning_rate: float,
+    power_t: float,
+    l1: float,
+    l2: float,
+    batch_size: int,
+    constant_index: int,
+    initial_weights: Optional[np.ndarray] = None,
+    quantile_tau: float = 0.5,
+    mesh: Optional[Any] = None,
+) -> VWTrainResult:
+    """Adagrad SGD over padded minibatches; per-pass pmean weight averaging
+    across mesh shards (VW endPass allreduce)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    sw = StopWatch()
+    n, k = batch.indices.shape
+    dim = batch.dim
+
+    # append the constant feature to every row
+    idx = np.concatenate(
+        [batch.indices, np.full((n, 1), constant_index, dtype=np.int32)], axis=1
+    )
+    val = np.concatenate([batch.values, np.ones((n, 1), dtype=np.float32)], axis=1)
+    k += 1
+
+    n_shards = int(mesh.shape["data"]) if mesh is not None else 1
+    rows_per_shard = -(-n // n_shards)  # ceil
+    num_batches = -(-rows_per_shard // batch_size)
+    padded = n_shards * num_batches * batch_size
+    pad = padded - n
+    if pad:
+        idx = np.concatenate([idx, np.zeros((pad, k), dtype=np.int32)])
+        val = np.concatenate([val, np.zeros((pad, k), dtype=np.float32)])
+        y = np.concatenate([y.astype(np.float32), np.zeros(pad, dtype=np.float32)])
+        sample_weight = np.concatenate(
+            [sample_weight, np.zeros(pad, dtype=np.float32)]
+        )
+    else:
+        y = y.astype(np.float32)
+
+    w0 = (
+        initial_weights.copy()
+        if initial_weights is not None
+        else np.zeros(dim, dtype=np.float32)
+    )
+
+    lr = float(learning_rate)
+
+    def run_pass(weights, acc, bidx, bval, by, bw, t0):
+        """One pass over this shard's minibatches. Shapes:
+        bidx/bval (num_batches, B, K); by/bw (num_batches, B)."""
+
+        def step(carry, xs):
+            weights, acc, t = carry
+            bi, bv, yy, ww = xs
+            wi = weights[bi]  # (B, K) gather
+            margin = jnp.sum(wi * bv, axis=1)
+            g_row = _loss_grad(loss, margin, yy, quantile_tau) * ww
+            g = g_row[:, None] * bv  # (B, K)
+            if l2:
+                g = g + l2 * wi * (bv != 0)
+            flat_i = bi.reshape(-1)
+            flat_g = g.reshape(-1)
+            acc = acc.at[flat_i].add(flat_g * flat_g)
+            denom = jnp.sqrt(acc[flat_i]) + 1e-6
+            step_t = lr if power_t == 0.0 else lr / ((1.0 + t) ** power_t)
+            weights = weights.at[flat_i].add(-step_t * flat_g / denom)
+            return (weights, acc, t + 1.0), None
+
+        (weights, acc, t0), _ = jax.lax.scan(
+            step, (weights, acc, t0), (bidx, bval, by, bw)
+        )
+        return weights, acc, t0
+
+    def fit_fn(idx_s, val_s, y_s, w_s, weights, acc):
+        # idx_s etc are this shard's rows: (num_batches*B, K)
+        bidx = idx_s.reshape(num_batches, batch_size, k)
+        bval = val_s.reshape(num_batches, batch_size, k)
+        by = y_s.reshape(num_batches, batch_size)
+        bw = w_s.reshape(num_batches, batch_size)
+        t = jnp.zeros(())
+        for _ in range(num_passes):
+            weights, acc, t = run_pass(weights, acc, bidx, bval, by, bw, t)
+            if mesh is not None:
+                weights = jax.lax.pmean(weights, "data")
+                acc = jax.lax.pmean(acc, "data")
+        if l1:
+            weights = jnp.sign(weights) * jnp.maximum(jnp.abs(weights) - l1, 0.0)
+        return weights, acc
+
+    with sw.measure():
+        if mesh is None:
+            fitted, _ = jax.jit(fit_fn)(
+                jnp.asarray(idx), jnp.asarray(val), jnp.asarray(y),
+                jnp.asarray(sample_weight), jnp.asarray(w0),
+                jnp.zeros(dim, dtype=jnp.float32),
+            )
+        else:
+            shard = jax.shard_map(
+                fit_fn,
+                mesh=mesh,
+                in_specs=(P("data"), P("data"), P("data"), P("data"), P(), P()),
+                out_specs=(P(), P()),
+                check_vma=False,
+            )
+            fitted, _ = jax.jit(shard)(
+                jnp.asarray(idx), jnp.asarray(val), jnp.asarray(y),
+                jnp.asarray(sample_weight), jnp.asarray(w0),
+                jnp.zeros(dim, dtype=jnp.float32),
+            )
+        fitted = np.asarray(jax.block_until_ready(fitted))
+
+    stats = {
+        "rows": int(n),
+        "passes": int(num_passes),
+        "learn_time_s": sw.elapsed_s,
+        "shards": n_shards,
+        "ipass_loss": None,
+    }
+    return VWTrainResult(weights=fitted, stats=stats)
+
+
+class VowpalWabbitModelBase(HasFeaturesCol, HasPredictionCol, Model):
+    """Shared model: weights + raw margin computation
+    (``VowpalWabbitBaseModel.scala``)."""
+
+    modelWeights = Param("Fitted weight vector", is_complex=True)
+    sparseDim = Param("Feature-space size", default=0, converter=to_int)
+    constantIndex = Param("Bias feature index", default=0, converter=to_int)
+    numBits = Param("log2 feature-space size for dense inputs", default=18, converter=to_int)
+
+    def _margins(self, table: Table) -> np.ndarray:
+        col = table.column(self.getFeaturesCol())
+        w = np.asarray(self.getModelWeights())
+        if col.dtype == object:
+            batch = column_to_batch(col, len(w))
+        else:
+            batch = dense_to_batch(np.asarray(col, dtype=np.float32), len(w))
+        m = (w[batch.indices] * batch.values).sum(axis=1)
+        return m + w[self.getConstantIndex()]
+
+    def get_performance_statistics(self) -> Table:
+        """Diagnostics DataFrame analogue (VowpalWabbitBase.scala:367-391)."""
+        stats = self.getTrainingStats() if self.isSet("trainingStats") else {}
+        return Table({k: [v] for k, v in stats.items() if v is not None})
+
+    trainingStats = Param("Training diagnostics", is_complex=True)

@@ -1,0 +1,119 @@
+"""VowpalWabbitFeaturizer — typed columns → hashed sparse features.
+
+Re-design of ``vw/VowpalWabbitFeaturizer.scala`` (+ the per-type featurizers
+under ``vw/featurizer/*.scala``): numeric, boolean, string, string-array,
+map, and dense-vector columns are hashed into one sparse feature space of
+``2^numBits`` dims with murmur3, namespace prefix seeding, and
+``sumCollisions`` semantics. Hashing runs vectorized on the host; the output
+column stores (indices, values) pairs ready for padded TPU batches.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+from mmlspark_tpu.core.params import (
+    HasInputCols,
+    HasOutputCol,
+    Param,
+    ge,
+    in_range,
+    to_bool,
+    to_int,
+)
+from mmlspark_tpu.core.pipeline import Transformer
+from mmlspark_tpu.data.sparse import batch_to_column, from_lists
+from mmlspark_tpu.data.table import Table
+from mmlspark_tpu.ops.hashing import (
+    mask_bits,
+    murmur32_ints,
+    murmur32_strings,
+    namespace_seed,
+)
+
+
+class VowpalWabbitFeaturizer(HasInputCols, HasOutputCol, Transformer):
+    numBits = Param("log2 of feature-space size", default=18, converter=to_int, validator=in_range(1, 30))
+    hashSeed = Param("Murmur hash seed", default=0, converter=to_int)
+    sumCollisions = Param("Sum values on hash collisions (vs keep first)", default=True, converter=to_bool)
+    stringSplit = Param("Split string columns on whitespace into tokens", default=False, converter=to_bool)
+    prefixStringsWithColumnName = Param("Prefix hashed tokens with the column name", default=True, converter=to_bool)
+
+    def transform(self, table: Table) -> Table:
+        num_bits = self.getNumBits()
+        seed = self.getHashSeed()
+        dim = 1 << num_bits
+        n = table.num_rows
+        per_row_idx: List[List[np.ndarray]] = [[] for _ in range(n)]
+        per_row_val: List[List[np.ndarray]] = [[] for _ in range(n)]
+
+        for col_name in self.getInputCols():
+            col = table.column(col_name)
+            ns_seed = namespace_seed(col_name, seed)
+            if col.dtype != object and col.ndim == 2:
+                # dense vector column: feature j hashed from its index
+                f = col.shape[1]
+                idx = mask_bits(murmur32_ints(np.arange(f), ns_seed), num_bits)
+                for i in range(n):
+                    per_row_idx[i].append(idx)
+                    per_row_val[i].append(col[i].astype(np.float32))
+            elif col.dtype != object and col.dtype != bool:
+                # numeric column: one feature named after the column
+                h = mask_bits(murmur32_ints(np.zeros(1), ns_seed), num_bits)
+                for i in range(n):
+                    per_row_idx[i].append(h)
+                    per_row_val[i].append(np.asarray([col[i]], dtype=np.float32))
+            elif col.dtype == bool:
+                h = mask_bits(murmur32_ints(np.zeros(1), ns_seed), num_bits)
+                for i in range(n):
+                    if col[i]:
+                        per_row_idx[i].append(h)
+                        per_row_val[i].append(np.ones(1, dtype=np.float32))
+            else:
+                first = next((v for v in col if v is not None), None)
+                hash_cache: dict = {}  # one per column: recurring tokens hash once
+                if isinstance(first, dict):
+                    for i in range(n):
+                        d = col[i] or {}
+                        keys = list(d.keys())
+                        if not keys:
+                            continue
+                        hs = mask_bits(
+                            murmur32_strings(keys, ns_seed, hash_cache), num_bits
+                        )
+                        per_row_idx[i].append(hs)
+                        per_row_val[i].append(
+                            np.asarray([float(d[k]) for k in keys], dtype=np.float32)
+                        )
+                else:
+                    prefix = col_name if self.getPrefixStringsWithColumnName() else ""
+                    split = self.getStringSplit()
+                    for i in range(n):
+                        v = col[i]
+                        if v is None:
+                            continue
+                        if isinstance(v, str):
+                            tokens = v.split() if split else [v]
+                        else:
+                            tokens = [str(t) for t in v]
+                        if not tokens:
+                            continue
+                        named = [prefix + t for t in tokens] if prefix else tokens
+                        hs = mask_bits(
+                            murmur32_strings(named, ns_seed, hash_cache), num_bits
+                        )
+                        per_row_idx[i].append(hs)
+                        per_row_val[i].append(np.ones(len(tokens), dtype=np.float32))
+
+        idx_lists = [
+            np.concatenate(r) if r else np.zeros(0, dtype=np.int64) for r in per_row_idx
+        ]
+        val_lists = [
+            np.concatenate(r) if r else np.zeros(0, dtype=np.float32) for r in per_row_val
+        ]
+        batch = from_lists(idx_lists, val_lists, dim, self.getSumCollisions())
+        return table.with_column(
+            self.getOutputCol(), batch_to_column(batch), metadata={"sparse_dim": dim}
+        )

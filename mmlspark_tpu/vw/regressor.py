@@ -1,0 +1,36 @@
+"""VowpalWabbitRegressor — squared/quantile-loss online linear regression.
+
+Parity with ``vw/VowpalWabbitRegressor.scala``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from mmlspark_tpu.data.table import Table
+from mmlspark_tpu.vw.base import (
+    VowpalWabbitBase,
+    VowpalWabbitModelBase,
+    VWTrainResult,
+)
+
+
+class VowpalWabbitRegressor(VowpalWabbitBase):
+    _default_loss = "squared"
+
+    def _make_model(self, result: VWTrainResult, dim: int, const_idx: int):
+        return VowpalWabbitRegressionModel(
+            featuresCol=self.getFeaturesCol(),
+            predictionCol=self.getPredictionCol(),
+            modelWeights=result.weights,
+            sparseDim=dim,
+            constantIndex=const_idx,
+            trainingStats=result.stats,
+        )
+
+
+class VowpalWabbitRegressionModel(VowpalWabbitModelBase):
+    def transform(self, table: Table) -> Table:
+        return table.with_column(
+            self.getPredictionCol(), self._margins(table).astype(np.float64)
+        )

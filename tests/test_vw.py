@@ -1,0 +1,155 @@
+"""VW-equivalent learner tests (reference: vw test suites + Amazon-reviews
+text classification config, BASELINE.md config 4)."""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.data.table import Table
+from mmlspark_tpu.ops.hashing import murmur32_bytes, murmur32_ints, murmur32_strings
+from mmlspark_tpu.vw import (
+    VowpalWabbitClassifier,
+    VowpalWabbitClassificationModel,
+    VowpalWabbitFeaturizer,
+    VowpalWabbitInteractions,
+    VowpalWabbitRegressor,
+)
+
+
+def test_murmur_reference_vectors():
+    # canonical murmur3_x86_32 test vectors
+    assert murmur32_bytes(b"", 0) == 0
+    assert murmur32_bytes(b"", 1) == 0x514E28B7
+    assert murmur32_bytes(b"abc", 0) == 0xB3DD93FA
+    assert murmur32_bytes(b"Hello, world!", 1234) == 0xFAF6CDB3
+
+
+def test_murmur_int_vectorized_consistency():
+    vals = np.asarray([0, 1, 42, 2**31 - 1], dtype=np.uint32)
+    vec = murmur32_ints(vals, seed=7)
+    for i, v in enumerate(vals):
+        assert vec[i] == murmur32_bytes(int(v).to_bytes(4, "little"), 7)
+
+
+def test_featurizer_types():
+    t = Table(
+        {
+            "num": np.array([1.5, 2.0, 0.0]),
+            "txt": np.array(["good movie", "bad movie", "meh"], dtype=object),
+            "vec": np.array([[1.0, 2.0], [3.0, 4.0], [5.0, 6.0]]),
+            "flag": np.array([True, False, True]),
+        }
+    )
+    f = VowpalWabbitFeaturizer(
+        inputCols=["num", "txt", "vec", "flag"], outputCol="features",
+        stringSplit=True, numBits=15,
+    )
+    out = f.transform(t)
+    assert out.metadata("features")["sparse_dim"] == 1 << 15
+    idx0, val0 = out["features"][0]
+    # num(1) + 2 tokens + vec(2) + flag(1) = 6 features (modulo collisions)
+    assert len(idx0) >= 5
+    assert (idx0 < (1 << 15)).all()
+    # same text token hashes identically across rows
+    idx_a = set(out["features"][0][0])
+    idx_b = set(out["features"][1][0])
+    assert idx_a & idx_b  # "movie" token + shared numeric/vector/bias features
+
+
+def test_classifier_text_pipeline():
+    rng = np.random.default_rng(0)
+    pos_words = ["great", "excellent", "love", "wonderful", "best"]
+    neg_words = ["terrible", "awful", "hate", "worst", "boring"]
+    neutral = ["movie", "film", "plot", "actor", "scene", "the", "a"]
+    texts, labels = [], []
+    for i in range(800):
+        y = i % 2
+        pool = pos_words if y else neg_words
+        words = list(rng.choice(pool, size=2)) + list(rng.choice(neutral, size=4))
+        rng.shuffle(words)
+        texts.append(" ".join(words))
+        labels.append(float(y))
+    t = Table({"text": np.array(texts, dtype=object), "label": np.array(labels)})
+    feat = VowpalWabbitFeaturizer(inputCols=["text"], outputCol="features", stringSplit=True)
+    t2 = feat.transform(t)
+    clf = VowpalWabbitClassifier(numPasses=3).fit(t2)
+    out = clf.transform(t2)
+    acc = (out["prediction"] == np.array(labels)).mean()
+    assert acc > 0.95, acc
+    assert out["probability"].shape == (800, 2)
+
+
+def test_regressor_dense_features():
+    rng = np.random.default_rng(1)
+    X = rng.normal(size=(600, 6)).astype(np.float64)
+    w_true = np.array([1.0, -2.0, 0.5, 0.0, 3.0, -1.0])
+    y = X @ w_true + 0.7 + 0.05 * rng.normal(size=600)
+    t = Table({"features": X, "label": y})
+    m = VowpalWabbitRegressor(numPasses=10, learningRate=0.5).fit(t)
+    pred = m.transform(t)["prediction"]
+    r2 = 1 - np.sum((pred - y) ** 2) / np.sum((y - y.mean()) ** 2)
+    assert r2 > 0.95, r2
+
+
+def test_regressor_quantile_loss():
+    rng = np.random.default_rng(2)
+    X = rng.normal(size=(1000, 3))
+    y = X[:, 0] + rng.normal(size=1000)
+    t = Table({"features": X, "label": y})
+    m = VowpalWabbitRegressor(
+        numPasses=8, passThroughArgs="--loss_function quantile --quantile_tau 0.9"
+    ).fit(t)
+    pred = m.transform(t)["prediction"]
+    assert 0.75 < (y <= pred).mean() <= 1.0
+
+
+def test_interactions_cross():
+    t = Table(
+        {
+            "a": np.array(["x", "y"], dtype=object),
+            "b": np.array(["u", "v"], dtype=object),
+        }
+    )
+    fa = VowpalWabbitFeaturizer(inputCols=["a"], outputCol="fa", numBits=10)
+    fb = VowpalWabbitFeaturizer(inputCols=["b"], outputCol="fb", numBits=10)
+    t = fb.transform(fa.transform(t))
+    inter = VowpalWabbitInteractions(inputCols=["fa", "fb"], outputCol="cross", numBits=10)
+    out = inter.transform(t)
+    (i0, v0), (i1, v1) = out["cross"][0], out["cross"][1]
+    assert len(i0) == 1 and len(i1) == 1
+    assert i0[0] != i1[0]  # different crossed pairs hash differently
+
+
+def test_warm_start_initial_model():
+    rng = np.random.default_rng(3)
+    X = rng.normal(size=(300, 4))
+    y = (X[:, 0] > 0).astype(float)
+    t = Table({"features": X, "label": y})
+    m1 = VowpalWabbitClassifier(numPasses=2).fit(t)
+    m2 = VowpalWabbitClassifier(numPasses=2, initialModel=m1.getModelWeights()).fit(t)
+    from mmlspark_tpu.lightgbm.objectives import binary_logloss
+
+    ll1 = binary_logloss(y, m1._margins(t), np.ones(300))
+    ll2 = binary_logloss(y, m2._margins(t), np.ones(300))
+    assert ll2 <= ll1 + 1e-6
+
+
+def test_save_load(tmp_path, table_equal):
+    rng = np.random.default_rng(4)
+    X = rng.normal(size=(100, 3))
+    y = (X[:, 0] > 0).astype(float)
+    t = Table({"features": X, "label": y})
+    m = VowpalWabbitClassifier(numPasses=1).fit(t)
+    p = str(tmp_path / "vw")
+    m.save(p)
+    loaded = VowpalWabbitClassificationModel.load(p)
+    table_equal(m.transform(t), loaded.transform(t))
+
+
+def test_performance_statistics():
+    rng = np.random.default_rng(5)
+    X = rng.normal(size=(64, 2))
+    t = Table({"features": X, "label": (X[:, 0] > 0).astype(float)})
+    m = VowpalWabbitClassifier(numPasses=1).fit(t)
+    stats = m.get_performance_statistics()
+    assert "rows" in stats.columns and stats["rows"][0] == 64
+    assert stats["learn_time_s"][0] > 0
