@@ -130,8 +130,12 @@ def _read_stage_list(path: str) -> List[Any]:
 
 
 def _write_pickle(value: Any, path: str) -> None:
+    # cloudpickle handles closures/lambdas — the UDFParam case
+    # (org/apache/spark/ml/param/UDFParam.scala uses Java closure serde).
+    import cloudpickle
+
     with open(os.path.join(path, "value.pkl"), "wb") as f:
-        pickle.dump(value, f)
+        cloudpickle.dump(value, f)
 
 
 def _read_pickle(path: str) -> Any:

@@ -1,0 +1,230 @@
+"""Generic pipeline stages (reference ``stages/`` test suites, SURVEY.md §2.11)."""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.data.table import Table
+from mmlspark_tpu.stages import (
+    Cacher,
+    ClassBalancer,
+    DropColumns,
+    DynamicMiniBatchTransformer,
+    EnsembleByKey,
+    Explode,
+    FixedMiniBatchTransformer,
+    FlattenBatch,
+    Lambda,
+    MultiColumnAdapter,
+    RenameColumn,
+    Repartition,
+    SelectColumns,
+    StratifiedRepartition,
+    SummarizeData,
+    TextPreprocessor,
+    TimeIntervalMiniBatchTransformer,
+    Timer,
+    UDFTransformer,
+    UnicodeNormalize,
+    get_value_at,
+    to_vector,
+)
+
+
+def test_select_drop_rename(basic_table):
+    t = SelectColumns(cols=["numbers", "words"]).transform(basic_table)
+    assert t.columns == ["numbers", "words"]
+    t = DropColumns(cols=["doubles"]).transform(basic_table)
+    assert t.columns == ["numbers", "words"]
+    with pytest.raises(KeyError):
+        DropColumns(cols=["nope"]).transform(basic_table)
+    t = RenameColumn(inputCol="words", outputCol="instruments").transform(basic_table)
+    assert "instruments" in t.columns and "words" not in t.columns
+
+
+def test_cacher_repartition(basic_table):
+    assert Cacher().transform(basic_table) is basic_table
+    t = Repartition(n=2).transform(basic_table)
+    assert t.num_partitions == 2
+    assert Repartition(n=2, disable=True).transform(basic_table).num_partitions == 1
+
+
+def test_stratified_repartition():
+    # 8 rows of label 0, 4 of label 1, 4 partitions: every partition must
+    # contain both labels afterwards (reference VerifyStratifiedRepartition).
+    labels = np.array([0] * 8 + [1] * 4)
+    t = Table({"label": labels, "x": np.arange(12)}, num_partitions=4)
+    out = StratifiedRepartition(labelCol="label").transform(t)
+    for part in out.partitions():
+        assert set(np.unique(part["label"])) == {0, 1}
+    # 'mixed' partially upsamples the minority; every source row id is valid.
+    assert set(out["x"]) <= set(range(12))
+
+    # 'original' keeps the row multiset exactly.
+    out = StratifiedRepartition(labelCol="label", mode="original").transform(t)
+    assert sorted(out["x"]) == list(range(12))
+    for part in out.partitions():
+        assert set(np.unique(part["label"])) == {0, 1}
+
+    # 'equal' upsamples with replacement so label counts match.
+    out = StratifiedRepartition(labelCol="label", mode="equal").transform(t)
+    counts = {v: int((out["label"] == v).sum()) for v in (0, 1)}
+    assert counts[0] == counts[1] == 8
+
+
+def test_class_balancer():
+    t = Table({"label": np.array([0, 0, 0, 1])})
+    model = ClassBalancer(inputCol="label").fit(t)
+    out = model.transform(t)
+    np.testing.assert_allclose(out["weight"], [1.0, 1.0, 1.0, 3.0])
+
+
+def test_explode():
+    t = Table({"k": np.array([1, 2]), "vals": [[10, 20, 30], [40]]})
+    out = Explode(inputCol="vals").transform(t)
+    assert list(out["k"]) == [1, 1, 1, 2]
+    assert list(out["vals"]) == [10, 20, 30, 40]
+
+
+def test_lambda_and_udf(basic_table):
+    lam = Lambda(transformFunc=lambda t: t.with_column("twice", t["numbers"] * 2))
+    out = lam.transform(basic_table)
+    np.testing.assert_array_equal(out["twice"], [0, 2, 4, 6])
+
+    u = UDFTransformer(inputCol="doubles", outputCol="plus1", udf=lambda c: c + 1)
+    np.testing.assert_allclose(u.transform(basic_table)["plus1"], [1.0, 2.5, 3.5, 4.5])
+
+    u2 = UDFTransformer(
+        inputCols=["numbers", "doubles"], outputCol="sum", udf=lambda a, b: a + b
+    )
+    np.testing.assert_allclose(u2.transform(basic_table)["sum"], [0.0, 2.5, 4.5, 6.5])
+
+
+def test_multi_column_adapter(basic_table):
+    base = UDFTransformer(udf=lambda c: c.astype(np.float64) * 10)
+    adapter = MultiColumnAdapter(
+        baseStage=base,
+        inputCols=["numbers", "doubles"],
+        outputCols=["n10", "d10"],
+    )
+    out = adapter.transform(basic_table)
+    np.testing.assert_allclose(out["n10"], [0, 10, 20, 30])
+    np.testing.assert_allclose(out["d10"], [0, 15, 25, 35])
+
+
+def test_text_preprocessor():
+    t = Table({"text": np.array(["The Happy sad", "JE T'aime"], dtype=object)})
+    out = TextPreprocessor(
+        inputCol="text",
+        outputCol="out",
+        map={"Happy": "glad", "sad": "blue", "je t'aime": "i love you"},
+        normFunc="lowerCase",
+    ).transform(t)
+    # Keys are normalized like the text; unmatched spans keep original casing.
+    assert list(out["out"]) == ["The glad blue", "i love you"]
+
+
+def test_unicode_normalize():
+    t = Table({"text": np.array(["Ça va Bien", "ﬁne"], dtype=object)})
+    out = UnicodeNormalize(inputCol="text", outputCol="out", form="NFKD").transform(t)
+    assert "fine" in list(out["out"])[1]
+
+
+def test_timer(basic_table, caplog):
+    import logging
+
+    stage = UDFTransformer(inputCol="numbers", outputCol="n2", udf=lambda c: c * 2)
+    with caplog.at_level(logging.INFO, logger="mmlspark_tpu.stages"):
+        model = Timer(stage=stage).fit(basic_table)
+        out = model.transform(basic_table)
+    np.testing.assert_array_equal(out["n2"], [0, 2, 4, 6])
+    assert any("transform took" in r.message for r in caplog.records)
+
+
+def test_ensemble_by_key():
+    t = Table(
+        {
+            "key": np.array(["a", "a", "b"], dtype=object),
+            "score": np.array([1.0, 3.0, 5.0]),
+            "vec": np.array([[1.0, 0.0], [3.0, 2.0], [5.0, 4.0]]),
+        }
+    )
+    out = EnsembleByKey(keys=["key"], cols=["score", "vec"]).transform(t)
+    assert out.num_rows == 2
+    by_key = {out["key"][i]: i for i in range(2)}
+    assert out["mean(score)"][by_key["a"]] == 2.0
+    np.testing.assert_allclose(out["mean(vec)"][by_key["a"]], [2.0, 1.0])
+    # Non-collapsed: aggregate broadcast back to rows.
+    out2 = EnsembleByKey(keys=["key"], cols=["score"], collapseGroup=False).transform(t)
+    np.testing.assert_allclose(out2["mean(score)"], [2.0, 2.0, 5.0])
+
+
+def test_summarize_data(basic_table):
+    out = SummarizeData().transform(basic_table)
+    assert out.num_rows == 3
+    row = {out["Feature"][i]: i for i in range(3)}
+    assert out["Count"][row["numbers"]] == 4.0
+    assert out["Mean"][row["doubles"]] == pytest.approx(1.875)
+    assert np.isnan(out["Mean"][row["words"]])
+
+
+def test_fixed_minibatch_roundtrip(basic_table):
+    batched = FixedMiniBatchTransformer(batchSize=3).transform(basic_table)
+    assert batched.num_rows == 2
+    assert len(batched["numbers"][0]) == 3 and len(batched["numbers"][1]) == 1
+    flat = FlattenBatch().transform(batched)
+    np.testing.assert_array_equal(flat["numbers"], basic_table["numbers"])
+    assert list(flat["words"]) == list(basic_table["words"])
+
+
+def test_dynamic_minibatch():
+    t = Table({"x": np.arange(10)}, num_partitions=2)
+    batched = DynamicMiniBatchTransformer().transform(t)
+    assert batched.num_rows == 2  # one batch per partition
+    batched = DynamicMiniBatchTransformer(maxBatchSize=3).transform(t)
+    assert [len(b) for b in batched["x"]] == [3, 2, 3, 2]
+
+
+def test_time_interval_minibatch():
+    ts = np.array([0, 10, 20, 5000, 5010], dtype=np.int64)
+    t = Table({"ts": ts, "x": np.arange(5)})
+    batched = TimeIntervalMiniBatchTransformer(
+        millisToWait=1000, timestampCol="ts"
+    ).transform(t)
+    assert [len(b) for b in batched["x"]] == [3, 2]
+
+
+def test_vector_batched_roundtrip():
+    t = Table({"vec": np.arange(12, dtype=np.float64).reshape(6, 2)})
+    batched = FixedMiniBatchTransformer(batchSize=4).transform(t)
+    assert batched["vec"][0].shape == (4, 2)
+    flat = FlattenBatch().transform(batched)
+    np.testing.assert_allclose(flat["vec"], t["vec"])
+
+
+def test_udfs_helpers():
+    col = np.array([[1.0, 2.0], [3.0, 4.0]])
+    np.testing.assert_allclose(get_value_at(col, 1), [2.0, 4.0])
+    ragged = np.empty(2, dtype=object)
+    ragged[0], ragged[1] = [1.0, 2.0], [3.0, 4.0]
+    np.testing.assert_allclose(get_value_at(ragged, 0), [1.0, 3.0])
+    np.testing.assert_allclose(to_vector([[1, 2], [3, 4]]), [[1.0, 2.0], [3.0, 4.0]])
+
+
+def test_stage_serialization_roundtrip(tmp_path, basic_table, table_equal):
+    stages = [
+        SelectColumns(cols=["numbers", "doubles"]),
+        FixedMiniBatchTransformer(batchSize=2),
+        FlattenBatch(),
+        UnicodeNormalize(inputCol="words", outputCol="norm"),
+        TextPreprocessor(inputCol="words", outputCol="pp", map={"drums": "beats"}),
+        EnsembleByKey(keys=["words"], cols=["doubles"]),
+        SummarizeData(),
+    ]
+    from mmlspark_tpu.core.pipeline import PipelineStage
+
+    for i, stage in enumerate(stages):
+        p = str(tmp_path / f"stage_{i}")
+        stage.save(p)
+        loaded = PipelineStage.load(p)
+        assert type(loaded) is type(stage)
+        table_equal(loaded.transform(basic_table), stage.transform(basic_table))
