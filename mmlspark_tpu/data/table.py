@@ -55,7 +55,7 @@ def _as_column(values: ColumnLike) -> np.ndarray:
 class Table:
     """An immutable, ordered collection of named columns of equal length."""
 
-    __slots__ = ("_columns", "_num_rows", "_metadata", "num_partitions")
+    __slots__ = ("_columns", "_num_rows", "_metadata", "num_partitions", "_partition_sizes")
 
     def __init__(
         self,
@@ -78,6 +78,7 @@ class Table:
         self._num_rows = n or 0
         self._metadata = dict(metadata or {})
         self.num_partitions = max(1, int(num_partitions))
+        self._partition_sizes: Optional[List[int]] = None
 
     # -- construction -------------------------------------------------------
 
@@ -146,6 +147,10 @@ class Table:
         t._num_rows = len(next(iter(columns.values()))) if columns else 0
         t._metadata = metadata if metadata is not None else dict(self._metadata)
         t.num_partitions = self.num_partitions
+        # Explicit partition sizes survive only row-preserving derivations.
+        t._partition_sizes = (
+            self._partition_sizes if t._num_rows == self._num_rows else None
+        )
         return t
 
     def with_column(
@@ -247,6 +252,13 @@ class Table:
                 merged = np.empty(sum(len(p) for p in parts), dtype=object)
                 i = 0
                 for p in parts:
+                    if p.ndim > 1:
+                        # Dense multi-dim part into object slots: element-wise
+                        # so each row keeps its array payload.
+                        for row in p:
+                            merged[i] = row
+                            i += 1
+                        continue
                     merged[i : i + len(p)] = p
                     i += len(p)
                 cols[n] = merged
@@ -261,16 +273,37 @@ class Table:
     def repartition(self, n: int) -> "Table":
         out = self._derive(dict(self._columns))
         out.num_partitions = max(1, int(n))
+        out._partition_sizes = None
+        return out
+
+    def with_partition_sizes(self, sizes: Sequence[int]) -> "Table":
+        """Pin explicit contiguous partition sizes (must sum to num_rows) —
+        used by partition-aware stages like StratifiedRepartition whose
+        groups are not the default balanced split."""
+        sizes = [int(s) for s in sizes]
+        if sum(sizes) != self._num_rows:
+            raise ValueError(
+                f"partition sizes {sizes} sum to {sum(sizes)}, "
+                f"expected {self._num_rows}"
+            )
+        out = self._derive(dict(self._columns))
+        out.num_partitions = len(sizes)
+        out._partition_sizes = sizes
         return out
 
     def coalesce(self, n: int) -> "Table":
         return self.repartition(min(self.num_partitions, n))
 
     def partition_bounds(self) -> List[Tuple[int, int]]:
-        """Row ranges of each logical partition (balanced contiguous split)."""
-        n, p = self._num_rows, self.num_partitions
-        edges = np.linspace(0, n, p + 1).astype(int)
-        return [(int(edges[i]), int(edges[i + 1])) for i in range(p)]
+        """Row ranges of each logical partition: explicit sizes when pinned,
+        else a balanced contiguous split."""
+        if self._partition_sizes is not None:
+            edges = np.concatenate([[0], np.cumsum(self._partition_sizes)])
+        else:
+            edges = np.linspace(0, self._num_rows, self.num_partitions + 1).astype(int)
+        return [
+            (int(edges[i]), int(edges[i + 1])) for i in range(len(edges) - 1)
+        ]
 
     def partitions(self) -> Iterator["Table"]:
         for lo, hi in self.partition_bounds():

@@ -1,0 +1,38 @@
+"""ONNX → JAX import (gated — the ``onnx`` package is not in this image).
+
+SURVEY.md §7 step 5 names ONNX import as the CNTK-evaluator replacement
+path. The environment ships without the ``onnx`` protobuf bindings, so this
+module degrades to a clear error; :func:`mmlspark_tpu.dnn.from_torch` is
+the supported external-graph frontend meanwhile. The op lowering table in
+:mod:`torch_import` (conv/pool/norm/activation/gemm) is exactly the set an
+ONNX walker needs, so wiring a real parser here is mechanical once the
+package exists.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Tuple
+
+import numpy as np
+
+
+def onnx_available() -> bool:
+    try:
+        import onnx  # noqa: F401
+
+        return True
+    except ImportError:
+        return False
+
+
+def from_onnx(path: str) -> Tuple[Callable, Dict[str, Any]]:
+    """Load an ONNX file into ``(apply_fn, params)`` for DNNModel."""
+    if not onnx_available():
+        raise ImportError(
+            "the 'onnx' package is not installed in this environment; "
+            "import external graphs with mmlspark_tpu.dnn.from_torch instead"
+        )
+    raise NotImplementedError(
+        "ONNX parsing lands when the onnx package is present; "
+        "use mmlspark_tpu.dnn.from_torch"
+    )

@@ -1,0 +1,381 @@
+"""torch.fx → JAX graph import.
+
+The reference ships models as serialized CNTK graphs evaluated over JNI
+(``com/microsoft/CNTK/SerializableFunction.scala:17-143``). The TPU-native
+equivalent of "bring an external deep net" is graph import into XLA: a
+``torch.nn.Module`` is symbolically traced with ``torch.fx`` and each node
+is lowered to a JAX op, producing a pure ``apply(params, inputs)`` function
+that jits onto the MXU. No torch code runs at inference time — torch is
+only the import-time frontend (the same role ONNX plays in SURVEY.md §7
+step 5; see :mod:`mmlspark_tpu.dnn.onnx_import` for the gated ONNX path).
+
+Covered op set: Conv2d (incl. groups/dilation), Linear, BatchNorm1d/2d
+(eval), LayerNorm, ReLU/GELU/SiLU/Sigmoid/Tanh/Softmax, MaxPool2d,
+AvgPool2d, AdaptiveAvgPool2d, Flatten/Dropout/Identity, residual adds,
+cat, mul, and the common tensor methods (view/reshape/flatten/mean/
+permute/transpose). Layout stays NCHW end-to-end — XLA relayouts for the
+TPU convolution units itself.
+"""
+
+from __future__ import annotations
+
+import operator
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+def _pair(v: Any) -> Tuple[int, int]:
+    if isinstance(v, (tuple, list)):
+        return int(v[0]), int(v[1])
+    return int(v), int(v)
+
+
+def _conv2d(x, w, b, stride, padding, dilation, groups):
+    import jax.numpy as jnp
+    from jax import lax
+
+    sh, sw = _pair(stride)
+    dh, dw = _pair(dilation)
+    if isinstance(padding, str):
+        pad = padding.upper()  # 'same'/'valid'
+    else:
+        ph, pw = _pair(padding)
+        pad = [(ph, ph), (pw, pw)]
+    out = lax.conv_general_dilated(
+        x,
+        w,
+        window_strides=(sh, sw),
+        padding=pad,
+        rhs_dilation=(dh, dw),
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        feature_group_count=int(groups),
+    )
+    if b is not None:
+        out = out + b.reshape(1, -1, 1, 1)
+    return out
+
+
+def _linear(x, w, b):
+    out = x @ w.T
+    if b is not None:
+        out = out + b
+    return out
+
+
+def _batch_norm(x, gamma, beta, mean, var, eps):
+    shape = (1, -1) + (1,) * (x.ndim - 2)
+    inv = (var + eps) ** -0.5
+    out = (x - mean.reshape(shape)) * inv.reshape(shape)
+    if gamma is not None:
+        out = out * gamma.reshape(shape)
+    if beta is not None:
+        out = out + beta.reshape(shape)
+    return out
+
+
+def _layer_norm(x, normalized_shape, gamma, beta, eps):
+    import jax.numpy as jnp
+
+    axes = tuple(range(x.ndim - len(normalized_shape), x.ndim))
+    mean = x.mean(axis=axes, keepdims=True)
+    var = ((x - mean) ** 2).mean(axis=axes, keepdims=True)
+    out = (x - mean) / jnp.sqrt(var + eps)
+    if gamma is not None:
+        out = out * gamma
+    if beta is not None:
+        out = out + beta
+    return out
+
+
+def _pool2d(x, kernel, stride, padding, reduce_fn, init, average: bool):
+    from jax import lax
+
+    kh, kw = _pair(kernel)
+    sh, sw = _pair(stride if stride is not None else kernel)
+    ph, pw = _pair(padding)
+    window = (1, 1, kh, kw)
+    strides = (1, 1, sh, sw)
+    pads = ((0, 0), (0, 0), (ph, ph), (pw, pw))
+    out = lax.reduce_window(x, init, reduce_fn, window, strides, pads)
+    if average:
+        out = out / float(kh * kw)
+    return out
+
+
+def _max_pool2d(x, kernel, stride=None, padding=0):
+    from jax import lax
+
+    return _pool2d(x, kernel, stride, padding, lax.max, -np.inf, average=False)
+
+
+def _avg_pool2d(x, kernel, stride=None, padding=0):
+    from jax import lax
+
+    return _pool2d(x, kernel, stride, padding, lax.add, 0.0, average=True)
+
+
+def _adaptive_avg_pool2d(x, output_size):
+    oh, ow = _pair(output_size)
+    h, w = x.shape[2], x.shape[3]
+    if (oh, ow) == (1, 1):
+        return x.mean(axis=(2, 3), keepdims=True)
+    if h % oh or w % ow:
+        raise NotImplementedError(
+            f"adaptive_avg_pool2d: input {h}x{w} not divisible by output {oh}x{ow}"
+        )
+    x = x.reshape(x.shape[0], x.shape[1], oh, h // oh, ow, w // ow)
+    return x.mean(axis=(3, 5))
+
+
+def _softmax(x, dim=-1):
+    import jax
+
+    return jax.nn.softmax(x, axis=dim)
+
+
+class _TorchGraph:
+    """A traced torch graph lowered node-by-node at call time."""
+
+    def __init__(self, graph_module: Any):
+        import torch
+
+        self.nodes = list(graph_module.graph.nodes)
+        self.modules = dict(graph_module.named_modules())
+        # Pull every parameter/buffer out of torch into numpy once; the
+        # resulting pytree is the DNNModel ``modelParams``.
+        self.params: Dict[str, Dict[str, np.ndarray]] = {}
+        for name, mod in self.modules.items():
+            entry: Dict[str, np.ndarray] = {}
+            for p_name, p in mod.named_parameters(recurse=False):
+                entry[p_name] = p.detach().cpu().numpy()
+            for b_name, b in mod.named_buffers(recurse=False):
+                entry[b_name] = b.detach().cpu().numpy()
+            if entry:
+                self.params[name] = entry
+        self.attr_consts: Dict[str, np.ndarray] = {}
+        for node in self.nodes:
+            if node.op == "get_attr":
+                obj = graph_module
+                for part in node.target.split("."):
+                    obj = getattr(obj, part)
+                self.attr_consts[node.target] = obj.detach().cpu().numpy()
+        self.input_names = [n.name for n in self.nodes if n.op == "placeholder"]
+
+    # -- node lowering -------------------------------------------------------
+
+    def _lower_module(self, mod: Any, p: Dict[str, Any], args: list, kwargs: dict):
+        import torch.nn as nn
+
+        x = args[0]
+        if isinstance(mod, nn.Conv2d):
+            return _conv2d(
+                x, p["weight"], p.get("bias"), mod.stride, mod.padding,
+                mod.dilation, mod.groups,
+            )
+        if isinstance(mod, nn.Linear):
+            return _linear(x, p["weight"], p.get("bias"))
+        if isinstance(mod, (nn.BatchNorm1d, nn.BatchNorm2d, nn.BatchNorm3d)):
+            return _batch_norm(
+                x, p.get("weight"), p.get("bias"), p["running_mean"],
+                p["running_var"], mod.eps,
+            )
+        if isinstance(mod, nn.LayerNorm):
+            return _layer_norm(
+                x, tuple(mod.normalized_shape), p.get("weight"), p.get("bias"),
+                mod.eps,
+            )
+        if isinstance(mod, nn.ReLU):
+            import jax
+
+            return jax.nn.relu(x)
+        if isinstance(mod, nn.GELU):
+            import jax
+
+            return jax.nn.gelu(x, approximate=mod.approximate != "none")
+        if isinstance(mod, nn.SiLU):
+            import jax
+
+            return jax.nn.silu(x)
+        if isinstance(mod, nn.Sigmoid):
+            import jax
+
+            return jax.nn.sigmoid(x)
+        if isinstance(mod, nn.Tanh):
+            import jax.numpy as jnp
+
+            return jnp.tanh(x)
+        if isinstance(mod, nn.Softmax):
+            return _softmax(x, mod.dim if mod.dim is not None else -1)
+        if isinstance(mod, nn.MaxPool2d):
+            return _max_pool2d(x, mod.kernel_size, mod.stride, mod.padding)
+        if isinstance(mod, nn.AvgPool2d):
+            return _avg_pool2d(x, mod.kernel_size, mod.stride, mod.padding)
+        if isinstance(mod, nn.AdaptiveAvgPool2d):
+            return _adaptive_avg_pool2d(x, mod.output_size)
+        if isinstance(mod, nn.Flatten):
+            lo = mod.start_dim
+            hi = mod.end_dim if mod.end_dim != -1 else x.ndim - 1
+            shape = x.shape[:lo] + (-1,) + x.shape[hi + 1 :]
+            return x.reshape(shape)
+        if isinstance(mod, (nn.Dropout, nn.Identity)):
+            return x
+        raise NotImplementedError(
+            f"torch module {type(mod).__name__} has no JAX lowering"
+        )
+
+    def _lower_function(self, target: Any, args: list, kwargs: dict):
+        import jax
+        import jax.numpy as jnp
+        import torch
+        import torch.nn.functional as F
+
+        table: Dict[Any, Callable] = {
+            operator.add: lambda a, b: a + b,
+            operator.sub: lambda a, b: a - b,
+            operator.mul: lambda a, b: a * b,
+            operator.truediv: lambda a, b: a / b,
+            operator.matmul: lambda a, b: a @ b,
+            torch.add: lambda a, b: a + b,
+            torch.mul: lambda a, b: a * b,
+            torch.relu: jax.nn.relu,
+            F.relu: lambda x, inplace=False: jax.nn.relu(x),
+            F.gelu: lambda x, approximate="none": jax.nn.gelu(
+                x, approximate=approximate != "none"
+            ),
+            F.silu: lambda x, inplace=False: jax.nn.silu(x),
+            torch.sigmoid: jax.nn.sigmoid,
+            F.sigmoid: jax.nn.sigmoid,
+            torch.tanh: jnp.tanh,
+            F.softmax: _softmax,
+            F.max_pool2d: _max_pool2d,
+            F.avg_pool2d: _avg_pool2d,
+            F.adaptive_avg_pool2d: _adaptive_avg_pool2d,
+            F.linear: _linear,
+            torch.flatten: lambda x, start_dim=0, end_dim=-1: x.reshape(
+                x.shape[:start_dim] + (-1,)
+            )
+            if end_dim in (-1, x.ndim - 1)
+            else x,
+            torch.cat: lambda ts, dim=0: jnp.concatenate(ts, axis=dim),
+            torch.mean: lambda x, dim=None, keepdim=False: x.mean(
+                axis=dim, keepdims=keepdim
+            ),
+        }
+        if target in table:
+            return table[target](*args, **kwargs)
+        raise NotImplementedError(f"torch function {target} has no JAX lowering")
+
+    def _lower_method(self, name: str, args: list, kwargs: dict):
+        import jax.numpy as jnp
+
+        x = args[0]
+        rest = args[1:]
+        if name in ("view", "reshape"):
+            shape = rest[0] if len(rest) == 1 and isinstance(rest[0], (tuple, list)) else rest
+            return x.reshape(tuple(int(s) for s in shape))
+        if name == "flatten":
+            start = rest[0] if rest else 0
+            return x.reshape(x.shape[:start] + (-1,))
+        if name == "mean":
+            return x.mean(axis=rest[0] if rest else None, **kwargs)
+        if name == "permute":
+            return jnp.transpose(x, rest)
+        if name == "transpose":
+            perm = list(range(x.ndim))
+            perm[rest[0]], perm[rest[1]] = perm[rest[1]], perm[rest[0]]
+            return jnp.transpose(x, perm)
+        if name == "contiguous":
+            return x
+        if name == "size":
+            return x.shape[rest[0]] if rest else x.shape
+        if name == "add":
+            return x + rest[0]
+        if name == "mul":
+            return x * rest[0]
+        raise NotImplementedError(f"tensor method .{name}() has no JAX lowering")
+
+    # -- execution -----------------------------------------------------------
+
+    def __call__(self, params: Dict[str, Dict[str, Any]], inputs: Dict[str, Any]):
+        env: Dict[Any, Any] = {}
+
+        def resolve(v: Any) -> Any:
+            import torch.fx as fx
+
+            if isinstance(v, fx.Node):
+                return env[v]
+            if isinstance(v, (list, tuple)):
+                return type(v)(resolve(x) for x in v)
+            return v
+
+        out = None
+        for node in self.nodes:
+            if node.op == "placeholder":
+                if node.name not in inputs:
+                    raise KeyError(
+                        f"missing model input {node.name!r}; have {sorted(inputs)}"
+                    )
+                env[node] = inputs[node.name]
+            elif node.op == "get_attr":
+                env[node] = self.attr_consts[node.target]
+            elif node.op == "call_module":
+                mod = self.modules[node.target]
+                p = params.get(node.target, {})
+                env[node] = self._lower_module(
+                    mod, p, [resolve(a) for a in node.args],
+                    {k: resolve(v) for k, v in node.kwargs.items()},
+                )
+            elif node.op == "call_function":
+                env[node] = self._lower_function(
+                    node.target, [resolve(a) for a in node.args],
+                    {k: resolve(v) for k, v in node.kwargs.items()},
+                )
+            elif node.op == "call_method":
+                env[node] = self._lower_method(
+                    node.target, [resolve(a) for a in node.args],
+                    {k: resolve(v) for k, v in node.kwargs.items()},
+                )
+            elif node.op == "output":
+                out = resolve(node.args[0])
+            else:  # pragma: no cover
+                raise NotImplementedError(f"fx op {node.op}")
+        return out
+
+
+def from_torch(
+    module: Any, single_input_name: str = "input", single_output_name: str = "output"
+) -> Tuple[Callable, Dict[str, Dict[str, np.ndarray]]]:
+    """Trace a ``torch.nn.Module`` and return ``(apply_fn, params)``.
+
+    ``apply_fn(params, {input_name: array}) -> {output_name: array}`` is pure
+    and jittable; ``params`` is a plain dict pytree of numpy arrays. Feed
+    both straight into :class:`~mmlspark_tpu.dnn.model.DNNModel`:
+
+        fn, params = from_torch(resnet.eval())
+        DNNModel(applyFn=fn, modelParams=params,
+                 feedDict={"input": "images"}, fetchDict={"scores": "output"})
+    """
+    import torch
+    import torch.fx as fx
+
+    module = module.eval()
+    graph_module = fx.symbolic_trace(module)
+    lowered = _TorchGraph(graph_module)
+
+    names = lowered.input_names
+    if len(names) == 1 and names[0] != single_input_name:
+        rename = {single_input_name: names[0]}
+    else:
+        rename = {}
+
+    def apply_fn(params, inputs):
+        mapped = {rename.get(k, k): v for k, v in inputs.items()}
+        result = lowered(params, mapped)
+        if isinstance(result, dict):
+            return result
+        if isinstance(result, (list, tuple)):
+            return {f"{single_output_name}_{i}": r for i, r in enumerate(result)}
+        return {single_output_name: result}
+
+    return apply_fn, lowered.params

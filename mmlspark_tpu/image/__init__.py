@@ -1,0 +1,20 @@
+"""Image pipeline (reference ``opencv/`` + ``image/`` — SURVEY.md §2.5).
+
+The reference crosses every image row into OpenCV JNI mats
+(``opencv/ImageTransformer.scala``); here images are numpy HWC arrays
+batched by shape and transformed by jitted JAX programs (resize/crop/
+flip/blur/threshold run as XLA ops on whole batches).
+"""
+
+from mmlspark_tpu.image.featurizer import ImageFeaturizer
+from mmlspark_tpu.image.transforms import ImageSetAugmenter, ImageTransformer
+from mmlspark_tpu.image.unroll import UnrollImage, roll_image, unroll_image
+
+__all__ = [
+    "ImageFeaturizer",
+    "ImageSetAugmenter",
+    "ImageTransformer",
+    "UnrollImage",
+    "roll_image",
+    "unroll_image",
+]

@@ -1,0 +1,6 @@
+"""IO: binary/image file ingest and (later) HTTP client/serving stacks
+(reference ``io/`` — SURVEY.md §2.5, §2.15, §2.16)."""
+
+from mmlspark_tpu.io.files import read_binary_files, read_images
+
+__all__ = ["read_binary_files", "read_images"]

@@ -1,0 +1,11 @@
+"""Built-in model zoo (reference ``downloader/`` model zoo role, SURVEY.md §2.14).
+
+The reference downloads pre-trained CNTK graphs from a CDN; in the TPU build
+the zoo is constructive — model families are defined here in JAX and their
+weights are produced by training or loaded from checkpoints via
+:mod:`mmlspark_tpu.downloader`.
+"""
+
+from mmlspark_tpu.models.resnet import init_resnet, resnet_apply
+
+__all__ = ["init_resnet", "resnet_apply"]

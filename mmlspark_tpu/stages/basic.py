@@ -17,8 +17,10 @@ import numpy as np
 
 from mmlspark_tpu.core.params import (
     HasInputCol,
+    HasInputCols,
     HasLabelCol,
     HasOutputCol,
+    HasOutputCols,
     Param,
     ge,
     gt,
@@ -126,25 +128,23 @@ class StratifiedRepartition(HasLabelCol, Transformer):
             fractions = np.ones(len(values))
         else:  # mixed heuristic: partial upsampling toward equal
             fractions = np.sqrt(max_count / counts)
-        # Resample each label, then deal its rows across partitions
-        # round-robin; the final stable sort by partition id plays the
-        # RangePartitioner's role for contiguous Table partitions.
-        sampled: List[np.ndarray] = []
-        parts: List[np.ndarray] = []
-        offset = 0
+        # Resample each label, split its rows into nparts near-even chunks,
+        # and give chunk p to partition p — every partition receives a slice
+        # of every label (whenever a label has ≥ nparts rows). The resulting
+        # per-partition sizes are pinned on the Table so partition_bounds
+        # reflects the actual groups (RangePartitioner's role).
+        per_part: List[List[np.ndarray]] = [[] for _ in range(nparts)]
         for val, frac in zip(values, fractions):
             idx = np.flatnonzero(labels == val)
             target = max(1, int(round(len(idx) * frac)))
             if target > len(idx):
                 idx = np.concatenate([idx, rng.choice(idx, target - len(idx))])
             rng.shuffle(idx)
-            sampled.append(idx)
-            parts.append((offset + np.arange(len(idx))) % nparts)
-            offset += len(idx)
-        all_idx = np.concatenate(sampled)
-        part_of_row = np.concatenate(parts)
-        order = np.argsort(part_of_row, kind="stable")
-        return table.take(all_idx[order])
+            for p, chunk in enumerate(np.array_split(idx, nparts)):
+                per_part[p].append(chunk)
+        part_rows = [np.concatenate(chunks) for chunks in per_part]
+        out = table.take(np.concatenate(part_rows))
+        return out.with_partition_sizes([len(r) for r in part_rows])
 
 
 class ClassBalancer(HasInputCol, HasOutputCol, Estimator):
@@ -215,12 +215,11 @@ class Lambda(Transformer):
         return f(schema) if f is not None else dict(schema)
 
 
-class UDFTransformer(HasInputCol, HasOutputCol, Transformer):
+class UDFTransformer(HasInputCol, HasInputCols, HasOutputCol, Transformer):
     """Applies a column function to one or many input columns
     (``stages/UDFTransformer.scala``). ``udf`` receives whole column
     arrays (vectorized), not scalar rows."""
 
-    inputCols = Param("Input columns (multi-input form)", converter=to_list_str)
     udf = Param("Column-level function", is_complex=True)
 
     def transform(self, table: Table) -> Table:
@@ -232,13 +231,11 @@ class UDFTransformer(HasInputCol, HasOutputCol, Transformer):
         return table.with_column(self.getOutputCol(), f(*args))
 
 
-class MultiColumnAdapter(Transformer, Estimator):
+class MultiColumnAdapter(HasInputCols, HasOutputCols, Transformer, Estimator):
     """Map a single-column stage over many column pairs
     (``stages/MultiColumnAdapter.scala:18``)."""
 
     baseStage = Param("Stage to replicate per column", is_complex=True)
-    inputCols = Param("Input columns", converter=to_list_str)
-    outputCols = Param("Output columns", converter=to_list_str)
 
     def _pairs(self) -> List[tuple]:
         ins, outs = self.getInputCols(), self.getOutputCols()
@@ -289,10 +286,17 @@ class TextPreprocessor(HasInputCol, HasOutputCol, Transformer):
         validator=one_of("identity", "lowerCase", "upperCase"),
     )
 
+    # Per-character case mapping (Java Character.toLowerCase semantics):
+    # chars whose case-fold changes length (e.g. 'İ') are left as-is so
+    # match offsets on the normalized text stay valid in the original.
+    @staticmethod
+    def _char_map(s: str, f: Callable[[str], str]) -> str:
+        return "".join(c2 if len(c2 := f(c)) == 1 else c for c in s)
+
     _NORM_FUNCS = {
         "identity": lambda s: s,
-        "lowerCase": str.lower,
-        "upperCase": str.upper,
+        "lowerCase": lambda s: TextPreprocessor._char_map(s, str.lower),
+        "upperCase": lambda s: TextPreprocessor._char_map(s, str.upper),
     }
 
     def transform(self, table: Table) -> Table:

@@ -1,0 +1,179 @@
+"""Deep inference stack: DNNModel, torch import, ResNet zoo
+(reference ``cntk/`` suites — SURVEY.md §2.4)."""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.data.table import Table
+from mmlspark_tpu.dnn import DNNModel, from_torch
+from mmlspark_tpu.models import init_resnet, resnet_apply
+
+
+def _torch_cnn():
+    import torch.nn as nn
+
+    return nn.Sequential(
+        nn.Conv2d(3, 8, 3, stride=1, padding=1),
+        nn.BatchNorm2d(8),
+        nn.ReLU(),
+        nn.MaxPool2d(2),
+        nn.Conv2d(8, 16, 3, stride=2, padding=1, groups=2),
+        nn.ReLU(),
+        nn.AdaptiveAvgPool2d((1, 1)),
+        nn.Flatten(),
+        nn.Linear(16, 5),
+        nn.Softmax(dim=-1),
+    )
+
+
+class _ResidualNet:
+    """Built lazily so torch imports stay inside tests."""
+
+    def __new__(cls):
+        import torch
+        import torch.nn as nn
+        import torch.nn.functional as F
+
+        class Block(nn.Module):
+            def __init__(self):
+                super().__init__()
+                self.conv1 = nn.Conv2d(4, 4, 3, padding=1)
+                self.conv2 = nn.Conv2d(4, 4, 3, padding=1)
+                self.fc = nn.Linear(4, 3)
+
+            def forward(self, x):
+                h = F.relu(self.conv1(x))
+                h = self.conv2(h) + x  # residual add
+                h = torch.flatten(F.adaptive_avg_pool2d(h, (1, 1)), 1)
+                return self.fc(h)
+
+        return Block()
+
+
+def test_torch_import_matches_torch():
+    import torch
+
+    torch.manual_seed(0)
+    net = _torch_cnn().eval()
+    x = np.random.default_rng(0).standard_normal((4, 3, 16, 16)).astype(np.float32)
+    with torch.no_grad():
+        expected = net(torch.from_numpy(x)).numpy()
+    fn, params = from_torch(net)
+    got = np.asarray(fn(params, {"input": x})["output"])
+    np.testing.assert_allclose(got, expected, rtol=1e-4, atol=1e-5)
+
+
+def test_torch_import_residual():
+    import torch
+
+    torch.manual_seed(1)
+    net = _ResidualNet().eval()
+    x = np.random.default_rng(1).standard_normal((2, 4, 8, 8)).astype(np.float32)
+    with torch.no_grad():
+        expected = net(torch.from_numpy(x)).numpy()
+    fn, params = from_torch(net)
+    got = np.asarray(fn(params, {"input": x})["output"])
+    np.testing.assert_allclose(got, expected, rtol=1e-4, atol=1e-5)
+
+
+def test_dnn_model_transform_batched():
+    import torch
+
+    torch.manual_seed(0)
+    net = _torch_cnn().eval()
+    fn, params = from_torch(net)
+    n = 23  # deliberately not a multiple of batchSize: exercises padding
+    images = np.random.default_rng(2).standard_normal((n, 3, 16, 16)).astype(np.float32)
+    t = Table({"id": np.arange(n), "images": [img for img in images]})
+    model = DNNModel(
+        applyFn=fn,
+        modelParams=params,
+        feedDict={"input": "images"},
+        fetchDict={"scores": "output"},
+        batchSize=8,
+    )
+    out = model.transform(t)
+    assert out["scores"].shape == (n, 5)
+    with torch.no_grad():
+        expected = net(torch.from_numpy(images)).numpy()
+    np.testing.assert_allclose(out["scores"], expected, rtol=1e-4, atol=1e-5)
+
+
+def test_dnn_model_sharded(mesh8):
+    fn = lambda params, inputs: {"output": inputs["x"] * params["scale"]}
+    n = 40
+    t = Table({"x": np.arange(n, dtype=np.float32)})
+    model = DNNModel(
+        applyFn=fn,
+        modelParams={"scale": np.float32(3.0)},
+        feedDict={"x": "x"},
+        fetchDict={"y": "output"},
+        batchSize=16,
+        shardOverMesh=True,
+    )
+    out = model.transform(t)
+    np.testing.assert_allclose(out["y"], np.arange(n) * 3.0)
+
+
+def test_dnn_model_single_io_convenience():
+    fn = lambda params, inputs: inputs["input"] + 1.0
+    model = (
+        DNNModel(applyFn=fn, modelParams={}, batchSize=4)
+        .setInputCol("x")
+        .setOutputCol("y")
+    )
+    t = Table({"x": np.arange(6, dtype=np.float32)})
+    out = model.transform(t)
+    np.testing.assert_allclose(out["y"], np.arange(6) + 1.0)
+    assert model.getInputCol() == "x" and model.getOutputCol() == "y"
+
+
+def test_dnn_model_missing_feed():
+    model = DNNModel(applyFn=lambda p, i: i, modelParams={})
+    with pytest.raises(ValueError):
+        model.transform(Table({"x": np.arange(3.0)}))
+
+
+def test_resnet_shapes_and_cut():
+    import jax
+
+    params = init_resnet(variant="resnet18", num_classes=7, small_inputs=True)
+    x = np.random.default_rng(0).standard_normal((2, 3, 32, 32)).astype(np.float32)
+    logits = jax.jit(lambda p, v: resnet_apply(p, v))(params, x)
+    assert logits.shape == (2, 7)
+    feats = resnet_apply(params, x, cut=1)
+    assert feats.shape == (2, 512)
+    fmap = resnet_apply(params, x, cut=2)
+    assert fmap.shape == (2, 512, 4, 4)
+
+
+def test_resnet50_bottleneck():
+    params = init_resnet(variant="resnet50", num_classes=3, small_inputs=True)
+    x = np.zeros((1, 3, 32, 32), np.float32)
+    feats = resnet_apply(params, x, cut=1)
+    assert feats.shape == (1, 2048)
+
+
+def test_resnet_in_dnn_model():
+    params = init_resnet(variant="resnet18", num_classes=4, small_inputs=True)
+    fn = lambda p, inputs: {"output": resnet_apply(p, inputs["input"])}
+    images = np.random.default_rng(3).standard_normal((5, 3, 32, 32)).astype(np.float32)
+    t = Table({"images": [im for im in images]})
+    model = DNNModel(
+        applyFn=fn,
+        modelParams=params,
+        feedDict={"input": "images"},
+        fetchDict={"scores": "output"},
+        batchSize=4,
+    )
+    out = model.transform(t)
+    assert out["scores"].shape == (5, 4)
+    assert np.isfinite(out["scores"]).all()
+
+
+def test_onnx_gate():
+    from mmlspark_tpu.dnn import onnx_import
+
+    if not onnx_import.onnx_available():
+        with pytest.raises(ImportError):
+            onnx_import.from_onnx("/tmp/nope.onnx")

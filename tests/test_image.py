@@ -1,0 +1,170 @@
+"""Image pipeline (reference ``opencv/``/``image/`` suites — SURVEY.md §2.5)."""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.data.table import Table
+from mmlspark_tpu.image import (
+    ImageFeaturizer,
+    ImageSetAugmenter,
+    ImageTransformer,
+    UnrollImage,
+    roll_image,
+    unroll_image,
+)
+
+
+@pytest.fixture()
+def image_table(rng):
+    images = np.empty(3, dtype=object)
+    for i in range(3):
+        images[i] = rng.integers(0, 256, size=(20, 24, 3), dtype=np.uint8)
+    return Table({"id": np.arange(3), "image": images})
+
+
+def test_resize_crop(image_table):
+    t = (
+        ImageTransformer(inputCol="image", outputCol="out")
+        .resize(10, 12)
+        .crop(2, 1, 8, 8)
+        .transform(image_table)
+    )
+    assert t["out"][0].shape == (8, 8, 3)
+    assert t["out"][0].dtype == np.uint8
+
+
+def test_flip_matches_numpy(image_table):
+    out = (
+        ImageTransformer(inputCol="image", outputCol="out")
+        .flip(1)
+        .transform(image_table)
+    )
+    np.testing.assert_array_equal(out["out"][0], image_table["image"][0][:, ::-1, :])
+    out = (
+        ImageTransformer(inputCol="image", outputCol="out")
+        .flip(0)
+        .transform(image_table)
+    )
+    np.testing.assert_array_equal(out["out"][0], image_table["image"][0][::-1, :, :])
+
+
+def test_gray_threshold(image_table):
+    out = (
+        ImageTransformer(inputCol="image", outputCol="out")
+        .color_format("gray")
+        .threshold(127.0)
+        .transform(image_table)
+    )
+    img = out["out"][0]
+    assert img.shape == (20, 24, 1)
+    assert set(np.unique(img)) <= {0, 255}
+
+
+def test_blur_constant_image():
+    images = np.empty(1, dtype=object)
+    images[0] = np.full((8, 8, 3), 100, dtype=np.uint8)
+    t = Table({"image": images})
+    out = (
+        ImageTransformer(inputCol="image", outputCol="out")
+        .blur(3, 3)
+        .transform(t)
+    )
+    # Box blur of a constant image keeps the interior constant.
+    np.testing.assert_array_equal(out["out"][0][2:-2, 2:-2], 100)
+
+
+def test_gaussian_kernel_smooths(rng):
+    images = np.empty(1, dtype=object)
+    img = np.zeros((9, 9, 1), dtype=np.uint8)
+    img[4, 4, 0] = 255
+    images[0] = img
+    t = Table({"image": images})
+    out = (
+        ImageTransformer(inputCol="image", outputCol="out", toFloat=True)
+        .gaussian_kernel(5, 1.0)
+        .transform(t)
+    )
+    res = out["out"][0][..., 0]
+    assert res[4, 4] == res.max() and res[4, 4] < 255
+    assert res[2, 4] > 0
+
+
+def test_mixed_shapes_grouped(rng):
+    images = np.empty(4, dtype=object)
+    images[0] = rng.integers(0, 255, (16, 16, 3), dtype=np.uint8)
+    images[1] = rng.integers(0, 255, (20, 10, 3), dtype=np.uint8)
+    images[2] = rng.integers(0, 255, (16, 16, 3), dtype=np.uint8)
+    images[3] = rng.integers(0, 255, (20, 10, 3), dtype=np.uint8)
+    t = Table({"image": images})
+    out = ImageTransformer(inputCol="image", outputCol="out").resize(8, 8).transform(t)
+    assert all(im.shape == (8, 8, 3) for im in out["out"])
+
+
+def test_augmenter(image_table):
+    out = ImageSetAugmenter(inputCol="image", outputCol="image").transform(image_table)
+    assert out.num_rows == 6
+    np.testing.assert_array_equal(out["image"][3], image_table["image"][0][:, ::-1, :])
+
+
+def test_unroll_roll_roundtrip(image_table):
+    out = UnrollImage(inputCol="image", outputCol="vec").transform(image_table)
+    vec = out["vec"]
+    assert vec.shape == (3, 20 * 24 * 3)
+    rolled = roll_image(vec[0], 20, 24, 3)
+    np.testing.assert_array_equal(rolled, image_table["image"][0].astype(np.float64))
+    # Single-image helper agrees with the column path.
+    np.testing.assert_array_equal(unroll_image(image_table["image"][0]), vec[0])
+
+
+def test_image_featurizer(image_table):
+    from mmlspark_tpu.models import init_resnet
+
+    params = init_resnet(variant="resnet18", num_classes=6, small_inputs=True)
+    feat = ImageFeaturizer(
+        inputCol="image",
+        outputCol="features",
+        modelParams=params,
+        inputHeight=32,
+        inputWidth=32,
+        batchSize=4,
+    )
+    out = feat.transform(image_table)
+    assert out["features"].shape == (3, 512)
+    assert np.isfinite(out["features"]).all()
+    # Headful: cut=0 emits class scores.
+    logits = feat.copy({"cutOutputLayers": 0}).transform(image_table)
+    assert logits["features"].shape == (3, 6)
+
+
+def test_read_images(tmp_path, rng):
+    from PIL import Image
+
+    from mmlspark_tpu.io import read_binary_files, read_images
+
+    for i in range(3):
+        arr = rng.integers(0, 255, (10, 12, 3), dtype=np.uint8)
+        Image.fromarray(arr).save(tmp_path / f"img_{i}.png")
+    (tmp_path / "notes.txt").write_text("not an image")
+
+    files = read_binary_files(str(tmp_path))
+    assert files.num_rows == 4
+    imgs = read_images(str(tmp_path), pattern="*.png")
+    assert imgs.num_rows == 3
+    assert imgs["image"][0].shape == (10, 12, 3)
+    # Undecodable files are dropped (reference emits null images).
+    all_files = read_images(str(tmp_path))
+    assert all_files.num_rows == 3
+
+
+def test_read_zip(tmp_path):
+    import zipfile
+
+    with zipfile.ZipFile(tmp_path / "archive.zip", "w") as zf:
+        zf.writestr("a.txt", "alpha")
+        zf.writestr("sub/b.txt", "beta")
+    from mmlspark_tpu.io import read_binary_files
+
+    t = read_binary_files(str(tmp_path))
+    assert t.num_rows == 2
+    assert any(p.endswith("!a.txt") for p in t["path"])
+    assert b"beta" in list(t["bytes"])

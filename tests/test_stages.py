@@ -71,6 +71,28 @@ def test_stratified_repartition():
     assert counts[0] == counts[1] == 8
 
 
+def test_stratified_repartition_uneven_split():
+    # Row count not divisible by partitions: coverage must still hold
+    # (regression: round-robin dealing misaligned with linspace bounds).
+    t = Table(
+        {"label": np.array(["a", "a", "a", "b", "b"], dtype=object), "x": np.arange(5)},
+        num_partitions=2,
+    )
+    out = StratifiedRepartition(labelCol="label", mode="original").transform(t)
+    for part in out.partitions():
+        assert set(part["label"]) == {"a", "b"}
+    assert sorted(out["x"]) == list(range(5))
+
+
+def test_text_preprocessor_length_changing_fold():
+    # 'İ'.lower() is two chars; offsets must not shift (regression).
+    t = Table({"text": np.array(["İstanbul is big"], dtype=object)})
+    out = TextPreprocessor(
+        inputCol="text", outputCol="out", map={"big": "huge"}, normFunc="lowerCase"
+    ).transform(t)
+    assert list(out["out"]) == ["İstanbul is huge"]
+
+
 def test_class_balancer():
     t = Table({"label": np.array([0, 0, 0, 1])})
     model = ClassBalancer(inputCol="label").fit(t)
