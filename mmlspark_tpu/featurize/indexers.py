@@ -1,0 +1,98 @@
+"""Categorical value indexing (reference ``featurize/ValueIndexer.scala:55``,
+``ValueIndexerModel:102``, ``IndexToValue.scala:27``; categorical metadata
+idiom from ``core/schema/Categoricals.scala``).
+
+Levels are recorded in column metadata (``{"categorical": True, "levels":
+[...]}``) — the Table analogue of MML-style categorical metadata — so
+downstream one-hot assembly and ``IndexToValue`` need no side channel.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import numpy as np
+
+from mmlspark_tpu.core.params import HasInputCol, HasOutputCol, Param, to_bool
+from mmlspark_tpu.core.pipeline import Estimator, Model, Transformer
+from mmlspark_tpu.data.table import Table
+
+
+class ValueIndexer(HasInputCol, HasOutputCol, Estimator):
+    """Distinct values -> dense indices [0, n); unseen values map to n
+    (an explicit 'unknown' bucket) at transform time."""
+
+    def _fit(self, table: Table) -> "ValueIndexerModel":
+        col = table.column(self.getInputCol())
+        if col.dtype == object:
+            levels = sorted({str(v) for v in col if v is not None})
+        else:
+            valid = col[~_isnan(col)]
+            levels = [v.item() for v in np.unique(valid)]
+        model = ValueIndexerModel(
+            inputCol=self.getInputCol(),
+            outputCol=self.getOutputCol(),
+            levels=levels,
+            dataType="string" if col.dtype == object else str(col.dtype),
+        )
+        model.parent = self
+        return model
+
+
+def _isnan(col: np.ndarray) -> np.ndarray:
+    if np.issubdtype(col.dtype, np.floating):
+        return np.isnan(col)
+    return np.zeros(len(col), dtype=bool)
+
+
+class ValueIndexerModel(HasInputCol, HasOutputCol, Model):
+    levels = Param("Ordered distinct values", default=[])
+    dataType = Param("Original value dtype", default="string")
+
+    def transform(self, table: Table) -> Table:
+        col = table.column(self.getInputCol())
+        levels = self.getLevels()
+        lookup: Dict[Any, int] = {v: i for i, v in enumerate(levels)}
+        unknown = len(levels)
+        if col.dtype == object:
+            out = np.array(
+                [lookup.get(str(v), unknown) if v is not None else unknown for v in col],
+                dtype=np.int64,
+            )
+        else:
+            out = np.array([lookup.get(v.item(), unknown) for v in col], dtype=np.int64)
+        return table.with_column(
+            self.getOutputCol(),
+            out,
+            metadata={"categorical": True, "levels": list(levels)},
+        )
+
+
+def decode_levels(indices: np.ndarray, levels: List[Any]) -> np.ndarray:
+    """Indices -> original level values; the unknown bucket decodes to None
+    (string levels) or NaN (numeric levels). Shared by IndexToValue and
+    TrainedClassifierModel."""
+    idx = np.asarray(indices).astype(np.int64)
+    in_range = (idx >= 0) & (idx < len(levels))
+    if levels and not isinstance(levels[0], str):
+        values = np.asarray(levels, dtype=np.float64)
+        out = np.where(in_range, values[np.clip(idx, 0, len(levels) - 1)], np.nan)
+        return out
+    out = np.empty(len(idx), dtype=object)
+    for i, (ok, j) in enumerate(zip(in_range, idx)):
+        out[i] = levels[j] if ok else None
+    return out
+
+
+class IndexToValue(HasInputCol, HasOutputCol, Transformer):
+    """Inverse of ValueIndexer: index column + categorical metadata -> values
+    (``featurize/IndexToValue.scala:27``)."""
+
+    def transform(self, table: Table) -> Table:
+        meta = table.metadata(self.getInputCol())
+        if not meta.get("categorical") or "levels" not in meta:
+            raise ValueError(
+                f"column {self.getInputCol()!r} has no categorical levels metadata"
+            )
+        out = decode_levels(table.column(self.getInputCol()), meta["levels"])
+        return table.with_column(self.getOutputCol(), out)

@@ -1,0 +1,211 @@
+"""Text featurization (reference ``featurize/text/`` — SURVEY.md §2.10).
+
+``TextFeaturizer`` composes tokenize → n-grams → hashingTF → IDF exactly like
+``featurize/text/TextFeaturizer.scala:181``'s internal pipeline; hashing is
+the framework's vectorized murmur3 (:mod:`mmlspark_tpu.ops.hashing`) and the
+TF/IDF aggregation is columnar scatter-adds — per-document Python loops only
+materialize token lists, everything numeric is whole-column numpy.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from mmlspark_tpu.core.params import (
+    HasInputCol,
+    HasOutputCol,
+    Param,
+    ge,
+    gt,
+    to_bool,
+    to_int,
+    to_str,
+)
+from mmlspark_tpu.core.pipeline import Estimator, Model, Transformer
+from mmlspark_tpu.data.table import Table
+from mmlspark_tpu.ops.hashing import mask_bits, murmur32_strings
+
+
+def _tokenize(text: str, pattern: str, to_lower: bool, min_len: int) -> List[str]:
+    if to_lower:
+        text = text.lower()
+    tokens = re.split(pattern, text)
+    return [t for t in tokens if len(t) >= min_len]
+
+
+def _ngrams(tokens: List[str], n: int) -> List[str]:
+    if n <= 1:
+        return list(tokens)
+    return [" ".join(tokens[i : i + n]) for i in range(len(tokens) - n + 1)]
+
+
+def hashing_tf(
+    docs: List[List[str]], num_features: int, binary: bool = False
+) -> np.ndarray:
+    """Token lists -> [n_docs, num_features] term-frequency matrix via
+    murmur3 bucket hashing (HashingTF role in the reference pipeline)."""
+    num_bits = int(np.log2(num_features))
+    if 2**num_bits != num_features:
+        raise ValueError(f"numFeatures must be a power of two, got {num_features}")
+    out = np.zeros((len(docs), num_features), dtype=np.float32)
+    for i, tokens in enumerate(docs):
+        if not tokens:
+            continue
+        idx = mask_bits(murmur32_strings(tokens), num_bits)
+        np.add.at(out[i], idx, 1.0)
+    if binary:
+        out = (out > 0).astype(np.float32)
+    return out
+
+
+class PageSplitter(HasInputCol, HasOutputCol, Transformer):
+    """Split documents into pages within [minimum, maximum] character budget,
+    preferring boundaries (``featurize/text/PageSplitter.scala:20``).
+    Output is a ragged column of page-string lists."""
+
+    maximumPageLength = Param(
+        "Max characters per page", default=5000, converter=to_int, validator=gt(0)
+    )
+    minimumPageLength = Param(
+        "Min characters before a soft boundary split",
+        default=4500,
+        converter=to_int,
+        validator=gt(0),
+    )
+    boundaryRegex = Param("Soft boundary", default=r"\s", converter=to_str)
+
+    def transform(self, table: Table) -> Table:
+        col = table.column(self.getInputCol())
+        max_len = self.getMaximumPageLength()
+        min_len = self.getMinimumPageLength()
+        boundary = re.compile(self.getBoundaryRegex())
+        out = np.empty(len(col), dtype=object)
+        for i, doc in enumerate(col):
+            text = "" if doc is None else str(doc)
+            pages: List[str] = []
+            pos = 0
+            while pos < len(text):
+                window = text[pos : pos + max_len]
+                if len(window) < max_len:
+                    pages.append(window)
+                    break
+                # Prefer the last soft boundary in [min_len, max_len).
+                cut = max_len
+                for m in boundary.finditer(window, min_len):
+                    cut = m.start() + 1
+                pages.append(window[:cut])
+                pos += cut
+            out[i] = pages
+        return table.with_column(self.getOutputCol(), out)
+
+
+class MultiNGram(HasInputCol, HasOutputCol, Transformer):
+    """All n-grams for several lengths at once
+    (``featurize/text/MultiNGram.scala:24``). Input: token-list column."""
+
+    lengths = Param("N-gram lengths", default=[1, 2, 3])
+
+    def transform(self, table: Table) -> Table:
+        col = table.column(self.getInputCol())
+        lengths = [int(n) for n in self.getLengths()]
+        out = np.empty(len(col), dtype=object)
+        for i, tokens in enumerate(col):
+            tokens = list(tokens)
+            grams: List[str] = []
+            for n in lengths:
+                grams.extend(_ngrams(tokens, n))
+            out[i] = grams
+        return table.with_column(self.getOutputCol(), out)
+
+
+class TextFeaturizer(HasInputCol, HasOutputCol, Estimator):
+    """tokenize -> n-grams -> hashingTF -> IDF, one estimator
+    (``featurize/text/TextFeaturizer.scala:181``)."""
+
+    useTokenizer = Param("Tokenize the input", default=True, converter=to_bool)
+    tokenizerPattern = Param("Token split regex", default=r"\s+", converter=to_str)
+    toLowercase = Param("Lowercase before tokenizing", default=True, converter=to_bool)
+    minTokenLength = Param("Drop shorter tokens", default=0, converter=to_int)
+    useNGram = Param("Add n-grams", default=False, converter=to_bool)
+    nGramLength = Param("N-gram length", default=2, converter=to_int, validator=gt(0))
+    numFeatures = Param(
+        "Hash space size (power of two). TF blocks are dense 2-D columns, so "
+        "memory is n_docs x numFeatures x 4 bytes — size accordingly",
+        default=1 << 12,
+        converter=to_int,
+        validator=gt(0),
+    )
+    binary = Param("Binary term frequencies", default=False, converter=to_bool)
+    useIDF = Param("Rescale by inverse document frequency", default=True, converter=to_bool)
+    minDocFreq = Param("Min documents for IDF terms", default=0, converter=to_int)
+
+    def _docs(self, col: np.ndarray) -> List[List[str]]:
+        docs: List[List[str]] = []
+        for v in col:
+            if isinstance(v, (list, np.ndarray)):
+                tokens = [str(t) for t in v]
+            elif self.getUseTokenizer():
+                tokens = _tokenize(
+                    "" if v is None else str(v),
+                    self.getTokenizerPattern(),
+                    self.getToLowercase(),
+                    self.getMinTokenLength(),
+                )
+            else:
+                tokens = [] if v is None else [str(v)]
+            if self.getUseNGram():
+                tokens = tokens + _ngrams(tokens, self.getNGramLength())
+            docs.append(tokens)
+        return docs
+
+    def _fit(self, table: Table) -> "TextFeaturizerModel":
+        docs = self._docs(table.column(self.getInputCol()))
+        tf = hashing_tf(docs, self.getNumFeatures(), self.getBinary())
+        idf = None
+        if self.getUseIDF():
+            n_docs = len(docs)
+            df = (tf > 0).sum(axis=0).astype(np.float64)
+            if self.getMinDocFreq() > 0:
+                df = np.where(df >= self.getMinDocFreq(), df, 0.0)
+            # Spark's IDF formula: log((m + 1) / (df + 1)).
+            idf = np.log((n_docs + 1.0) / (df + 1.0)) * (df > 0)
+        model = TextFeaturizerModel(
+            inputCol=self.getInputCol(),
+            outputCol=self.getOutputCol(),
+            useTokenizer=self.getUseTokenizer(),
+            tokenizerPattern=self.getTokenizerPattern(),
+            toLowercase=self.getToLowercase(),
+            minTokenLength=self.getMinTokenLength(),
+            useNGram=self.getUseNGram(),
+            nGramLength=self.getNGramLength(),
+            numFeatures=self.getNumFeatures(),
+            binary=self.getBinary(),
+            idfVector=idf,
+        )
+        model.parent = self
+        return model
+
+
+class TextFeaturizerModel(HasInputCol, HasOutputCol, Model):
+    useTokenizer = Param("Tokenize the input", default=True, converter=to_bool)
+    tokenizerPattern = Param("Token split regex", default=r"\s+", converter=to_str)
+    toLowercase = Param("Lowercase before tokenizing", default=True, converter=to_bool)
+    minTokenLength = Param("Drop shorter tokens", default=0, converter=to_int)
+    useNGram = Param("Add n-grams", default=False, converter=to_bool)
+    nGramLength = Param("N-gram length", default=2, converter=to_int)
+    numFeatures = Param("Hash space size", default=1 << 12, converter=to_int)
+    binary = Param("Binary term frequencies", default=False, converter=to_bool)
+    idfVector = Param("IDF weights (None = raw TF)", default=None, is_complex=True)
+
+    _docs = TextFeaturizer._docs
+
+    def transform(self, table: Table) -> Table:
+        docs = self._docs(table.column(self.getInputCol()))
+        tf = hashing_tf(docs, self.getNumFeatures(), self.getBinary())
+        idf = self.getIdfVector()
+        if idf is not None:
+            tf = tf * np.asarray(idf, dtype=np.float32)
+        return table.with_column(self.getOutputCol(), tf)

@@ -1,0 +1,21 @@
+"""Simplified train/eval API (reference ``train/`` — SURVEY.md §2.12)."""
+
+from mmlspark_tpu.train.statistics import (
+    ComputeModelStatistics,
+    ComputePerInstanceStatistics,
+)
+from mmlspark_tpu.train.trainers import (
+    TrainClassifier,
+    TrainRegressor,
+    TrainedClassifierModel,
+    TrainedRegressorModel,
+)
+
+__all__ = [
+    "ComputeModelStatistics",
+    "ComputePerInstanceStatistics",
+    "TrainClassifier",
+    "TrainRegressor",
+    "TrainedClassifierModel",
+    "TrainedRegressorModel",
+]

@@ -1,0 +1,176 @@
+"""Model evaluation metrics as transformers
+(reference ``train/ComputeModelStatistics.scala:56``,
+``ComputePerInstanceStatistics.scala:42``).
+
+Classification: accuracy, per-class/micro precision & recall, AUC (rank
+statistic), confusion matrix. Regression: mse, rmse, r², mae. All computed
+as whole-column numpy reductions — one pass, no per-row UDFs.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from mmlspark_tpu.core.params import HasLabelCol, Param, one_of, to_str
+from mmlspark_tpu.core.pipeline import Transformer
+from mmlspark_tpu.data.table import Table
+
+
+def binary_auc(labels: np.ndarray, scores: np.ndarray) -> float:
+    """AUC via the Mann-Whitney rank statistic, fully vectorized
+    (tied scores get their group's average rank)."""
+    labels = np.asarray(labels, dtype=np.float64)
+    scores = np.asarray(scores, dtype=np.float64)
+    n_pos = float((labels == 1).sum())
+    n_neg = float((labels == 0).sum())
+    if n_pos == 0 or n_neg == 0:
+        return float("nan")
+    order = np.argsort(scores, kind="stable")
+    sorted_scores = scores[order]
+    boundary = np.concatenate([[True], sorted_scores[1:] != sorted_scores[:-1]])
+    group = np.cumsum(boundary) - 1
+    counts = np.bincount(group)
+    starts = np.concatenate([[0], np.cumsum(counts)[:-1]])
+    avg_rank = starts + (counts + 1) / 2.0  # 1-based average rank per group
+    ranks = np.empty(len(scores), dtype=np.float64)
+    ranks[order] = avg_rank[group]
+    rank_sum = ranks[labels == 1].sum()
+    return float((rank_sum - n_pos * (n_pos + 1) / 2) / (n_pos * n_neg))
+
+
+def remap_classes(labels: np.ndarray, pred: np.ndarray):
+    """Map label/prediction columns (numeric or string) onto dense class ids
+    [0, k) ordered by sorted distinct value — the convention both metric
+    stages share, so 1-D probability columns always mean P(highest class)."""
+    if labels.dtype == object or pred.dtype == object:
+        l_str = np.array([str(v) for v in labels])
+        p_str = np.array([str(v) for v in pred])
+        classes = np.unique(np.concatenate([l_str, p_str]))
+        lookup = {c: i for i, c in enumerate(classes)}
+        li = np.array([lookup[v] for v in l_str], dtype=np.int64)
+        pi = np.array([lookup[v] for v in p_str], dtype=np.int64)
+    else:
+        l_num = labels.astype(np.float64)
+        p_num = pred.astype(np.float64)
+        classes = np.unique(np.concatenate([l_num, p_num]))
+        lookup = {c: i for i, c in enumerate(classes)}
+        li = np.array([lookup[v] for v in l_num], dtype=np.int64)
+        pi = np.array([lookup[v] for v in p_num], dtype=np.int64)
+    return li, pi, classes
+
+
+class ComputeModelStatistics(HasLabelCol, Transformer):
+    """Scored table -> one-row metrics table."""
+
+    scoresCol = Param("Prediction column", default="prediction", converter=to_str)
+    scoredProbabilitiesCol = Param(
+        "Probability column (binary AUC)", default="probability", converter=to_str
+    )
+    evaluationMetric = Param(
+        "classification | regression | auto",
+        default="auto",
+        converter=to_str,
+        validator=one_of("classification", "regression", "auto"),
+    )
+
+    def _kind(self, table: Table) -> str:
+        metric = self.getEvaluationMetric()
+        if metric != "auto":
+            return metric
+        labels = table.column(self.getLabelCol())
+        if labels.dtype == object:
+            return "classification"
+        labels = labels.astype(np.float64)
+        uniq = np.unique(labels[~np.isnan(labels)])
+        if len(uniq) <= max(20, int(np.sqrt(len(labels)))) and np.allclose(
+            uniq, np.rint(uniq)
+        ):
+            return "classification"
+        return "regression"
+
+    def transform(self, table: Table) -> Table:
+        labels = table.column(self.getLabelCol())
+        pred = table.column(self.getScoresCol())
+        if self._kind(table) == "classification":
+            li, pi, classes = remap_classes(labels, pred)
+            k = len(classes)
+            confusion = np.zeros((k, k), dtype=np.int64)
+            np.add.at(confusion, (li, pi), 1)
+            accuracy = float((li == pi).mean())
+            tp = np.diag(confusion).astype(np.float64)
+            col_sums = confusion.sum(axis=0).astype(np.float64)
+            row_sums = confusion.sum(axis=1).astype(np.float64)
+            with np.errstate(invalid="ignore", divide="ignore"):
+                precision = np.where(col_sums > 0, tp / col_sums, 0.0)
+                recall = np.where(row_sums > 0, tp / row_sums, 0.0)
+            weights = row_sums / row_sums.sum()
+            metrics: Dict[str, float] = {
+                "accuracy": accuracy,
+                "precision": float((precision * weights).sum()),
+                "recall": float((recall * weights).sum()),
+            }
+            if k == 2 and self.getScoredProbabilitiesCol() in table:
+                probs = table.column(self.getScoredProbabilitiesCol())
+                scores = probs[:, -1] if probs.ndim == 2 else probs.astype(np.float64)
+                metrics["AUC"] = binary_auc(li, scores)
+            out = Table({name: np.array([value]) for name, value in metrics.items()})
+            return out.with_column(
+                "confusion_matrix", confusion.reshape(1, k * k).astype(np.float64)
+            )
+        labels = labels.astype(np.float64)
+        pred = pred.astype(np.float64)
+        err = pred - labels
+        mse = float((err**2).mean())
+        denom = float(((labels - labels.mean()) ** 2).sum())
+        metrics = {
+            "mean_squared_error": mse,
+            "root_mean_squared_error": float(np.sqrt(mse)),
+            "mean_absolute_error": float(np.abs(err).mean()),
+            "R^2": float(1.0 - (err**2).sum() / denom) if denom > 0 else float("nan"),
+        }
+        return Table({name: np.array([value]) for name, value in metrics.items()})
+
+
+class ComputePerInstanceStatistics(HasLabelCol, Transformer):
+    """Appends per-row metrics (``ComputePerInstanceStatistics.scala:42``):
+    regression -> L1/L2 loss; classification -> log-loss + correctness."""
+
+    scoresCol = Param("Prediction column", default="prediction", converter=to_str)
+    scoredProbabilitiesCol = Param(
+        "Probability column", default="probability", converter=to_str
+    )
+    evaluationMetric = Param(
+        "classification | regression | auto",
+        default="auto",
+        converter=to_str,
+        validator=one_of("classification", "regression", "auto"),
+    )
+
+    _kind = ComputeModelStatistics._kind
+
+    def transform(self, table: Table) -> Table:
+        labels = table.column(self.getLabelCol())
+        pred = table.column(self.getScoresCol())
+        if self._kind(table) == "regression":
+            err = pred.astype(np.float64) - labels.astype(np.float64)
+            return table.with_columns(
+                {"L1_loss": np.abs(err), "L2_loss": err**2}
+            )
+        # Same dense-id remap as ComputeModelStatistics: a 1-D probability
+        # column means P(highest class) regardless of raw label coding.
+        li, pi, _ = remap_classes(labels, pred)
+        out = table.with_column("correct", (li == pi).astype(np.float64))
+        if self.getScoredProbabilitiesCol() in table:
+            probs = table.column(self.getScoredProbabilitiesCol())
+            if probs.ndim == 2:
+                idx = np.clip(li, 0, probs.shape[1] - 1)
+                p_true = probs[np.arange(len(li)), idx]
+            else:
+                p = probs.astype(np.float64)
+                p_true = np.where(li == 1, p, 1.0 - p)
+            out = out.with_column(
+                "log_loss", -np.log(np.clip(p_true, 1e-15, 1.0))
+            )
+        return out

@@ -1,0 +1,153 @@
+"""TrainClassifier / TrainRegressor — auto-featurize + fit any learner.
+
+Re-design of ``train/TrainClassifier.scala:53`` / ``train/TrainRegressor.scala:24``:
+wraps any Estimator, auto-featurizes non-vector inputs via
+:class:`~mmlspark_tpu.featurize.Featurize`, reindexes string labels, and
+returns a model carrying the featurization chain
+(``TrainedClassifierModel:276`` keeps the pipeline the same way).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from mmlspark_tpu.core.params import (
+    HasFeaturesCol,
+    HasLabelCol,
+    Param,
+    gt,
+    to_bool,
+    to_int,
+    to_str,
+)
+from mmlspark_tpu.core.pipeline import Estimator, Model, Transformer
+from mmlspark_tpu.data.table import Table
+from mmlspark_tpu.featurize.featurize import Featurize
+
+
+class _TrainBase(HasLabelCol, HasFeaturesCol, Estimator):
+    model = Param("The learner to fit", is_complex=True)
+    featuresCol = Param(
+        "Assembled features column", default="TrainedFeatures", converter=to_str
+    )
+    numFeatures = Param(
+        "Text hash dimensions during featurization",
+        default=1 << 8,
+        converter=to_int,
+        validator=gt(0),
+    )
+
+    def _feature_columns(self, table: Table) -> List[str]:
+        label = self.getLabelCol()
+        return [c for c in table.columns if c != label]
+
+    def _prepare(self, table: Table):
+        cols = self._feature_columns(table)
+        featurizer = None
+        feat_col = self.getFeaturesCol()
+        if len(cols) == 1 and table.column(cols[0]).ndim == 2:
+            # Already a single assembled vector column.
+            feat_col = cols[0]
+        else:
+            featurizer = Featurize(
+                inputCols=cols,
+                outputCol=feat_col,
+                numberOfFeatures=self.getNumFeatures(),
+            ).fit(table)
+            table = featurizer.transform(table)
+        return table, featurizer, feat_col
+
+
+class TrainClassifier(_TrainBase):
+    """Featurize + reindex labels + fit a classifier."""
+
+    reindexLabel = Param("Index string/sparse labels", default=True, converter=to_bool)
+
+    def _fit(self, table: Table) -> "TrainedClassifierModel":
+        work, featurizer, feat_col = self._prepare(table)
+        label_col = self.getLabelCol()
+        labels_raw = work.column(label_col)
+        levels: Optional[List[Any]] = None
+        if self.getReindexLabel():
+            if labels_raw.dtype == object:
+                levels = sorted({str(v) for v in labels_raw})
+                lookup = {v: i for i, v in enumerate(levels)}
+                y = np.array([lookup[str(v)] for v in labels_raw], dtype=np.float64)
+            else:
+                uniq = np.unique(labels_raw)
+                if not np.array_equal(uniq, np.arange(len(uniq))):
+                    levels = [v.item() for v in uniq]
+                    lookup = {v: i for i, v in enumerate(levels)}
+                    y = np.array(
+                        [lookup[v.item()] for v in labels_raw], dtype=np.float64
+                    )
+                else:
+                    y = labels_raw.astype(np.float64)
+            work = work.with_column(label_col, y)
+        learner = self.getModel().copy(
+            {"featuresCol": feat_col, "labelCol": label_col}
+        )
+        fitted = learner.fit(work)
+        model = TrainedClassifierModel(
+            fittedModel=fitted,
+            featurizerModel=featurizer,
+            labelCol=label_col,
+            featuresCol=feat_col,
+            labelLevels=levels,
+        )
+        model.parent = self
+        return model
+
+
+class TrainRegressor(_TrainBase):
+    def _fit(self, table: Table) -> "TrainedRegressorModel":
+        work, featurizer, feat_col = self._prepare(table)
+        label_col = self.getLabelCol()
+        work = work.with_column(label_col, work.column(label_col).astype(np.float64))
+        learner = self.getModel().copy(
+            {"featuresCol": feat_col, "labelCol": label_col}
+        )
+        fitted = learner.fit(work)
+        model = TrainedRegressorModel(
+            fittedModel=fitted,
+            featurizerModel=featurizer,
+            labelCol=label_col,
+            featuresCol=feat_col,
+        )
+        model.parent = self
+        return model
+
+
+class _TrainedBase(HasLabelCol, HasFeaturesCol, Model):
+    fittedModel = Param("The fitted learner", is_complex=True)
+    featurizerModel = Param("The fitted featurizer (None = passthrough)",
+                            default=None, is_complex=True)
+
+    def _featurize(self, table: Table) -> Table:
+        featurizer = self.getFeaturizerModel()
+        if featurizer is not None:
+            table = featurizer.transform(table)
+        return table
+
+
+class TrainedClassifierModel(_TrainedBase):
+    labelLevels = Param("Original label values (None = already indexed)",
+                        default=None)
+
+    def transform(self, table: Table) -> Table:
+        out = self.getFittedModel().transform(self._featurize(table))
+        levels = self.getLabelLevels()
+        if levels is not None and "prediction" in out:
+            from mmlspark_tpu.featurize.indexers import decode_levels
+
+            out = out.with_column(
+                "prediction", decode_levels(out.column("prediction"), levels)
+            )
+        return out
+
+
+class TrainedRegressorModel(_TrainedBase):
+    def transform(self, table: Table) -> Table:
+        return self.getFittedModel().transform(self._featurize(table))

@@ -1,0 +1,191 @@
+"""Featurization (reference ``featurize/`` suites — SURVEY.md §2.10)."""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.data.table import Table
+from mmlspark_tpu.featurize import (
+    AssembleFeatures,
+    CleanMissingData,
+    DataConversion,
+    Featurize,
+    IndexToValue,
+    MultiNGram,
+    PageSplitter,
+    TextFeaturizer,
+    ValueIndexer,
+)
+
+
+def test_value_indexer_roundtrip():
+    t = Table({"cat": np.array(["b", "a", "b", "c"], dtype=object)})
+    model = ValueIndexer(inputCol="cat", outputCol="idx").fit(t)
+    out = model.transform(t)
+    assert list(out["idx"]) == [1, 0, 1, 2]
+    assert out.metadata("idx")["categorical"]
+    back = IndexToValue(inputCol="idx", outputCol="orig").transform(out)
+    assert list(back["orig"]) == ["b", "a", "b", "c"]
+    # Unseen value -> unknown bucket -> None on inverse.
+    t2 = Table({"cat": np.array(["a", "zzz"], dtype=object)})
+    out2 = model.transform(t2)
+    assert list(out2["idx"]) == [0, 3]
+    assert IndexToValue(inputCol="idx", outputCol="v").transform(out2)["v"][1] is None
+
+
+def test_value_indexer_numeric():
+    t = Table({"x": np.array([10, 5, 10, 7])})
+    model = ValueIndexer(inputCol="x", outputCol="idx").fit(t)
+    assert list(model.transform(t)["idx"]) == [2, 0, 2, 1]
+
+
+def test_clean_missing_data():
+    t = Table(
+        {
+            "a": np.array([1.0, np.nan, 3.0]),
+            "b": np.array([np.nan, 4.0, 8.0]),
+        }
+    )
+    model = CleanMissingData(inputCols=["a", "b"], cleaningMode="Mean").fit(t)
+    out = model.transform(t)
+    np.testing.assert_allclose(out["a"], [1.0, 2.0, 3.0])
+    np.testing.assert_allclose(out["b"], [6.0, 4.0, 8.0])
+    model = CleanMissingData(
+        inputCols=["a"], cleaningMode="Custom", customValue=-1
+    ).fit(t)
+    np.testing.assert_allclose(model.transform(t)["a"], [1.0, -1.0, 3.0])
+    model = CleanMissingData(inputCols=["a"], cleaningMode="Median").fit(t)
+    np.testing.assert_allclose(model.transform(t)["a"], [1.0, 2.0, 3.0])
+
+
+def test_data_conversion():
+    t = Table({"x": np.array(["1", "2"], dtype=object), "y": np.array([1.5, 2.5])})
+    out = DataConversion(inputCols=["x"], convertTo="double").transform(t)
+    assert out["x"].dtype == np.float64
+    out = DataConversion(inputCols=["y"], convertTo="string").transform(t)
+    assert out["y"].dtype == object and out["y"][0] == "1.5"
+    out = DataConversion(inputCols=["x"], convertTo="toCategorical").transform(t)
+    assert out.metadata("x").get("categorical")
+    back = DataConversion(inputCols=["x"], convertTo="clearCategorical").transform(out)
+    assert list(back["x"]) == ["1", "2"]
+
+
+def test_assemble_features():
+    t = Table(
+        {
+            "num": np.array([1.0, 2.0]),
+            "vec": np.array([[1.0, 2.0], [3.0, 4.0]]),
+            "flag": np.array([True, False]),
+        }
+    )
+    out = AssembleFeatures(inputCols=["num", "vec", "flag"]).transform(t)
+    np.testing.assert_allclose(
+        out["features"], [[1.0, 1.0, 2.0, 1.0], [2.0, 3.0, 4.0, 0.0]]
+    )
+    with pytest.raises(ValueError):
+        AssembleFeatures(inputCols=["s"]).transform(
+            Table({"s": np.array(["x", "y"], dtype=object)})
+        )
+
+
+def test_featurize_mixed_columns():
+    rng = np.random.default_rng(0)
+    n = 50
+    t = Table(
+        {
+            "num": rng.normal(size=n),
+            "with_nan": np.where(rng.random(n) < 0.2, np.nan, rng.normal(size=n)),
+            "cat": np.array([["red", "green", "blue"][i % 3] for i in range(n)], dtype=object),
+            "text": np.array([f"word{i} common tokens here {i%7}" for i in range(n)], dtype=object),
+        }
+    )
+    model = Featurize(
+        inputCols=["num", "with_nan", "cat", "text"],
+        outputCol="features",
+        numberOfFeatures=64,
+    ).fit(t)
+    out = model.transform(t)
+    f = out["features"]
+    # 1 numeric + 1 numeric + (3 levels + unknown) one-hot + 64 hash dims.
+    assert f.shape == (n, 2 + 4 + 64)
+    assert np.isfinite(f).all()
+    # Unknown categorical at transform time goes to the unknown slot.
+    t2 = Table(
+        {
+            "num": np.zeros(1),
+            "with_nan": np.array([np.nan]),
+            "cat": np.array(["violet"], dtype=object),
+            "text": np.array(["common tokens"], dtype=object),
+        }
+    )
+    f2 = model.transform(t2)["features"]
+    assert f2[0, 2 + 3] == 1.0  # unknown bucket
+
+
+def test_featurize_single_vector_passthrough():
+    t = Table({"vec": np.array([[1.0, 2.0], [3.0, 4.0]])})
+    model = Featurize(inputCols=["vec"], outputCol="features").fit(t)
+    np.testing.assert_allclose(model.transform(t)["features"], t["vec"])
+
+
+def test_text_featurizer_idf():
+    docs = ["the cat sat", "the dog sat", "a bird flew"]
+    t = Table({"text": np.array(docs, dtype=object)})
+    model = TextFeaturizer(
+        inputCol="text", outputCol="tf", numFeatures=256, useIDF=True
+    ).fit(t)
+    out = model.transform(t)
+    assert out["tf"].shape == (3, 256)
+    # 'the' appears in 2/3 docs; its idf weight is below a unique token's.
+    assert out["tf"].max() > 0
+
+
+def test_text_featurizer_ngrams_binary():
+    t = Table({"text": np.array(["a b a b", "c d"], dtype=object)})
+    model = TextFeaturizer(
+        inputCol="text", outputCol="tf", numFeatures=64,
+        useNGram=True, nGramLength=2, binary=True, useIDF=False,
+    ).fit(t)
+    out = model.transform(t)
+    assert set(np.unique(out["tf"])) <= {0.0, 1.0}
+
+
+def test_text_featurizer_token_list_input():
+    t = Table({"tokens": [["x", "y"], ["z"]]})
+    model = TextFeaturizer(
+        inputCol="tokens", outputCol="tf", numFeatures=32, useIDF=False
+    ).fit(t)
+    assert model.transform(t)["tf"].shape == (2, 32)
+
+
+def test_multi_ngram():
+    t = Table({"tokens": [["a", "b", "c"]]})
+    out = MultiNGram(inputCol="tokens", outputCol="grams", lengths=[1, 2, 3]).transform(t)
+    assert list(out["grams"][0]) == ["a", "b", "c", "a b", "b c", "a b c"]
+
+
+def test_page_splitter():
+    text = "word " * 100  # 500 chars
+    t = Table({"doc": np.array([text.strip()], dtype=object)})
+    out = PageSplitter(
+        inputCol="doc", outputCol="pages",
+        maximumPageLength=100, minimumPageLength=80,
+    ).transform(t)
+    pages = out["pages"][0]
+    assert "".join(pages) == text.strip()
+    assert all(len(p) <= 100 for p in pages)
+    assert all(len(p) >= 80 for p in pages[:-1])
+
+
+def test_featurize_serialization(tmp_path):
+    t = Table(
+        {
+            "num": np.arange(5.0),
+            "cat": np.array(list("ababa"), dtype=object),
+        }
+    )
+    model = Featurize(inputCols=["num", "cat"], outputCol="features").fit(t)
+    model.save(str(tmp_path / "feat"))
+    from mmlspark_tpu.core.pipeline import PipelineStage
+
+    loaded = PipelineStage.load(str(tmp_path / "feat"))
+    np.testing.assert_allclose(loaded.transform(t)["features"], model.transform(t)["features"])

@@ -1,0 +1,167 @@
+"""Train/eval API (reference ``train/`` suites — SURVEY.md §2.12)."""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.data.table import Table
+from mmlspark_tpu.train import (
+    ComputeModelStatistics,
+    ComputePerInstanceStatistics,
+    TrainClassifier,
+    TrainRegressor,
+)
+from mmlspark_tpu.train.statistics import binary_auc
+
+
+@pytest.fixture()
+def mixed_classification_table(rng):
+    n = 200
+    x1 = rng.normal(size=n)
+    x2 = rng.normal(size=n)
+    cat = np.array([["u", "v"][i % 2] for i in range(n)], dtype=object)
+    margin = 2.0 * x1 - x2 + np.where(cat == "u", 1.0, -1.0)
+    label = np.array(["yes" if m > 0 else "no" for m in margin], dtype=object)
+    return Table({"x1": x1, "x2": x2, "cat": cat, "label": label})
+
+
+def test_train_classifier_string_labels(mixed_classification_table):
+    from mmlspark_tpu.lightgbm import LightGBMClassifier
+
+    t = mixed_classification_table
+    trainer = TrainClassifier(
+        model=LightGBMClassifier(numIterations=20, numLeaves=7),
+        labelCol="label",
+    )
+    model = trainer.fit(t)
+    out = model.transform(t)
+    # Predictions decoded back to the original string labels.
+    assert set(np.unique(out["prediction"].astype(str))) <= {"yes", "no"}
+    acc = (out["prediction"].astype(str) == t["label"].astype(str)).mean()
+    assert acc > 0.9
+
+
+def test_train_regressor(rng):
+    from mmlspark_tpu.lightgbm import LightGBMRegressor
+
+    n = 300
+    x = rng.normal(size=(n, 4))
+    y = x[:, 0] * 3 + x[:, 1] + 0.05 * rng.normal(size=n)
+    t = Table({"f": x, "label": y})
+    model = TrainRegressor(
+        model=LightGBMRegressor(numIterations=40, numLeaves=15), labelCol="label"
+    ).fit(t)
+    out = model.transform(t)
+    stats = ComputeModelStatistics(
+        labelCol="label", evaluationMetric="regression"
+    ).transform(out)
+    assert stats["R^2"][0] > 0.8
+
+
+def test_compute_model_statistics_classification():
+    t = Table(
+        {
+            "label": np.array([0, 0, 1, 1, 1, 0]),
+            "prediction": np.array([0, 1, 1, 1, 0, 0]),
+            "probability": np.array(
+                [[0.8, 0.2], [0.4, 0.6], [0.1, 0.9], [0.2, 0.8], [0.7, 0.3], [0.9, 0.1]]
+            ),
+        }
+    )
+    stats = ComputeModelStatistics(labelCol="label").transform(t)
+    assert stats["accuracy"][0] == pytest.approx(4 / 6)
+    assert 0.5 < stats["AUC"][0] <= 1.0
+    cm = stats["confusion_matrix"][0].reshape(2, 2)
+    assert cm.sum() == 6 and cm[0, 0] == 2 and cm[1, 1] == 2
+
+
+def test_binary_auc_known_value():
+    labels = np.array([0, 0, 1, 1])
+    scores = np.array([0.1, 0.4, 0.35, 0.8])
+    # sklearn-verified value for this classic example.
+    assert binary_auc(labels, scores) == pytest.approx(0.75)
+    assert binary_auc(labels, np.array([0.5, 0.5, 0.5, 0.5])) == pytest.approx(0.5)
+
+
+def test_compute_model_statistics_regression():
+    t = Table(
+        {"label": np.array([1.0, 2.0, 3.0]), "prediction": np.array([1.1, 1.9, 3.2])}
+    )
+    stats = ComputeModelStatistics(
+        labelCol="label", evaluationMetric="regression"
+    ).transform(t)
+    assert stats["mean_squared_error"][0] == pytest.approx(0.02, abs=1e-9)
+    assert stats["R^2"][0] > 0.96
+
+
+def test_per_instance_statistics():
+    t = Table(
+        {
+            "label": np.array([0.0, 1.0]),
+            "prediction": np.array([0.0, 0.0]),
+            "probability": np.array([[0.9, 0.1], [0.6, 0.4]]),
+        }
+    )
+    out = ComputePerInstanceStatistics(labelCol="label").transform(t)
+    np.testing.assert_allclose(out["correct"], [1.0, 0.0])
+    np.testing.assert_allclose(out["log_loss"], [-np.log(0.9), -np.log(0.4)])
+    t2 = Table({"label": np.array([1.0, 2.0]), "prediction": np.array([1.5, 2.0])})
+    out2 = ComputePerInstanceStatistics(
+        labelCol="label", evaluationMetric="regression"
+    ).transform(t2)
+    np.testing.assert_allclose(out2["L2_loss"], [0.25, 0.0])
+
+
+def test_model_statistics_string_labels(mixed_classification_table):
+    # Regression: TrainClassifier emits decoded string predictions; the
+    # metrics stage must compose with them directly.
+    from mmlspark_tpu.lightgbm import LightGBMClassifier
+
+    t = mixed_classification_table
+    model = TrainClassifier(
+        model=LightGBMClassifier(numIterations=10, numLeaves=7), labelCol="label"
+    ).fit(t)
+    out = model.transform(t)
+    stats = ComputeModelStatistics(labelCol="label").transform(out)
+    assert stats["accuracy"][0] > 0.8
+    assert "AUC" in stats.columns
+    per = ComputePerInstanceStatistics(labelCol="label").transform(out)
+    assert set(np.unique(per["correct"])) <= {0.0, 1.0}
+
+
+def test_per_instance_log_loss_shifted_binary_labels():
+    # Regression: labels {1,2} with 1-D probabilities = P(higher class).
+    t = Table(
+        {
+            "label": np.array([1.0, 2.0]),
+            "prediction": np.array([1.0, 2.0]),
+            "probability": np.array([0.1, 0.9]),
+        }
+    )
+    out = ComputePerInstanceStatistics(labelCol="label").transform(t)
+    np.testing.assert_allclose(out["log_loss"], [-np.log(0.9), -np.log(0.9)])
+
+
+def test_index_to_value_numeric_unknown():
+    # Regression: numeric levels + unknown bucket -> NaN, not a crash.
+    from mmlspark_tpu.featurize import IndexToValue, ValueIndexer
+
+    t = Table({"x": np.array([10, 5, 7])})
+    model = ValueIndexer(inputCol="x", outputCol="idx").fit(t)
+    out = model.transform(Table({"x": np.array([10, 999])}))
+    back = IndexToValue(inputCol="idx", outputCol="v").transform(out)
+    assert back["v"][0] == 10.0 and np.isnan(back["v"][1])
+
+
+def test_trained_model_serialization(tmp_path, mixed_classification_table):
+    from mmlspark_tpu.core.pipeline import PipelineStage
+    from mmlspark_tpu.lightgbm import LightGBMClassifier
+
+    t = mixed_classification_table
+    model = TrainClassifier(
+        model=LightGBMClassifier(numIterations=5, numLeaves=7), labelCol="label"
+    ).fit(t)
+    model.save(str(tmp_path / "trained"))
+    loaded = PipelineStage.load(str(tmp_path / "trained"))
+    a = model.transform(t)["prediction"].astype(str)
+    b = loaded.transform(t)["prediction"].astype(str)
+    assert list(a) == list(b)
