@@ -51,10 +51,11 @@ def hashing_tf(
     if 2**num_bits != num_features:
         raise ValueError(f"numFeatures must be a power of two, got {num_features}")
     out = np.zeros((len(docs), num_features), dtype=np.float32)
+    cache: dict = {}  # one cache per table so recurring tokens hash once
     for i, tokens in enumerate(docs):
         if not tokens:
             continue
-        idx = mask_bits(murmur32_strings(tokens), num_bits)
+        idx = mask_bits(murmur32_strings(tokens, cache=cache), num_bits)
         np.add.at(out[i], idx, 1.0)
     if binary:
         out = (out > 0).astype(np.float32)

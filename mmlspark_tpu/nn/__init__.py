@@ -1,0 +1,14 @@
+"""Nearest neighbors (reference ``nn/``, SURVEY.md §2.6)."""
+
+from mmlspark_tpu.nn.ball_tree import BallTree, BestMatch, ConditionalBallTree
+from mmlspark_tpu.nn.knn import KNN, ConditionalKNN, ConditionalKNNModel, KNNModel
+
+__all__ = [
+    "BallTree",
+    "BestMatch",
+    "ConditionalBallTree",
+    "ConditionalKNN",
+    "ConditionalKNNModel",
+    "KNN",
+    "KNNModel",
+]

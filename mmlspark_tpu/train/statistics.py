@@ -40,6 +40,22 @@ def binary_auc(labels: np.ndarray, scores: np.ndarray) -> float:
     return float((rank_sum - n_pos * (n_pos + 1) / 2) / (n_pos * n_neg))
 
 
+def prob_class_index(values: np.ndarray) -> Optional[np.ndarray]:
+    """Class-column indices into a probability matrix: the raw numeric values
+    when they are non-negative integers (the learners' native class coding —
+    column j of ``probability`` is P(class j)). Returns None for string or
+    non-integral labels, where no alignment is derivable. This is distinct
+    from :func:`remap_classes`, whose dense ids are ordered by *observed*
+    distinct value and misalign with model class columns whenever the eval
+    table sees only a subset of classes."""
+    if values.dtype == object:
+        return None
+    v = values.astype(np.float64)
+    if np.isnan(v).any() or not np.allclose(v, np.rint(v)) or v.min(initial=0) < 0:
+        return None
+    return np.rint(v).astype(np.int64)
+
+
 def remap_classes(labels: np.ndarray, pred: np.ndarray):
     """Map label/prediction columns (numeric or string) onto dense class ids
     [0, k) ordered by sorted distinct value — the convention both metric
@@ -111,10 +127,22 @@ class ComputeModelStatistics(HasLabelCol, Transformer):
                 "precision": float((precision * weights).sum()),
                 "recall": float((recall * weights).sum()),
             }
-            if k == 2 and self.getScoredProbabilitiesCol() in table:
+            if self.getScoredProbabilitiesCol() in table:
                 probs = table.column(self.getScoredProbabilitiesCol())
-                scores = probs[:, -1] if probs.ndim == 2 else probs.astype(np.float64)
-                metrics["AUC"] = binary_auc(li, scores)
+                if probs.ndim == 1 and k == 2:
+                    # 1-D probability = P(higher observed class): dense ids.
+                    metrics["AUC"] = binary_auc(li, probs.astype(np.float64))
+                elif probs.ndim == 2 and probs.shape[1] == 2:
+                    # Columns are model class ids — only score as binary when
+                    # labels use that coding (a 2-observed-class slice of a
+                    # multiclass model must NOT be scored as binary).
+                    li_raw = prob_class_index(labels)
+                    if li_raw is not None and li_raw.max(initial=0) <= 1:
+                        metrics["AUC"] = binary_auc(li_raw, probs[:, 1])
+                    elif li_raw is None and k == 2:
+                        # String labels: dense remap is sorted-distinct,
+                        # matching the trainers' sorted level indexing.
+                        metrics["AUC"] = binary_auc(li, probs[:, 1])
             out = Table({name: np.array([value]) for name, value in metrics.items()})
             return out.with_column(
                 "confusion_matrix", confusion.reshape(1, k * k).astype(np.float64)
@@ -165,9 +193,14 @@ class ComputePerInstanceStatistics(HasLabelCol, Transformer):
         if self.getScoredProbabilitiesCol() in table:
             probs = table.column(self.getScoredProbabilitiesCol())
             if probs.ndim == 2:
-                idx = np.clip(li, 0, probs.shape[1] - 1)
-                p_true = probs[np.arange(len(li)), idx]
+                # Index probability columns by the model's class coding (raw
+                # integer labels), not the observed-value dense remap.
+                li_raw = prob_class_index(labels)
+                li_prob = li_raw if li_raw is not None else li
+                idx = np.clip(li_prob, 0, probs.shape[1] - 1)
+                p_true = probs[np.arange(len(li_prob)), idx]
             else:
+                # 1-D probability = P(higher observed class): dense ids.
                 p = probs.astype(np.float64)
                 p_true = np.where(li == 1, p, 1.0 - p)
             out = out.with_column(

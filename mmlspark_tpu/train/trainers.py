@@ -51,6 +51,9 @@ class _TrainBase(HasLabelCol, HasFeaturesCol, Estimator):
             # Already a single assembled vector column.
             feat_col = cols[0]
         else:
+            from mmlspark_tpu.data.table import find_unused_column_name
+
+            feat_col = find_unused_column_name(feat_col, table)
             featurizer = Featurize(
                 inputCols=cols,
                 outputCol=feat_col,
@@ -137,13 +140,19 @@ class TrainedClassifierModel(_TrainedBase):
                         default=None)
 
     def transform(self, table: Table) -> Table:
-        out = self.getFittedModel().transform(self._featurize(table))
+        fitted = self.getFittedModel()
+        out = fitted.transform(self._featurize(table))
         levels = self.getLabelLevels()
-        if levels is not None and "prediction" in out:
+        pred_col = (
+            fitted.getPredictionCol()
+            if fitted.isDefined("predictionCol")
+            else "prediction"
+        )
+        if levels is not None and pred_col in out:
             from mmlspark_tpu.featurize.indexers import decode_levels
 
             out = out.with_column(
-                "prediction", decode_levels(out.column("prediction"), levels)
+                pred_col, decode_levels(out.column(pred_col), levels)
             )
         return out
 

@@ -1,0 +1,139 @@
+"""nn/ tests — mirrors reference ``nn/`` suites (BallTreeTest, KNNTest,
+ConditionalKNNTest under ``src/test/scala/com/microsoft/ml/spark/nn/``)."""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.data.table import Table
+from mmlspark_tpu.nn import KNN, BallTree, ConditionalBallTree, ConditionalKNN
+
+
+def _index(rng, n=200, d=8):
+    keys = rng.normal(size=(n, d))
+    values = [f"v{i}" for i in range(n)]
+    return keys, values
+
+
+def _brute_topk(keys, q, k):
+    scores = keys @ q
+    order = np.argsort(-scores)[:k]
+    return order, scores[order]
+
+
+class TestBallTree:
+    def test_matches_brute_force(self, rng):
+        keys, values = _index(rng)
+        tree = BallTree(keys, values, leaf_size=10)
+        for _ in range(5):
+            q = rng.normal(size=8)
+            got = tree.find_maximum_inner_products(q, k=7)
+            exp_idx, exp_scores = _brute_topk(keys, q, 7)
+            assert [m.index for m in got] == list(exp_idx)
+            np.testing.assert_allclose([m.distance for m in got], exp_scores, rtol=1e-9)
+
+    def test_save_load(self, rng, tmp_path):
+        keys, values = _index(rng, n=50)
+        tree = BallTree(keys, values, leaf_size=5)
+        path = str(tmp_path / "tree.pkl")
+        tree.save(path)
+        loaded = BallTree.load(path)
+        q = rng.normal(size=8)
+        assert [m.index for m in tree.find_maximum_inner_products(q, 3)] == \
+               [m.index for m in loaded.find_maximum_inner_products(q, 3)]
+
+    def test_duplicate_points(self):
+        keys = np.ones((20, 4))
+        tree = BallTree(keys, list(range(20)), leaf_size=3)
+        got = tree.find_maximum_inner_products(np.ones(4), k=3)
+        assert len(got) == 3
+        assert all(abs(m.distance - 4.0) < 1e-12 for m in got)
+
+
+class TestConditionalBallTree:
+    def test_conditioner_filters(self, rng):
+        keys, values = _index(rng, n=100)
+        labels = ["even" if i % 2 == 0 else "odd" for i in range(100)]
+        tree = ConditionalBallTree(keys, values, labels, leaf_size=8)
+        q = rng.normal(size=8)
+        got = tree.find_maximum_inner_products(q, k=5, conditioner={"even"})
+        assert all(int(m.index) % 2 == 0 for m in got)
+        # equals brute force over the even subset
+        even = np.arange(0, 100, 2)
+        scores = keys[even] @ q
+        exp = even[np.argsort(-scores)[:5]]
+        assert [m.index for m in got] == list(exp)
+
+
+@pytest.mark.parametrize("method", ["brute", "balltree"])
+class TestKNN:
+    def test_fit_transform(self, rng, method):
+        keys, values = _index(rng)
+        index = Table({"features": keys, "values": np.array(values, dtype=object)})
+        queries = Table({"features": rng.normal(size=(11, 8))})
+        model = KNN(k=4, method=method, outputCol="matches").fit(index)
+        out = model.transform(queries)
+        matches = out["matches"]
+        assert len(matches) == 11
+        for r in range(11):
+            exp_idx, exp_scores = _brute_topk(keys, queries["features"][r], 4)
+            assert [m["value"] for m in matches[r]] == [values[i] for i in exp_idx]
+            np.testing.assert_allclose(
+                [m["distance"] for m in matches[r]], exp_scores, rtol=1e-4)
+
+
+class TestConditionalKNN:
+    def test_per_row_conditioners(self, rng):
+        keys, values = _index(rng, n=60)
+        labels = [["a", "b", "c"][i % 3] for i in range(60)]
+        index = Table({
+            "features": keys,
+            "values": np.array(values, dtype=object),
+            "labels": np.array(labels, dtype=object),
+        })
+        conds = [{"a"}, {"b"}, {"a", "c"}, {"b", "c"}, {"a", "b", "c"}]
+        queries = Table({
+            "features": rng.normal(size=(5, 8)),
+            "conditioner": np.array(conds, dtype=object),
+        })
+        model = ConditionalKNN(k=3, labelCol="labels", outputCol="m").fit(index)
+        out = model.transform(queries)
+        for r in range(5):
+            for m in out["m"][r]:
+                assert m["label"] in conds[r]
+            # matches brute force over admissible rows
+            mask = np.array([l in conds[r] for l in labels])
+            sub = np.where(mask)[0]
+            scores = keys[sub] @ queries["features"][r]
+            exp = sub[np.argsort(-scores)[:3]]
+            assert [values[i] for i in exp] == [m["value"] for m in out["m"][r]]
+
+    def test_empty_conditioner(self, rng):
+        keys, values = _index(rng, n=10)
+        index = Table({
+            "features": keys,
+            "values": np.array(values, dtype=object),
+            "labels": np.array(["x"] * 10, dtype=object),
+        })
+        queries = Table({
+            "features": rng.normal(size=(2, 8)),
+            "conditioner": np.array([{"nope"}, {"x"}], dtype=object),
+        })
+        model = ConditionalKNN(k=2, labelCol="labels", outputCol="m").fit(index)
+        out = model.transform(queries)
+        assert out["m"][0] == []
+        assert len(out["m"][1]) == 2
+
+    def test_model_save_load(self, rng, tmp_path):
+        keys, values = _index(rng, n=30)
+        index = Table({"features": keys, "values": np.array(values, dtype=object)})
+        model = KNN(k=2, outputCol="m").fit(index)
+        path = str(tmp_path / "knn_model")
+        model.save(path)
+        from mmlspark_tpu.nn import KNNModel
+
+        loaded = KNNModel.load(path)
+        queries = Table({"features": rng.normal(size=(3, 8))})
+        a = model.transform(queries)["m"]
+        b = loaded.transform(queries)["m"]
+        for r in range(3):
+            assert [m["value"] for m in a[r]] == [m["value"] for m in b[r]]

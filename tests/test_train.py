@@ -165,3 +165,64 @@ def test_trained_model_serialization(tmp_path, mixed_classification_table):
     a = model.transform(t)["prediction"].astype(str)
     b = loaded.transform(t)["prediction"].astype(str)
     assert list(a) == list(b)
+
+
+def test_log_loss_subset_classes_aligns_with_model_columns():
+    # Regression: eval rows observing only classes {0, 2} of a 3-class model
+    # must index probability column 2 for class 2, not dense-remapped id 1.
+    t = Table(
+        {
+            "label": np.array([0.0, 2.0]),
+            "prediction": np.array([0.0, 2.0]),
+            "probability": np.array([[0.8, 0.1, 0.1], [0.1, 0.1, 0.8]]),
+        }
+    )
+    out = ComputePerInstanceStatistics(labelCol="label").transform(t)
+    np.testing.assert_allclose(out["log_loss"], [-np.log(0.8), -np.log(0.8)])
+
+
+def test_no_auc_for_two_class_slice_of_multiclass_model():
+    t = Table(
+        {
+            "label": np.array([0.0, 2.0]),
+            "prediction": np.array([0.0, 2.0]),
+            "probability": np.array([[0.8, 0.1, 0.1], [0.1, 0.1, 0.8]]),
+        }
+    )
+    out = ComputeModelStatistics(labelCol="label").transform(t)
+    assert "AUC" not in out.columns
+
+
+def test_trained_classifier_custom_prediction_col():
+    # Regression: label decoding must follow the learner's predictionCol.
+    from mmlspark_tpu.lightgbm import LightGBMClassifier
+
+    rng = np.random.default_rng(3)
+    X = rng.normal(size=(80, 4))
+    y = np.array(["yes" if v > 0 else "no" for v in X[:, 0]], dtype=object)
+    t = Table({"f": X, "label": y})
+    model = TrainClassifier(
+        model=LightGBMClassifier(numIterations=5, numLeaves=7, predictionCol="pred"),
+        labelCol="label",
+    ).fit(t)
+    out = model.transform(t)
+    assert set(np.unique(out["pred"].astype(str))) <= {"yes", "no"}
+
+
+def test_train_features_col_collision():
+    # Regression: a real column named TrainedFeatures must not be clobbered.
+    rng = np.random.default_rng(4)
+    t = Table(
+        {
+            "TrainedFeatures": rng.normal(size=100),
+            "other": rng.normal(size=100),
+            "label": (rng.normal(size=100) > 0).astype(np.float64),
+        }
+    )
+    from mmlspark_tpu.lightgbm import LightGBMClassifier
+
+    model = TrainClassifier(
+        model=LightGBMClassifier(numIterations=3, numLeaves=7), labelCol="label"
+    ).fit(t)
+    out = model.transform(t)
+    np.testing.assert_array_equal(out["TrainedFeatures"], t["TrainedFeatures"])
