@@ -102,7 +102,6 @@ class BallTree:
             raise ValueError("values length must match keys")
         self.values = list(values)
         self.leaf_size = int(leaf_size)
-        self._labels: Optional[np.ndarray] = None
         self.root = _build(self.keys, np.arange(len(self.keys)), self.leaf_size, self._label_array())
 
     def _label_array(self) -> Optional[np.ndarray]:

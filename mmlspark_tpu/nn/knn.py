@@ -21,17 +21,36 @@ masked matmul.
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Any, Dict, Hashable, List, Optional, Sequence, Set
 
 import numpy as np
 
-from mmlspark_tpu.core.params import HasFeaturesCol, HasOutputCol, Param, to_int, to_str
+from mmlspark_tpu.core.params import (
+    HasFeaturesCol,
+    HasOutputCol,
+    Param,
+    one_of,
+    to_int,
+    to_str,
+)
 from mmlspark_tpu.core.pipeline import Estimator, Model
 from mmlspark_tpu.data.table import Table
 from mmlspark_tpu.nn.ball_tree import BallTree, ConditionalBallTree
 
 _QUERY_BATCH = 4096
+
+
+def _run_topk(K, Q, m, k):
+    import jax
+    import jax.numpy as jnp
+
+    scores = Q @ K.T  # (nq, n) — the MXU hot op
+    if m is not None:
+        scores = jnp.where(m[None, :], scores, -jnp.inf)
+    return jax.lax.top_k(scores, k)
+
+
+_run_topk_jit = None  # module-level so identical (shape, k) calls hit the jit cache
 
 
 def _topk_inner_products(keys: np.ndarray, queries: np.ndarray, k: int,
@@ -44,20 +63,18 @@ def _topk_inner_products(keys: np.ndarray, queries: np.ndarray, k: int,
     import jax
     import jax.numpy as jnp
 
-    @partial(jax.jit, static_argnames=("k",))
-    def _run(K, Q, m, k):
-        scores = Q @ K.T  # (nq, n) — the MXU hot op
-        if m is not None:
-            scores = jnp.where(m[None, :], scores, -jnp.inf)
-        return jax.lax.top_k(scores, k)
+    global _run_topk_jit
+    if _run_topk_jit is None:
+        _run_topk_jit = jax.jit(_run_topk, static_argnames=("k",))
 
+    k = min(k, len(keys))
     K = jnp.asarray(keys, dtype=jnp.float32)
     m = None if mask is None else jnp.asarray(mask)
     out_s: List[np.ndarray] = []
     out_i: List[np.ndarray] = []
     for start in range(0, len(queries), _QUERY_BATCH):
         Q = jnp.asarray(queries[start:start + _QUERY_BATCH], dtype=jnp.float32)
-        s, i = _run(K, Q, m, k)
+        s, i = _run_topk_jit(K, Q, m, k)
         out_s.append(np.asarray(s))
         out_i.append(np.asarray(i))
     return np.concatenate(out_s), np.concatenate(out_i)
@@ -71,8 +88,7 @@ class _KNNParams(HasFeaturesCol, HasOutputCol):
     k = Param("Number of matches to return", default=5, converter=to_int)
     leafSize = Param("Max leaf size of the ball tree", default=50, converter=to_int)
     method = Param("Query engine: 'brute' (on-chip matmul top-k) or 'balltree' (host)",
-                   default="brute",
-                   validator=lambda v: v in ("brute", "balltree"))
+                   default="brute", validator=one_of("brute", "balltree"))
 
 
 class KNN(_KNNParams, Estimator):
@@ -122,7 +138,7 @@ class KNNModel(_KNNParams, Model):
             scores, idx = _topk_inner_products(self.getIndexKeys(), queries, k)
             for r in range(len(queries)):
                 out[r] = [{"value": values[idx[r, j]], "distance": float(scores[r, j])}
-                          for j in range(k)]
+                          for j in range(idx.shape[1])]
         else:
             tree = self._ball_tree()
             for r in range(len(queries)):

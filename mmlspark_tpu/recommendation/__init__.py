@@ -1,0 +1,26 @@
+"""Recommendation (reference ``recommendation/``, SURVEY.md §2.9)."""
+
+from mmlspark_tpu.recommendation.ranking import (
+    AdvancedRankingMetrics,
+    RankingAdapter,
+    RankingAdapterModel,
+    RankingEvaluator,
+    RankingTrainValidationSplit,
+    RankingTrainValidationSplitModel,
+    RecommendationIndexer,
+    RecommendationIndexerModel,
+)
+from mmlspark_tpu.recommendation.sar import SAR, SARModel
+
+__all__ = [
+    "AdvancedRankingMetrics",
+    "RankingAdapter",
+    "RankingAdapterModel",
+    "RankingEvaluator",
+    "RankingTrainValidationSplit",
+    "RankingTrainValidationSplitModel",
+    "RecommendationIndexer",
+    "RecommendationIndexerModel",
+    "SAR",
+    "SARModel",
+]

@@ -133,15 +133,16 @@ class ComputeModelStatistics(HasLabelCol, Transformer):
                     # 1-D probability = P(higher observed class): dense ids.
                     metrics["AUC"] = binary_auc(li, probs.astype(np.float64))
                 elif probs.ndim == 2 and probs.shape[1] == 2:
-                    # Columns are model class ids — only score as binary when
-                    # labels use that coding (a 2-observed-class slice of a
-                    # multiclass model must NOT be scored as binary).
+                    # Columns are model class ids — use that coding when the
+                    # labels fit it. A 2-column matrix cannot be a slice of a
+                    # multiclass model, so otherwise (string labels, or
+                    # reindexed codings like {1,2}) the sorted dense remap is
+                    # the trainers' level indexing and is correct when two
+                    # classes are observed.
                     li_raw = prob_class_index(labels)
                     if li_raw is not None and li_raw.max(initial=0) <= 1:
                         metrics["AUC"] = binary_auc(li_raw, probs[:, 1])
-                    elif li_raw is None and k == 2:
-                        # String labels: dense remap is sorted-distinct,
-                        # matching the trainers' sorted level indexing.
+                    elif k == 2:
                         metrics["AUC"] = binary_auc(li, probs[:, 1])
             out = Table({name: np.array([value]) for name, value in metrics.items()})
             return out.with_column(
@@ -194,9 +195,14 @@ class ComputePerInstanceStatistics(HasLabelCol, Transformer):
             probs = table.column(self.getScoredProbabilitiesCol())
             if probs.ndim == 2:
                 # Index probability columns by the model's class coding (raw
-                # integer labels), not the observed-value dense remap.
+                # integer labels) when the labels fit the column count; for
+                # reindexed codings (e.g. {1,2} on a 2-column model) fall
+                # back to the sorted dense remap the trainers index by.
                 li_raw = prob_class_index(labels)
-                li_prob = li_raw if li_raw is not None else li
+                if li_raw is not None and li_raw.max(initial=0) < probs.shape[1]:
+                    li_prob = li_raw
+                else:
+                    li_prob = li
                 idx = np.clip(li_prob, 0, probs.shape[1] - 1)
                 p_true = probs[np.arange(len(li_prob)), idx]
             else:

@@ -145,7 +145,7 @@ class TrainedClassifierModel(_TrainedBase):
         levels = self.getLabelLevels()
         pred_col = (
             fitted.getPredictionCol()
-            if fitted.isDefined("predictionCol")
+            if fitted.hasParam("predictionCol")
             else "prediction"
         )
         if levels is not None and pred_col in out:

@@ -137,3 +137,11 @@ class TestConditionalKNN:
         b = loaded.transform(queries)["m"]
         for r in range(3):
             assert [m["value"] for m in a[r]] == [m["value"] for m in b[r]]
+
+
+def test_knn_k_larger_than_index(rng):
+    keys = rng.normal(size=(3, 4))
+    index = Table({"features": keys, "values": np.array(["a", "b", "c"], dtype=object)})
+    model = KNN(k=5, outputCol="m").fit(index)
+    out = model.transform(Table({"features": rng.normal(size=(2, 4))}))
+    assert len(out["m"][0]) == 3

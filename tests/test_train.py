@@ -226,3 +226,18 @@ def test_train_features_col_collision():
     ).fit(t)
     out = model.transform(t)
     np.testing.assert_array_equal(out["TrainedFeatures"], t["TrainedFeatures"])
+
+
+def test_log_loss_reindexed_binary_labels():
+    # Regression: labels {1,2} on a 2-column model use the dense remap.
+    t = Table(
+        {
+            "label": np.array([1.0, 2.0]),
+            "prediction": np.array([1.0, 2.0]),
+            "probability": np.array([[0.9, 0.1], [0.2, 0.8]]),
+        }
+    )
+    out = ComputePerInstanceStatistics(labelCol="label").transform(t)
+    np.testing.assert_allclose(out["log_loss"], [-np.log(0.9), -np.log(0.8)])
+    stats = ComputeModelStatistics(labelCol="label").transform(t)
+    assert "AUC" in stats.columns
