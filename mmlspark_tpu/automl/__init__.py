@@ -1,0 +1,33 @@
+"""AutoML (reference ``automl/``, SURVEY.md §2.13)."""
+
+from mmlspark_tpu.automl.hyperparam import (
+    DefaultHyperparams,
+    DiscreteHyperParam,
+    Dist,
+    DoubleRangeHyperParam,
+    GridSpace,
+    HyperparamBuilder,
+    IntRangeHyperParam,
+    RandomSpace,
+)
+from mmlspark_tpu.automl.tune import (
+    BestModel,
+    FindBestModel,
+    TuneHyperparameters,
+    TuneHyperparametersModel,
+)
+
+__all__ = [
+    "BestModel",
+    "DefaultHyperparams",
+    "DiscreteHyperParam",
+    "Dist",
+    "DoubleRangeHyperParam",
+    "FindBestModel",
+    "GridSpace",
+    "HyperparamBuilder",
+    "IntRangeHyperParam",
+    "RandomSpace",
+    "TuneHyperparameters",
+    "TuneHyperparametersModel",
+]

@@ -1,0 +1,188 @@
+"""TuneHyperparameters / FindBestModel
+(reference ``automl/TuneHyperparameters.scala:37`` and
+``automl/FindBestModel.scala:55``).
+
+Randomized search with k-fold cross validation; candidate fits run on a
+bounded thread pool (``getExecutionContext``/future-per-paramMap,
+``TuneHyperparameters.scala:95-187``). JAX releases the GIL during device
+execution, so pool threads overlap host featurization with on-chip fits —
+the role the reference's driver-side pool played for Spark jobs.
+"""
+
+from __future__ import annotations
+
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from mmlspark_tpu.core.params import HasLabelCol, Param, gt, to_int, to_str
+from mmlspark_tpu.core.pipeline import Estimator, Model, Transformer
+from mmlspark_tpu.data.table import Table
+from mmlspark_tpu.train.statistics import ComputeModelStatistics
+
+# metric name -> (output column of ComputeModelStatistics, higher is better)
+_METRICS: Dict[str, Tuple[str, bool]] = {
+    "accuracy": ("accuracy", True),
+    "precision": ("precision", True),
+    "recall": ("recall", True),
+    "AUC": ("AUC", True),
+    "mse": ("mean_squared_error", False),
+    "rmse": ("root_mean_squared_error", False),
+    "mae": ("mean_absolute_error", False),
+    "r2": ("R^2", True),
+}
+
+
+def _evaluate(scored: Table, label_col: str, metric: str) -> float:
+    col, _ = _METRICS[metric]
+    stats = ComputeModelStatistics(labelCol=label_col).transform(scored)
+    if col not in stats:
+        raise ValueError(
+            f"metric {metric!r} not produced — got columns {stats.columns}"
+        )
+    return float(stats.column(col)[0])
+
+
+def _is_larger_better(metric: str) -> bool:
+    return _METRICS[metric][1]
+
+
+class TuneHyperparameters(HasLabelCol, Estimator):
+    """Randomized hyperparameter search over one or more estimators with
+    k-fold CV; best (estimator, param map) refitted on the full data."""
+
+    models = Param("Estimators to sweep", is_complex=True)
+    paramSpace = Param("Per-estimator dict of param Dists, or one shared dict",
+                       is_complex=True, default=None)
+    evaluationMetric = Param("Metric name", default="accuracy", converter=to_str,
+                             validator=lambda v: v in _METRICS)
+    numFolds = Param("CV folds", default=3, converter=to_int, validator=gt(1))
+    numRuns = Param("Sampled param maps per estimator", default=10,
+                    converter=to_int, validator=gt(0))
+    parallelism = Param("Concurrent candidate fits", default=1, converter=to_int,
+                        validator=gt(0))
+    seed = Param("RNG seed (sampling + fold split)", default=0, converter=to_int)
+
+    def _folds(self, n: int) -> List[np.ndarray]:
+        rng = np.random.default_rng(self.getSeed())
+        perm = rng.permutation(n)
+        return np.array_split(perm, self.getNumFolds())
+
+    def _cv_metric(self, est: Estimator, params: Dict[str, Any],
+                   table: Table, folds: List[np.ndarray]) -> float:
+        label_col = self.getLabelCol()
+        metric = self.getEvaluationMetric()
+        n = table.num_rows
+        scores = []
+        for fold in folds:
+            mask = np.zeros(n, dtype=bool)
+            mask[fold] = True
+            train, valid = table.filter(~mask), table.filter(mask)
+            model = est.copy(params).fit(train)
+            scores.append(_evaluate(model.transform(valid), label_col, metric))
+        return float(np.mean(scores))
+
+    def _fit(self, table: Table) -> "TuneHyperparametersModel":
+        estimators = self.getModels()
+        if isinstance(estimators, Estimator):
+            estimators = [estimators]
+        if not estimators:
+            raise ValueError("no estimators to tune")
+        space = self.getParamSpace() or {}
+        rng = np.random.default_rng(self.getSeed())
+        folds = self._folds(table.num_rows)
+
+        candidates: List[Tuple[Estimator, Dict[str, Any]]] = []
+        for est in estimators:
+            dists = space.get(est.uid, space) if space else {}
+            # tolerate {param: Dist} directly or per-estimator nesting
+            if dists and all(hasattr(d, "get_next") for d in dists.values()):
+                for _ in range(self.getNumRuns()):
+                    candidates.append(
+                        (est, {k: d.get_next(rng) for k, d in dists.items()})
+                    )
+            else:
+                candidates.append((est, {}))
+
+        def run(cand: Tuple[Estimator, Dict[str, Any]]) -> float:
+            est, params = cand
+            return self._cv_metric(est, params, table, folds)
+
+        if self.getParallelism() > 1:
+            with ThreadPoolExecutor(max_workers=self.getParallelism()) as pool:
+                metrics = list(pool.map(run, candidates))
+        else:
+            metrics = [run(c) for c in candidates]
+
+        higher = _is_larger_better(self.getEvaluationMetric())
+        order = np.argsort(metrics)
+        best_i = int(order[-1] if higher else order[0])
+        best_est, best_params = candidates[best_i]
+        best_model = best_est.copy(best_params).fit(table)
+        model = TuneHyperparametersModel(
+            bestModel=best_model,
+            bestMetric=float(metrics[best_i]),
+            allMetrics=[float(m) for m in metrics],
+            bestParams=best_params,
+        )
+        model.parent = self
+        return model
+
+
+class TuneHyperparametersModel(Model):
+    bestModel = Param("Winning fitted model", is_complex=True, default=None)
+    bestMetric = Param("Winning CV metric", default=float("nan"))
+    allMetrics = Param("CV metric per candidate", default=None)
+    bestParams = Param("Winning param map", default=None)
+
+    def transform(self, table: Table) -> Table:
+        return self.getBestModel().transform(table)
+
+
+class FindBestModel(HasLabelCol, Estimator):
+    """Evaluates already-fitted models on a dataset, keeps the best
+    (``FindBestModel.scala:55-130``)."""
+
+    models = Param("Fitted models (Transformers) to evaluate", is_complex=True)
+    evaluationMetric = Param("Metric name", default="accuracy", converter=to_str,
+                             validator=lambda v: v in _METRICS)
+
+    def _fit(self, table: Table) -> "BestModel":
+        models = self.getModels()
+        if not models:
+            raise ValueError("no trained models to evaluate")
+        metric = self.getEvaluationMetric()
+        label_col = self.getLabelCol()
+        higher = _is_larger_better(metric)
+        rows = []
+        best_val, best_model, best_scored = None, None, None
+        for m in models:
+            scored = m.transform(table)
+            val = _evaluate(scored, label_col, metric)
+            rows.append((m.uid, val))
+            if best_val is None or ((val > best_val) == higher and val != best_val):
+                best_val, best_model, best_scored = val, m, scored
+        model = BestModel(
+            bestModel=best_model,
+            bestModelMetrics=best_val,
+            allModelMetrics=rows,
+        )
+        model.parent = self
+        return model
+
+
+class BestModel(Model):
+    bestModel = Param("Winning transformer", is_complex=True, default=None)
+    bestModelMetrics = Param("Winning metric value", default=float("nan"))
+    allModelMetrics = Param("(uid, metric) per candidate", default=None)
+
+    def transform(self, table: Table) -> Table:
+        return self.getBestModel().transform(table)
+
+    def get_evaluated_models(self) -> Table:
+        rows = self.getAllModelMetrics() or []
+        return Table({
+            "model": np.array([r[0] for r in rows], dtype=object),
+            "metric": np.array([r[1] for r in rows], dtype=np.float64),
+        })

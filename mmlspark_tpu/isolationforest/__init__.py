@@ -1,0 +1,6 @@
+"""Isolation Forest (reference ``isolationforest/IsolationForest.scala:9-58``,
+a thin re-export of LinkedIn's ``isolation-forest`` Spark estimator)."""
+
+from mmlspark_tpu.isolationforest.forest import IsolationForest, IsolationForestModel
+
+__all__ = ["IsolationForest", "IsolationForestModel"]

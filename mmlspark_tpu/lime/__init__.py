@@ -1,0 +1,21 @@
+"""Model interpretability (reference ``lime/``, SURVEY.md §2.8)."""
+
+from mmlspark_tpu.lime.lasso import fit_lasso_batch
+from mmlspark_tpu.lime.lime import ImageLIME, TabularLIME, TabularLIMEModel
+from mmlspark_tpu.lime.superpixel import (
+    SuperpixelData,
+    SuperpixelTransformer,
+    mask_image,
+    slic,
+)
+
+__all__ = [
+    "ImageLIME",
+    "SuperpixelData",
+    "SuperpixelTransformer",
+    "TabularLIME",
+    "TabularLIMEModel",
+    "fit_lasso_batch",
+    "mask_image",
+    "slic",
+]

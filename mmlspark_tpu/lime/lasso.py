@@ -1,0 +1,63 @@
+"""Batched lasso via coordinate descent — LIME's per-row local fit.
+
+Reference: ``lime/BreezeUtils.scala`` (``LassoCalculator2``: cyclic
+coordinate descent, per-column least-squares on the residual followed by
+soft-thresholding with ``lambda``; ``lambda=0`` degrades to plain least
+squares) invoked per row through ``fitLassoUDF`` (``lime/LIME.scala:157``).
+
+TPU-first: the reference fits one Breeze lasso per DataFrame row inside a
+UDF. Here the whole batch of per-instance problems is a single
+``vmap``-over-rows jitted program — n_rows independent (n_samples × d)
+solves run as one XLA computation, with the cyclic sweep expressed as
+``lax.fori_loop`` (compiler-friendly control flow, no Python loop in jit).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+MAX_ITER = 100
+
+
+def _lasso_single(X, y, lam, max_iter):
+    """One coordinate-descent lasso solve (matches LassoCalculator2: the
+    unpenalized one-column LS coefficient is soft-thresholded by lam)."""
+    d = X.shape[1]
+    col_sq = jnp.maximum((X * X).sum(axis=0), 1e-12)
+
+    def sweep(_, w):
+        def col(j, w):
+            # residual excluding column j
+            r = y - X @ w + X[:, j] * w[j]
+            c = (X[:, j] @ r) / col_sq[j]
+            wj = jnp.sign(c) * jnp.maximum(jnp.abs(c) - lam, 0.0)
+            return w.at[j].set(wj)
+
+        return jax.lax.fori_loop(0, d, col, w)
+
+    w0 = jnp.zeros(d, dtype=X.dtype)
+    return jax.lax.fori_loop(0, max_iter, sweep, w0)
+
+
+@partial(jax.jit, static_argnames=("max_iter",))
+def _lasso_batch(X, y, lam, max_iter):
+    return jax.vmap(_lasso_single, in_axes=(0, 0, None, None))(X, y, lam, max_iter)
+
+
+def fit_lasso_batch(X: np.ndarray, y: np.ndarray, lam: float,
+                    max_iter: int = MAX_ITER) -> np.ndarray:
+    """Solve ``n_rows`` independent lasso problems on device.
+
+    X: (n_rows, n_samples, d), y: (n_rows, n_samples) -> (n_rows, d).
+    """
+    out = _lasso_batch(
+        jnp.asarray(X, dtype=jnp.float32),
+        jnp.asarray(y, dtype=jnp.float32),
+        jnp.float32(lam),
+        max_iter,
+    )
+    return np.asarray(out, dtype=np.float64)

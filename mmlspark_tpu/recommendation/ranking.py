@@ -339,8 +339,12 @@ class RankingTrainValidationSplit(Estimator):
         rng = np.random.default_rng(self.getSeed())
         ratio = self.getTrainRatio()
         in_train = np.zeros(len(users), dtype=bool)
-        for u in np.unique(users):
-            rows = np.where(users == u)[0]
+        # one O(n log n) pass: group rows by user, shuffle within each group
+        order = np.argsort(users, kind="stable")
+        _, starts = np.unique(users[order], return_index=True)
+        bounds = np.append(starts, len(users))
+        for s, e in zip(bounds[:-1], bounds[1:]):
+            rows = order[s:e].copy()
             rng.shuffle(rows)
             n_train = max(1, int(round(len(rows) * ratio)))
             in_train[rows[:n_train]] = True

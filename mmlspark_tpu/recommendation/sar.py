@@ -170,9 +170,12 @@ class SARModel(_SARParams, Model):
         })
 
     def recommend_for_user_subset(self, table: Table, num_items: int) -> Table:
-        """Top-k for the unique user ids in ``table``
-        (``SARModel.recommendForUserSubset``, ``SARModel.scala:65``)."""
+        """Top-k for the unique user ids in ``table``; ids unseen at fit time
+        are dropped — the reference's left-semi join against the factor frame
+        (``SARModel.recommendForUserSubset``/``getSourceFactorSubset``,
+        ``SARModel.scala:65-88``)."""
         users = np.unique(table.column(self.getUserCol()).astype(np.int64))
+        users = users[(users >= 0) & (users < self.getUserAffinity().shape[0])]
         A = self.getUserAffinity()[users]
         idx, scores = self._recommend(A, num_items)
         return Table({
@@ -182,10 +185,17 @@ class SARModel(_SARParams, Model):
         })
 
     def transform(self, table: Table) -> Table:
-        """Scores each (user, item) row: affinity·similarity[:, item]."""
+        """Scores each (user, item) row: affinity·similarity[:, item].
+        Cold-start users/items unseen at fit time score 0.0."""
         users = table.column(self.getUserCol()).astype(np.int64)
         items = table.column(self.getItemCol()).astype(np.int64)
         A = self.getUserAffinity()
         S = self.getItemSimilarity()
-        scores = np.einsum("ij,ij->i", A[users], S[:, items].T)
-        return table.with_column("prediction", scores)
+        known = (
+            (users >= 0) & (users < A.shape[0])
+            & (items >= 0) & (items < S.shape[1])
+        )
+        u = np.where(known, users, 0)
+        i = np.where(known, items, 0)
+        scores = np.einsum("ij,ij->i", A[u], S[:, i].T)
+        return table.with_column("prediction", np.where(known, scores, 0.0))

@@ -1,0 +1,103 @@
+"""automl/ tests — mirrors reference ``automl/`` suites
+(VerifyTuneHyperparameters, VerifyFindBestModel)."""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.automl import (
+    DiscreteHyperParam,
+    DoubleRangeHyperParam,
+    FindBestModel,
+    GridSpace,
+    HyperparamBuilder,
+    IntRangeHyperParam,
+    TuneHyperparameters,
+)
+from mmlspark_tpu.data.table import Table
+from mmlspark_tpu.lightgbm import LightGBMClassifier
+
+
+@pytest.fixture
+def clf_table(rng):
+    X = rng.normal(size=(200, 5))
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float64)
+    return Table({"features": X, "label": y})
+
+
+class TestHyperparams:
+    def test_discrete(self):
+        rng = np.random.default_rng(0)
+        d = DiscreteHyperParam([1, 2, 3])
+        assert all(d.get_next(rng) in (1, 2, 3) for _ in range(20))
+
+    def test_ranges(self):
+        rng = np.random.default_rng(0)
+        r = IntRangeHyperParam(5, 10)
+        assert all(5 <= r.get_next(rng) < 10 for _ in range(50))
+        f = DoubleRangeHyperParam(0.1, 0.2)
+        assert all(0.1 <= f.get_next(rng) < 0.2 for _ in range(50))
+        with pytest.raises(ValueError):
+            IntRangeHyperParam(3, 3)
+
+    def test_builder_and_grid(self):
+        space = (
+            HyperparamBuilder()
+            .add_hyperparam("a", DiscreteHyperParam([1, 2]))
+            .add_hyperparam("b", DoubleRangeHyperParam(0, 1))
+            .build()
+        )
+        maps = list(space.param_maps(4))
+        assert len(maps) == 4 and all({"a", "b"} == set(m) for m in maps)
+        grid = GridSpace({"a": [1, 2], "b": ["x", "y"]})
+        assert len(list(grid.param_maps())) == 4
+
+
+class TestTuneHyperparameters:
+    def test_tune_improves_or_matches(self, clf_table):
+        tuned = TuneHyperparameters(
+            models=LightGBMClassifier(numIterations=10),
+            paramSpace={
+                "numLeaves": DiscreteHyperParam([3, 15]),
+                "learningRate": DoubleRangeHyperParam(0.05, 0.3),
+            },
+            evaluationMetric="accuracy",
+            numFolds=2,
+            numRuns=3,
+            seed=5,
+        ).fit(clf_table)
+        assert 0.5 <= tuned.getBestMetric() <= 1.0
+        assert len(tuned.getAllMetrics()) == 3
+        out = tuned.transform(clf_table)
+        assert "prediction" in out
+
+    def test_parallel_matches_serial(self, clf_table):
+        kwargs = dict(
+            models=LightGBMClassifier(numIterations=5),
+            paramSpace={"numLeaves": DiscreteHyperParam([3, 7])},
+            evaluationMetric="accuracy",
+            numFolds=2,
+            numRuns=2,
+            seed=1,
+        )
+        serial = TuneHyperparameters(parallelism=1, **kwargs).fit(clf_table)
+        parallel = TuneHyperparameters(parallelism=2, **kwargs).fit(clf_table)
+        np.testing.assert_allclose(serial.getAllMetrics(), parallel.getAllMetrics())
+
+
+class TestFindBestModel:
+    def test_picks_best(self, clf_table):
+        good = LightGBMClassifier(numIterations=20, numLeaves=15).fit(clf_table)
+        weak = LightGBMClassifier(numIterations=1, numLeaves=2).fit(clf_table)
+        best = FindBestModel(
+            models=[weak, good], evaluationMetric="accuracy"
+        ).fit(clf_table)
+        assert best.getBestModel() is good or (
+            best.getBestModelMetrics()
+            >= best.get_evaluated_models()["metric"].min()
+        )
+        evald = best.get_evaluated_models()
+        assert evald.num_rows == 2
+
+    def test_no_models_raises(self, clf_table):
+        with pytest.raises(ValueError):
+            FindBestModel(models=[]).fit(clf_table)

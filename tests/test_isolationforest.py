@@ -1,0 +1,72 @@
+"""isolationforest/ tests — mirrors reference ``isolationforest/``
+VerifyIsolationForest."""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.data.table import Table
+from mmlspark_tpu.isolationforest import IsolationForest, IsolationForestModel
+from mmlspark_tpu.isolationforest.forest import c_factor
+
+
+def test_c_factor_known_values():
+    assert c_factor(1) == 0.0
+    assert c_factor(2) == 1.0
+    # c(256) ~ 10.24 (standard iForest constant)
+    assert 10.0 < c_factor(256) < 10.5
+
+
+@pytest.fixture
+def anomaly_table(rng):
+    inliers = rng.normal(size=(300, 4))
+    outliers = rng.normal(size=(10, 4)) * 0.5 + 8.0
+    X = np.vstack([inliers, outliers])
+    return Table({"features": X}), np.array([0] * 300 + [1] * 10)
+
+
+def test_outliers_score_higher(anomaly_table):
+    table, truth = anomaly_table
+    model = IsolationForest(numEstimators=50, maxSamples=64.0).fit(table)
+    out = model.transform(table)
+    scores = out["outlierScore"]
+    assert scores.min() >= 0.0 and scores.max() <= 1.0
+    assert scores[truth == 1].mean() > scores[truth == 0].mean() + 0.1
+
+
+def test_contamination_threshold(anomaly_table):
+    table, truth = anomaly_table
+    model = IsolationForest(
+        numEstimators=50, maxSamples=64.0, contamination=10 / 310
+    ).fit(table)
+    out = model.transform(table)
+    flagged = out["predictedLabel"].astype(bool)
+    # most flagged rows are the planted outliers
+    assert flagged.sum() >= 5
+    assert truth[flagged].mean() > 0.6
+
+
+def test_deterministic_given_seed(anomaly_table):
+    table, _ = anomaly_table
+    a = IsolationForest(numEstimators=10, randomSeed=3).fit(table)
+    b = IsolationForest(numEstimators=10, randomSeed=3).fit(table)
+    np.testing.assert_allclose(
+        a.transform(table)["outlierScore"], b.transform(table)["outlierScore"]
+    )
+
+
+def test_save_load(anomaly_table, tmp_path):
+    table, _ = anomaly_table
+    model = IsolationForest(numEstimators=10).fit(table)
+    model.save(str(tmp_path / "iforest"))
+    loaded = IsolationForestModel.load(str(tmp_path / "iforest"))
+    np.testing.assert_allclose(
+        model.transform(table)["outlierScore"],
+        loaded.transform(table)["outlierScore"],
+    )
+
+
+def test_feature_subsampling(anomaly_table):
+    table, truth = anomaly_table
+    model = IsolationForest(numEstimators=60, maxFeatures=0.5).fit(table)
+    out = model.transform(table)
+    assert out["outlierScore"][truth == 1].mean() > out["outlierScore"][truth == 0].mean()

@@ -1,0 +1,139 @@
+"""lime/ tests — mirrors reference ``lime/`` suites (TabularLIMESuite,
+ImageLIMESuite, SuperpixelSuite)."""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core.pipeline import Transformer
+from mmlspark_tpu.data.table import Table
+from mmlspark_tpu.lime import (
+    ImageLIME,
+    SuperpixelTransformer,
+    TabularLIME,
+    fit_lasso_batch,
+    mask_image,
+    slic,
+)
+
+
+class _LinearModel(Transformer):
+    """Inner model: y = x @ w, exposes inputCol/predictionCol contract."""
+
+    def __init__(self, w, input_col="features", pred_col="prediction", **kw):
+        super().__init__(**kw)
+        self._w = np.asarray(w, dtype=np.float64)
+        self._in = input_col
+        self._out = pred_col
+
+    def transform(self, table):
+        X = np.asarray(table.column(self._in), dtype=np.float64)
+        if X.ndim > 2:  # image input: mean intensity per quadrant-weight
+            X = X.reshape(len(X), -1)[:, : len(self._w)]
+        return table.with_column(self._out, X @ self._w)
+
+
+class TestLasso:
+    def test_least_squares_recovery(self, rng):
+        # lambda=0 -> plain least squares; recover true weights
+        X = rng.normal(size=(4, 200, 3))
+        w_true = np.array([2.0, -1.0, 0.5])
+        y = X @ w_true
+        W = fit_lasso_batch(X, y, 0.0)
+        np.testing.assert_allclose(W, np.tile(w_true, (4, 1)), atol=1e-3)
+
+    def test_soft_threshold_sparsity(self, rng):
+        X = rng.normal(size=(1, 400, 5))
+        w_true = np.array([3.0, 0.0, 0.0, 0.0, 0.0])
+        y = X @ w_true
+        W = fit_lasso_batch(X, y, 0.5)
+        assert abs(W[0, 0]) > 2.0
+        assert np.abs(W[0, 1:]).max() < 0.2
+
+
+class TestTabularLIME:
+    def test_recovers_linear_model(self, rng):
+        w_true = np.array([1.5, -2.0, 0.0, 3.0])
+        X = rng.normal(size=(6, 4))
+        t = Table({"features": X})
+        lime = TabularLIME(
+            model=_LinearModel(w_true),
+            inputCol="features",
+            outputCol="weights",
+            nSamples=400,
+            seed=1,
+        )
+        model = lime.fit(t)
+        out = model.transform(t)
+        W = np.asarray(out["weights"], dtype=np.float64)
+        # local explanation of a global linear model = its weights, every row
+        np.testing.assert_allclose(W, np.tile(w_true, (6, 1)), atol=0.05)
+
+    def test_save_load(self, rng, tmp_path):
+        from mmlspark_tpu.lime import TabularLIMEModel
+
+        X = rng.normal(size=(3, 2))
+        model = TabularLIME(
+            model=_LinearModel(np.ones(2)), inputCol="features",
+            outputCol="w", nSamples=50,
+        ).fit(Table({"features": X}))
+        model.save(str(tmp_path / "lime"))
+        loaded = TabularLIMEModel.load(str(tmp_path / "lime"))
+        np.testing.assert_allclose(loaded.getColumnMeans(), model.getColumnMeans())
+
+
+class TestSuperpixel:
+    def test_slic_covers_image(self):
+        img = np.zeros((32, 32, 3))
+        img[:, 16:] = 1.0  # two homogeneous halves
+        sp = slic(img, cell_size=8)
+        assert sp.labels.shape == (32, 32)
+        assert sp.num_clusters >= 2
+        # every pixel belongs to exactly one cluster
+        total = sum(len(c) for c in sp.clusters)
+        assert total == 32 * 32
+
+    def test_mask_image(self):
+        img = np.ones((16, 16, 3))
+        sp = slic(img, cell_size=8)
+        none_on = mask_image(img, sp, np.zeros(sp.num_clusters, dtype=bool))
+        assert none_on.sum() == 0
+        all_on = mask_image(img, sp, np.ones(sp.num_clusters, dtype=bool))
+        np.testing.assert_array_equal(all_on, img)
+
+    def test_transformer(self):
+        imgs = np.stack([np.random.default_rng(0).random((16, 16, 3))] * 2)
+        t = Table({"image": imgs})
+        out = SuperpixelTransformer(inputCol="image", cellSize=8).transform(t)
+        assert out["superpixels"][0].num_clusters > 0
+
+
+class TestImageLIME:
+    def test_finds_informative_region(self, rng):
+        # model responds to top-left pixel block intensity
+        H = W = 16
+
+        class _RegionModel(Transformer):
+            def transform(self, table):
+                imgs = np.asarray(table.column("image"), dtype=np.float64)
+                score = imgs[:, :8, :8].mean(axis=(1, 2, 3))
+                return table.with_column("prediction", score)
+
+        img = rng.random((H, W, 3))
+        t = Table({"image": img[None]})
+        lime = ImageLIME(
+            model=_RegionModel(),
+            inputCol="image",
+            outputCol="weights",
+            predictionCol="prediction",
+            cellSize=8,
+            nSamples=200,
+            seed=2,
+        )
+        out = lime.transform(t)
+        sp = out["superpixels"][0]
+        w = out["weights"][0]
+        assert len(w) == sp.num_clusters
+        # clusters centered in the top-left quadrant should carry the weight
+        centers = np.array([c.mean(axis=0) for c in sp.clusters])
+        informative = (centers[:, 0] < 8) & (centers[:, 1] < 8)
+        assert w[informative].sum() > w[~informative].sum()

@@ -189,3 +189,16 @@ class TestRankingTVS:
         filtered = tvs._filter_min_ratings(events)
         # users 2 has only 2 events -> dropped
         assert 2 not in set(np.unique(filtered["user"]))
+
+
+def test_cold_start_ids(events):
+    # Regression: unseen user/item ids must not crash scoring.
+    model = SAR(supportThreshold=1).fit(events)
+    t = Table({"user": np.array([0, 99], dtype=np.int64),
+               "item": np.array([55, 0], dtype=np.int64)})
+    out = model.transform(t)
+    assert out["prediction"][0] == 0.0 and out["prediction"][1] == 0.0
+    recs = model.recommend_for_user_subset(
+        Table({"user": np.array([1, 42], dtype=np.int64),
+               "item": np.array([0, 0], dtype=np.int64)}), 2)
+    assert list(recs["user"]) == [1]
