@@ -1,0 +1,40 @@
+"""Cognitive services on Table (reference ``cognitive/``, SURVEY.md §2.17)."""
+
+from mmlspark_tpu.cognitive.base import CognitiveServicesBase, ServiceParam
+from mmlspark_tpu.cognitive.search import AddDocuments
+from mmlspark_tpu.cognitive.services import (
+    NER,
+    OCR,
+    AnalyzeImage,
+    BingImageSearch,
+    DetectAnomalies,
+    DetectFace,
+    EntityDetector,
+    FindSimilarFace,
+    GenerateThumbnails,
+    KeyPhraseExtractor,
+    LanguageDetector,
+    RecognizeText,
+    SpeechToText,
+    TextSentiment,
+)
+
+__all__ = [
+    "AddDocuments",
+    "AnalyzeImage",
+    "BingImageSearch",
+    "CognitiveServicesBase",
+    "DetectAnomalies",
+    "DetectFace",
+    "EntityDetector",
+    "FindSimilarFace",
+    "GenerateThumbnails",
+    "KeyPhraseExtractor",
+    "LanguageDetector",
+    "NER",
+    "OCR",
+    "RecognizeText",
+    "ServiceParam",
+    "SpeechToText",
+    "TextSentiment",
+]

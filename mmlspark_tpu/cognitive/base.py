@@ -1,0 +1,144 @@
+"""Cognitive-service base machinery.
+
+Reference: ``cognitive/CognitiveServiceBase.scala`` — ``ServiceParam``
+(value-or-column Either params, ``:29-151``) and ``CognitiveServicesBase``
+whose internal pipeline is Lambda(struct of dynamic cols) →
+SimpleHTTPTransformer → DropColumns (``:282-308``), with URL params and the
+subscription-key header (``:321+``).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Callable, Dict, List, Optional
+from urllib.parse import urlencode
+
+import numpy as np
+
+from mmlspark_tpu.core.params import HasOutputCol, Param, to_int, to_str
+from mmlspark_tpu.core.pipeline import Transformer
+from mmlspark_tpu.data.table import Table
+from mmlspark_tpu.io.http.schema import EntityData, HeaderData, HTTPRequestData
+from mmlspark_tpu.io.http.transformers import (
+    CustomInputParser,
+    JSONOutputParser,
+    SimpleHTTPTransformer,
+)
+
+
+class ServiceParam(Param):
+    """A param that holds either a constant value or a column name
+    (``ServiceParam`` Left/Right, ``CognitiveServiceBase.scala:29-151``).
+    Stored as ``("value", v)`` or ``("col", name)`` tuples."""
+
+    def __init__(self, doc: str = "", default: Any = None, is_url_param: bool = False):
+        super().__init__(doc=doc, default=default)
+        self.is_url_param = is_url_param
+
+
+class _HasServiceParams:
+    """Mixin providing setX/setXCol accessors for ServiceParams."""
+
+    def set_scalar(self, name: str, value: Any):
+        return self.set(name, ("value", value))
+
+    def set_vector(self, name: str, col: str):
+        return self.set(name, ("col", col))
+
+    def _resolve_service_param(self, name: str, table: Table, row: int) -> Any:
+        v = self.getOrDefault(name)
+        if v is None:
+            return None
+        kind, payload = v
+        if kind == "value":
+            return payload
+        cell = table.column(payload)[row]
+        return cell.tolist() if isinstance(cell, np.ndarray) else cell
+
+
+class CognitiveServicesBase(_HasServiceParams, HasOutputCol, Transformer):
+    """Base REST transformer. Subclasses define ``urlPath``, declare
+    ServiceParams, and implement ``prepare_entity`` (row dict -> JSON body)
+    — the ``CognitiveServicesBase.prepareEntity`` hook."""
+
+    subscriptionKey = ServiceParam("API key (value or column)")
+    url = Param("Service base URL", default=None)
+    errorCol = Param("Error column", default=None)
+    concurrency = Param("Max in-flight requests", default=4, converter=to_int)
+
+    _key_header = "Ocp-Apim-Subscription-Key"
+
+    def __init__(self, **kwargs):
+        # plain-string conveniences: subscriptionKey="k" means a constant
+        for name in list(kwargs):
+            param = getattr(type(self), name, None)
+            if isinstance(param, ServiceParam) and not (
+                isinstance(kwargs[name], tuple) and len(kwargs[name]) == 2
+                and kwargs[name][0] in ("value", "col")
+            ):
+                kwargs[name] = ("value", kwargs[name])
+        super().__init__(**kwargs)
+
+    # -- subclass hooks ------------------------------------------------------
+
+    def url_params(self, table: Table, row: int) -> Dict[str, str]:
+        out = {}
+        for name, p in self.params.items():
+            if isinstance(p, ServiceParam) and p.is_url_param:
+                v = self._resolve_service_param(name, table, row)
+                if v is not None:
+                    out[name] = str(v)
+        return out
+
+    def prepare_entity(self, table: Table, row: int) -> Optional[Dict[str, Any]]:
+        raise NotImplementedError
+
+    def prepare_method(self) -> str:
+        return "POST"
+
+    # -- request assembly ----------------------------------------------------
+
+    def _make_request(self, table: Table):
+        def build(row_and_table):
+            table, row = row_and_table
+            body = self.prepare_entity(table, row)
+            params = self.url_params(table, row)
+            url = self.getUrl()
+            if params:
+                url = f"{url}?{urlencode(params)}"
+            headers = {"Content-Type": "application/json"}
+            key = self._resolve_service_param("subscriptionKey", table, row)
+            if key:
+                headers[self._key_header] = key
+            entity = None
+            if body is not None:
+                entity = EntityData(
+                    content=json.dumps(body).encode("utf-8"),
+                    contentType="application/json",
+                )
+            return HTTPRequestData(
+                url=url,
+                method=self.prepare_method(),
+                headers=[HeaderData(k, v) for k, v in headers.items()],
+                entity=entity,
+            )
+
+        return build
+
+    def transform(self, table: Table) -> Table:
+        from mmlspark_tpu.data.table import find_unused_column_name
+
+        if self.getUrl() is None:
+            raise ValueError(f"{type(self).__name__} requires url")
+        idx_col = find_unused_column_name("_row", table)
+        indexed = table.with_column(idx_col, np.arange(table.num_rows))
+        build = self._make_request(table)
+        inner = SimpleHTTPTransformer(
+            inputCol=idx_col,
+            outputCol=self.getOutputCol(),
+            errorCol=self.getErrorCol(),
+            concurrency=self.getConcurrency(),
+            inputParser=CustomInputParser(udf=lambda row: build((table, int(row)))),
+            outputParser=JSONOutputParser(),
+        )
+        return inner.transform(indexed).drop(idx_col)

@@ -1,0 +1,171 @@
+"""Cognitive-service transformers (reference ``cognitive/``, SURVEY.md §2.17).
+
+Each service is a thin :class:`CognitiveServicesBase` subclass declaring its
+request shape — the heavy lifting (HTTP, retries, error columns, key
+headers) lives in the base. Live-endpoint tests are impossible without
+network egress; suites exercise these against in-process mock servers, the
+pattern the reference's serving suites use (``io/split2/HTTPv2Suite``).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, Optional
+
+from mmlspark_tpu.cognitive.base import CognitiveServicesBase, ServiceParam
+from mmlspark_tpu.core.params import Param, to_str
+from mmlspark_tpu.data.table import Table
+
+
+class _TextAnalyticsBase(CognitiveServicesBase):
+    """documents batch body (``cognitive/TextAnalytics.scala``)."""
+
+    textCol = Param("Column of input text", default="text", converter=to_str)
+    language = ServiceParam("Language hint", default=("value", "en"))
+
+    def prepare_entity(self, table: Table, row: int) -> Dict[str, Any]:
+        lang = self._resolve_service_param("language", table, row)
+        return {
+            "documents": [
+                {"id": "0", "language": lang,
+                 "text": str(table.column(self.textCol)[row])}
+            ]
+        }
+
+
+class TextSentiment(_TextAnalyticsBase):
+    """``cognitive/TextAnalytics.scala`` TextSentiment."""
+
+
+class LanguageDetector(_TextAnalyticsBase):
+    """``cognitive/TextAnalytics.scala`` LanguageDetector."""
+
+    def prepare_entity(self, table: Table, row: int) -> Dict[str, Any]:
+        return {
+            "documents": [
+                {"id": "0", "text": str(table.column(self.textCol)[row])}
+            ]
+        }
+
+
+class EntityDetector(_TextAnalyticsBase):
+    """``cognitive/TextAnalytics.scala`` EntityDetector."""
+
+
+class KeyPhraseExtractor(_TextAnalyticsBase):
+    """``cognitive/TextAnalytics.scala`` KeyPhraseExtractor."""
+
+
+class NER(_TextAnalyticsBase):
+    """``cognitive/TextAnalytics.scala`` NER."""
+
+
+class _ImageServiceBase(CognitiveServicesBase):
+    """Image-URL body (``cognitive/ComputerVision.scala`` HasImageUrl)."""
+
+    imageUrlCol = Param("Column of image URLs", default="url", converter=to_str)
+
+    def prepare_entity(self, table: Table, row: int) -> Dict[str, Any]:
+        return {"url": str(table.column(self.imageUrlCol)[row])}
+
+
+class OCR(_ImageServiceBase):
+    """``cognitive/ComputerVision.scala`` OCR."""
+
+    detectOrientation = ServiceParam("Detect orientation", is_url_param=True)
+
+
+class AnalyzeImage(_ImageServiceBase):
+    """``cognitive/ComputerVision.scala`` AnalyzeImage."""
+
+    visualFeatures = ServiceParam("Comma-joined feature list", is_url_param=True)
+
+
+class RecognizeText(_ImageServiceBase):
+    """``cognitive/ComputerVision.scala`` RecognizeText (async
+    polling-location flow collapses to one call against mocks)."""
+
+    mode = ServiceParam("Printed|Handwritten", is_url_param=True)
+
+
+class GenerateThumbnails(_ImageServiceBase):
+    """``cognitive/ComputerVision.scala`` GenerateThumbnails."""
+
+    width = ServiceParam("Thumb width", is_url_param=True)
+    height = ServiceParam("Thumb height", is_url_param=True)
+    smartCropping = ServiceParam("Smart crop", is_url_param=True)
+
+
+class DetectFace(_ImageServiceBase):
+    """``cognitive/Face.scala`` DetectFace."""
+
+    returnFaceAttributes = ServiceParam("Attribute list", is_url_param=True)
+    returnFaceLandmarks = ServiceParam("Landmarks flag", is_url_param=True)
+
+
+class FindSimilarFace(CognitiveServicesBase):
+    """``cognitive/Face.scala`` FindSimilarFace."""
+
+    faceIdCol = Param("Column of face ids", default="faceId", converter=to_str)
+    faceIds = ServiceParam("Candidate face id list")
+
+    def prepare_entity(self, table: Table, row: int) -> Dict[str, Any]:
+        return {
+            "faceId": str(table.column(self.faceIdCol)[row]),
+            "faceIds": self._resolve_service_param("faceIds", table, row) or [],
+        }
+
+
+class DetectAnomalies(CognitiveServicesBase):
+    """``cognitive/AnamolyDetection.scala:23-160`` DetectAnomalies: series of
+    (timestamp, value) points + granularity."""
+
+    seriesCol = Param("Column of point-dict lists", default="series", converter=to_str)
+    granularity = ServiceParam("Series granularity", default=("value", "daily"))
+
+    def prepare_entity(self, table: Table, row: int) -> Dict[str, Any]:
+        series = table.column(self.seriesCol)[row]
+        if hasattr(series, "tolist"):
+            series = series.tolist()
+        return {
+            "series": list(series),
+            "granularity": self._resolve_service_param("granularity", table, row),
+        }
+
+
+class SpeechToText(CognitiveServicesBase):
+    """``cognitive/SpeechToText.scala`` REST speech recognition: binary audio
+    body (the native Speech SDK streaming variant is out of TPU scope —
+    SURVEY.md §2.20 item 5 keeps it a host HTTP client)."""
+
+    audioDataCol = Param("Column of audio bytes", default="audio", converter=to_str)
+    format = ServiceParam("simple|detailed", is_url_param=True)
+    language = ServiceParam("Recognition language", is_url_param=True,
+                            default=("value", "en-US"))
+
+    def prepare_entity(self, table: Table, row: int) -> Dict[str, Any]:
+        import base64
+
+        audio = table.column(self.audioDataCol)[row]
+        if isinstance(audio, bytes):
+            audio = base64.b64encode(audio).decode("ascii")
+        return {"audio": audio}
+
+
+class BingImageSearch(CognitiveServicesBase):
+    """``cognitive/BingImageSearch.scala:27-66``: GET with query url param."""
+
+    queryCol = Param("Column of search queries", default="q", converter=to_str)
+    count = ServiceParam("Result count", is_url_param=True)
+    offset = ServiceParam("Result offset", is_url_param=True)
+
+    def prepare_method(self) -> str:
+        return "GET"
+
+    def prepare_entity(self, table: Table, row: int) -> Optional[Dict[str, Any]]:
+        return None
+
+    def url_params(self, table: Table, row: int) -> Dict[str, str]:
+        out = super().url_params(table, row)
+        out["q"] = str(table.column(self.queryCol)[row])
+        return out

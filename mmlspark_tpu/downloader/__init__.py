@@ -1,0 +1,19 @@
+"""Pre-trained model downloader (reference ``downloader/``, SURVEY.md §2.14)."""
+
+from mmlspark_tpu.downloader.repository import (
+    FaultToleranceUtils,
+    LocalRepo,
+    ModelDownloader,
+    ModelSchema,
+    RemoteRepo,
+    Repository,
+)
+
+__all__ = [
+    "FaultToleranceUtils",
+    "LocalRepo",
+    "ModelDownloader",
+    "ModelSchema",
+    "RemoteRepo",
+    "Repository",
+]

@@ -1,0 +1,38 @@
+"""HTTP-on-Table (reference ``io/http/``, SURVEY.md §2.15)."""
+
+from mmlspark_tpu.io.http.clients import AsyncHTTPClient, HTTPClient
+from mmlspark_tpu.io.http.schema import (
+    EntityData,
+    HeaderData,
+    HTTPRequestData,
+    HTTPResponseData,
+    StatusLineData,
+)
+from mmlspark_tpu.io.http.transformers import (
+    CustomInputParser,
+    CustomOutputParser,
+    HTTPTransformer,
+    JSONInputParser,
+    JSONOutputParser,
+    PartitionConsolidator,
+    SimpleHTTPTransformer,
+    StringOutputParser,
+)
+
+__all__ = [
+    "AsyncHTTPClient",
+    "CustomInputParser",
+    "CustomOutputParser",
+    "EntityData",
+    "HTTPClient",
+    "HTTPRequestData",
+    "HTTPResponseData",
+    "HTTPTransformer",
+    "HeaderData",
+    "JSONInputParser",
+    "JSONOutputParser",
+    "PartitionConsolidator",
+    "SimpleHTTPTransformer",
+    "StatusLineData",
+    "StringOutputParser",
+]

@@ -1,0 +1,89 @@
+"""HTTP request/response as typed records.
+
+Reference: ``io/http/HTTPSchema.scala`` (``HTTPRequestData:162``,
+``HTTPResponseData:90``, ``HeaderData:26``, ``EntityData:38``,
+``StatusLineData:76`` — full HTTP messages as Spark StructTypes via
+SparkBindings). Here they are plain dataclasses stored in object columns;
+the Table analogue of the struct columns.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+
+@dataclass
+class HeaderData:
+    name: str
+    value: str
+
+
+@dataclass
+class EntityData:
+    content: bytes = b""
+    contentType: Optional[str] = None
+    contentEncoding: Optional[str] = None
+    isChunked: bool = False
+    isRepeatable: bool = True
+    isStreaming: bool = False
+
+    def text(self, encoding: str = "utf-8") -> str:
+        return self.content.decode(encoding, errors="replace")
+
+    def json(self):
+        return json.loads(self.text())
+
+
+@dataclass
+class StatusLineData:
+    protocolVersion: str
+    statusCode: int
+    reasonPhrase: str
+
+
+@dataclass
+class HTTPRequestData:
+    """One HTTP request (``HTTPRequestData`` case class)."""
+
+    url: str
+    method: str = "GET"
+    headers: List[HeaderData] = field(default_factory=list)
+    entity: Optional[EntityData] = None
+
+    @classmethod
+    def from_json(cls, url: str, payload, method: str = "POST",
+                  headers: Optional[Dict[str, str]] = None) -> "HTTPRequestData":
+        """Row -> JSON POST (the ``JSONInputParser`` construction,
+        ``io/http/Parsers.scala:24-77``)."""
+        hdrs = [HeaderData(k, v) for k, v in (headers or {}).items()]
+        hdrs.append(HeaderData("Content-Type", "application/json"))
+        body = json.dumps(payload).encode("utf-8")
+        return cls(url=url, method=method, headers=hdrs,
+                   entity=EntityData(content=body, contentType="application/json"))
+
+    def header_map(self) -> Dict[str, str]:
+        return {h.name: h.value for h in self.headers}
+
+
+@dataclass
+class HTTPResponseData:
+    """One HTTP response (``HTTPResponseData`` case class)."""
+
+    statusLine: StatusLineData
+    headers: List[HeaderData] = field(default_factory=list)
+    entity: Optional[EntityData] = None
+
+    @property
+    def status_code(self) -> int:
+        return self.statusLine.statusCode
+
+    def header_map(self) -> Dict[str, str]:
+        return {h.name: h.value for h in self.headers}
+
+    def text(self) -> str:
+        return self.entity.text() if self.entity else ""
+
+    def json(self):
+        return self.entity.json() if self.entity else None

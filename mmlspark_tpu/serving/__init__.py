@@ -1,0 +1,9 @@
+"""Model serving (reference Spark Serving, SURVEY.md §2.16)."""
+
+from mmlspark_tpu.serving.server import (
+    DistributedServingServer,
+    ServiceInfo,
+    ServingServer,
+)
+
+__all__ = ["DistributedServingServer", "ServiceInfo", "ServingServer"]

@@ -1,0 +1,66 @@
+"""In-process mock HTTP servers for io/serving/cognitive suites — the
+reference pattern of starting real servers and hitting them with real
+clients (``io/split2/HTTPv2Suite.scala``, ``DistributedHTTPSuite``)."""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+class MockService:
+    """Configurable echo/JSON server. ``behavior(path, body_dict) -> (status,
+    payload_dict, extra_headers)``."""
+
+    def __init__(self, behavior=None):
+        self.behavior = behavior or (lambda path, body: (200, {"echo": body}, {}))
+        self.requests = []
+        self._lock = threading.Lock()
+        mock = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def _respond(self):
+                length = int(self.headers.get("Content-Length", 0))
+                raw = self.rfile.read(length)
+                try:
+                    body = json.loads(raw) if raw else None
+                except json.JSONDecodeError:
+                    body = raw.decode("utf-8", "replace")
+                with mock._lock:
+                    mock.requests.append({
+                        "path": self.path,
+                        "method": self.command,
+                        "headers": dict(self.headers),
+                        "body": body,
+                    })
+                status, payload, extra = mock.behavior(self.path, body)
+                data = json.dumps(payload).encode("utf-8")
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                for k, v in extra.items():
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(data)
+
+            do_POST = do_GET = do_PUT = _respond
+
+            def log_message(self, *args):
+                pass
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self._httpd.server_address[1]
+        self.url = f"http://127.0.0.1:{self.port}"
+
+    def start(self):
+        threading.Thread(target=self._httpd.serve_forever, daemon=True).start()
+        return self
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()

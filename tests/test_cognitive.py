@@ -1,0 +1,93 @@
+"""cognitive/ tests against in-process mocks (live Azure endpoints need
+egress; the reference tags those suites flaky/secret-gated —
+``pipeline.yaml:270-275``)."""
+
+import numpy as np
+import pytest
+
+from http_mock import MockService
+from mmlspark_tpu.cognitive import (
+    AddDocuments,
+    BingImageSearch,
+    DetectAnomalies,
+    TextSentiment,
+)
+from mmlspark_tpu.data.table import Table
+
+
+class TestTextSentiment:
+    def test_request_shape_and_key_header(self):
+        def behavior(path, body):
+            assert body["documents"][0]["language"] == "en"
+            return 200, {"documents": [{"id": "0", "score": 0.9}]}, {}
+
+        with MockService(behavior) as svc:
+            t = Table({"text": np.array(["great product", "awful"], dtype=object)})
+            out = TextSentiment(
+                url=svc.url, subscriptionKey="k123", textCol="text",
+                outputCol="sentiment",
+            ).transform(t)
+            assert out["sentiment"][0]["documents"][0]["score"] == 0.9
+            sent = svc.requests[0]
+            assert sent["headers"]["Ocp-Apim-Subscription-Key"] == "k123"
+
+    def test_language_from_column(self):
+        with MockService(lambda p, b: (200, b, {})) as svc:
+            t = Table({
+                "text": np.array(["hola", "hello"], dtype=object),
+                "lang": np.array(["es", "en"], dtype=object),
+            })
+            ts = TextSentiment(url=svc.url, textCol="text", outputCol="o")
+            ts.set_vector("language", "lang")
+            out = ts.transform(t)
+            langs = sorted(r["documents"][0]["language"] for r in out["o"])
+            assert langs == ["en", "es"]
+
+
+class TestDetectAnomalies:
+    def test_series_body(self):
+        def behavior(path, body):
+            assert body["granularity"] == "daily"
+            assert len(body["series"]) == 3
+            return 200, {"isAnomaly": [False, False, True]}, {}
+
+        series = [
+            [{"timestamp": f"2026-01-0{i}", "value": float(v)} for i, v in
+             enumerate([1, 1, 99], start=1)]
+        ]
+        with MockService(behavior) as svc:
+            t = Table({"series": np.array(series, dtype=object)})
+            out = DetectAnomalies(
+                url=svc.url, seriesCol="series", outputCol="anomalies"
+            ).transform(t)
+            assert out["anomalies"][0]["isAnomaly"][-1] is True
+
+
+class TestBingImageSearch:
+    def test_get_with_query_param(self):
+        with MockService(lambda p, b: (200, {"value": []}, {})) as svc:
+            t = Table({"q": np.array(["cats"], dtype=object)})
+            BingImageSearch(url=svc.url, queryCol="q", outputCol="imgs",
+                            count=5).transform(t)
+            sent = svc.requests[0]
+            assert sent["method"] == "GET"
+            assert "q=cats" in sent["path"] and "count=5" in sent["path"]
+
+
+class TestAddDocuments:
+    def test_batched_upload(self):
+        with MockService(lambda p, b: (200, {"value": []}, {})) as svc:
+            t = Table({
+                "id": np.array(["a", "b", "c"], dtype=object),
+                "score": np.array([1.0, 2.0, 3.0]),
+            })
+            out = AddDocuments(
+                url=svc.url, subscriptionKey="key", batchSize=2
+            ).transform(t)
+            assert list(out["indexStatus"]) == [200, 200, 200]
+            assert len(svc.requests) == 2  # 2 + 1 docs
+            first = svc.requests[0]["body"]["value"]
+            assert first[0]["@search.action"] == "upload"
+            assert first[0]["id"] == "a" and first[0]["score"] == 1.0
+            headers = {k.lower(): v for k, v in svc.requests[0]["headers"].items()}
+            assert headers["api-key"] == "key"  # header names are case-insensitive

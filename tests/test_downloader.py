@@ -1,0 +1,69 @@
+"""downloader/ tests — mirrors reference ``downloader/`` DownloaderSuite."""
+
+import json
+import os
+
+import pytest
+
+from mmlspark_tpu.downloader import (
+    FaultToleranceUtils,
+    LocalRepo,
+    ModelDownloader,
+    ModelSchema,
+)
+
+
+def test_schema_roundtrip():
+    s = ModelSchema(name="resnet50", uri="resnet50.bin", inputNode="input",
+                    layerNames=["fc", "pool"])
+    s2 = ModelSchema.from_json(s.to_json())
+    assert s2 == s
+
+
+def test_local_repo_add_list_download(tmp_path):
+    repo_dir = str(tmp_path / "repo")
+    cache_dir = str(tmp_path / "cache")
+    repo = LocalRepo(repo_dir)
+    repo.add(ModelSchema(name="m1", uri=""), b"payload-bytes")
+    dl = ModelDownloader(cache_dir, repo)
+    models = dl.list_models()
+    assert [m.name for m in models] == ["m1"]
+    path = dl.download_by_name("m1")
+    with open(path, "rb") as f:
+        assert f.read() == b"payload-bytes"
+    # cached second call returns same file without re-fetching
+    assert dl.download_by_name("m1") == path
+
+
+def test_hash_mismatch_raises(tmp_path):
+    repo_dir = str(tmp_path / "repo")
+    repo = LocalRepo(repo_dir)
+    repo.add(ModelSchema(name="m", uri=""), b"data")
+    # corrupt the payload after hashing
+    with open(os.path.join(repo_dir, "m.bin"), "wb") as f:
+        f.write(b"tampered")
+    dl = ModelDownloader(str(tmp_path / "cache"), repo)
+    with pytest.raises(IOError):
+        dl.download_by_name("m")
+
+
+def test_missing_model_raises(tmp_path):
+    dl = ModelDownloader(str(tmp_path / "cache"), LocalRepo(str(tmp_path / "repo")))
+    with pytest.raises(KeyError):
+        dl.download_by_name("nope")
+
+
+def test_retry_with_timeout():
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise IOError("transient")
+        return "ok"
+
+    assert FaultToleranceUtils.retry_with_timeout(flaky, times=3, backoff=0.01) == "ok"
+    with pytest.raises(IOError):
+        FaultToleranceUtils.retry_with_timeout(
+            lambda: (_ for _ in ()).throw(IOError("always")), times=2, backoff=0.01
+        )

@@ -1,0 +1,131 @@
+"""io/http tests — real in-process servers + real clients, the reference's
+serving-suite pattern (SURVEY.md §4)."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from http_mock import MockService
+from mmlspark_tpu.data.table import Table
+from mmlspark_tpu.io.http import (
+    AsyncHTTPClient,
+    HTTPClient,
+    HTTPRequestData,
+    HTTPTransformer,
+    JSONInputParser,
+    JSONOutputParser,
+    PartitionConsolidator,
+    SimpleHTTPTransformer,
+    StringOutputParser,
+)
+
+
+class TestClients:
+    def test_roundtrip(self):
+        with MockService() as svc:
+            resp = HTTPClient().send(
+                HTTPRequestData.from_json(svc.url, {"x": 1})
+            )
+            assert resp.status_code == 200
+            assert resp.json() == {"echo": {"x": 1}}
+
+    def test_retry_on_429_with_retry_after(self):
+        calls = {"n": 0}
+
+        def behavior(path, body):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                return 429, {"error": "throttled"}, {"Retry-After": "0.05"}
+            return 200, {"ok": True}, {}
+
+        with MockService(behavior) as svc:
+            resp = HTTPClient(retries=(0.01,)).send(
+                HTTPRequestData.from_json(svc.url, {})
+            )
+            assert resp.status_code == 200 and calls["n"] == 2
+
+    def test_gives_up_after_retries(self):
+        with MockService(lambda p, b: (503, {}, {})) as svc:
+            resp = HTTPClient(retries=(0.01, 0.01)).send(
+                HTTPRequestData.from_json(svc.url, {})
+            )
+            assert resp.status_code == 503
+
+    def test_async_order_and_nulls(self):
+        with MockService(lambda p, b: (200, {"v": b["i"]}, {})) as svc:
+            reqs = [
+                None if i % 3 == 0 else HTTPRequestData.from_json(svc.url, {"i": i})
+                for i in range(10)
+            ]
+            out = AsyncHTTPClient(concurrency=4).send_all(reqs)
+            for i, r in enumerate(out):
+                if i % 3 == 0:
+                    assert r is None
+                else:
+                    assert r.json() == {"v": i}
+
+
+class TestTransformers:
+    def test_http_transformer(self):
+        with MockService() as svc:
+            t = Table({"req": np.array(
+                [HTTPRequestData.from_json(svc.url, {"i": i}) for i in range(5)],
+                dtype=object,
+            )})
+            out = HTTPTransformer(inputCol="req", outputCol="resp").transform(t)
+            assert all(r.status_code == 200 for r in out["resp"])
+
+    def test_simple_http_transformer(self):
+        with MockService(lambda p, b: (200, {"sentiment": "pos"}, {})) as svc:
+            t = Table({"text": np.array(["a", "b"], dtype=object)})
+            out = SimpleHTTPTransformer(
+                inputCol="text",
+                outputCol="parsed",
+                inputParser=JSONInputParser(url=svc.url),
+                outputParser=JSONOutputParser(),
+            ).transform(t)
+            assert out["parsed"][0] == {"sentiment": "pos"}
+            assert out["parsed_error"][0] is None
+
+    def test_simple_http_error_column(self):
+        def behavior(path, body):
+            if body == "bad":
+                return 400, {"error": "nope"}, {}
+            return 200, {"ok": True}, {}
+
+        with MockService(behavior) as svc:
+            t = Table({"text": np.array(["good", "bad"], dtype=object)})
+            out = SimpleHTTPTransformer(
+                inputCol="text",
+                outputCol="parsed",
+                inputParser=JSONInputParser(url=svc.url),
+                outputParser=JSONOutputParser(),
+            ).transform(t)
+            assert out["parsed"][0] == {"ok": True}
+            assert out["parsed"][1] is None
+            assert "400" in out["parsed_error"][1]
+
+    def test_string_output_parser(self):
+        with MockService(lambda p, b: (200, {"x": 1}, {})) as svc:
+            t = Table({"text": np.array(["q"], dtype=object)})
+            out = SimpleHTTPTransformer(
+                inputCol="text",
+                outputCol="raw",
+                inputParser=JSONInputParser(url=svc.url),
+                outputParser=StringOutputParser(),
+            ).transform(t)
+            assert out["raw"][0] == '{"x": 1}'
+
+    def test_partition_consolidator_shares_client(self):
+        with MockService() as svc:
+            reqs = np.array(
+                [HTTPRequestData.from_json(svc.url, {"i": i}) for i in range(4)],
+                dtype=object,
+            )
+            t = Table({"req": reqs})
+            c = PartitionConsolidator(inputCol="req", outputCol="resp", concurrency=2)
+            out1 = c.transform(t)
+            out2 = c.transform(t)
+            assert all(r.status_code == 200 for r in out1["resp"])
+            assert all(r.status_code == 200 for r in out2["resp"])

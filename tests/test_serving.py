@@ -1,0 +1,102 @@
+"""serving/ tests — real servers + real clients, matching the reference
+``HTTPv2Suite``/``DistributedHTTPSuite`` approach (latency + fault paths)."""
+
+import json
+import time
+import urllib.request
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core.pipeline import Transformer
+from mmlspark_tpu.data.table import Table
+from mmlspark_tpu.serving import DistributedServingServer, ServingServer
+
+
+class _Doubler(Transformer):
+    def transform(self, table):
+        x = np.asarray(table.column("input"), dtype=np.float64)
+        return table.with_column("prediction", x * 2)
+
+
+def _post(url, payload, timeout=10):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(), method="POST",
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read())
+
+
+class TestServingServer:
+    def test_single_request(self):
+        with ServingServer(_Doubler(), max_latency_ms=1.0) as srv:
+            status, out = _post(srv.info.url, {"input": 21.0})
+            assert status == 200 and out["prediction"] == 42.0
+
+    def test_vector_payloads(self):
+        class VecModel(Transformer):
+            def transform(self, table):
+                X = np.asarray(table.column("input"), dtype=np.float64)
+                return table.with_column("prediction", X.sum(axis=1))
+
+        with ServingServer(VecModel()) as srv:
+            status, out = _post(srv.info.url, {"input": [1.0, 2.0, 3.0]})
+            assert status == 200 and out["prediction"] == 6.0
+
+    def test_concurrent_batching(self):
+        with ServingServer(_Doubler(), max_batch_size=16, max_latency_ms=5.0) as srv:
+            with ThreadPoolExecutor(max_workers=16) as pool:
+                results = list(pool.map(
+                    lambda i: _post(srv.info.url, {"input": float(i)}),
+                    range(32),
+                ))
+            assert all(s == 200 for s, _ in results)
+            assert [o["prediction"] for _, o in results] == [2.0 * i for i in range(32)]
+
+    def test_model_error_returns_500(self):
+        class Exploder(Transformer):
+            def transform(self, table):
+                raise RuntimeError("boom")
+
+        with ServingServer(Exploder()) as srv:
+            try:
+                status, _ = _post(srv.info.url, {"input": 1.0})
+            except urllib.error.HTTPError as e:
+                status = e.code
+            assert status == 500
+
+    def test_invalid_json_400(self):
+        with ServingServer(_Doubler()) as srv:
+            req = urllib.request.Request(
+                srv.info.url, data=b"{not json", method="POST")
+            try:
+                urllib.request.urlopen(req, timeout=5)
+                status = 200
+            except urllib.error.HTTPError as e:
+                status = e.code
+            assert status == 400
+
+    def test_latency_single_row(self):
+        # p50 well under the 5ms BASELINE target for a trivial model on CPU;
+        # the real-chip number is measured by bench configs.
+        with ServingServer(_Doubler(), max_latency_ms=0.5) as srv:
+            _post(srv.info.url, {"input": 1.0})  # warmup
+            times = []
+            for i in range(30):
+                t0 = time.perf_counter()
+                _post(srv.info.url, {"input": float(i)})
+                times.append(time.perf_counter() - t0)
+            p50 = sorted(times)[len(times) // 2]
+            assert p50 < 0.05, f"p50 {p50 * 1e3:.1f}ms"
+
+
+class TestDistributedServing:
+    def test_multiple_endpoints(self):
+        with DistributedServingServer(_Doubler(), num_servers=3) as srv:
+            infos = srv.service_info
+            assert len({i.port for i in infos}) == 3
+            for info in infos:
+                status, out = _post(info.url, {"input": 2.0})
+                assert status == 200 and out["prediction"] == 4.0
