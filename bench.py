@@ -1,14 +1,20 @@
-"""Headline benchmark: GBDT training throughput on TPU vs host CPU.
+"""Headline benchmark: GBDT training on TPU vs a REAL CPU GBDT.
 
 Prints ONE JSON line:
-  {"metric": "...", "value": N, "unit": "...", "vs_baseline": N}
+  {"metric": "...", "value": N, "unit": "...", "vs_baseline": N, ...}
 
 Workload: binary-classification boosting on a Higgs-like dense matrix
-(BASELINE.json config 3's shape at bench-friendly scale). ``value`` is
-TPU row-iterations/sec (rows × boosting iterations / wall time, steady
-state, compile excluded). ``vs_baseline`` is the speedup over the same
-jitted program on the host CPU backend — the reference's LightGBM runs
-on CPU, and BASELINE.md's north-star target is ≥10× CPU rows/sec.
+(BASELINE.json config 3's shape at bench-friendly scale), leaf-wise growth
+with LightGBM-default 31 leaves — the flagship semantics.
+
+``value`` is TPU row-iterations/sec (rows × boosting iterations / fit wall
+time; binning included, one-time XLA compile excluded — production runs hit
+the persistent compilation cache). ``vs_baseline`` is the speedup over
+sklearn's ``HistGradientBoostingClassifier`` — the same histogram-GBDT
+algorithm family as LightGBM, run at matched settings (same rows, features,
+iterations, leaves, bins, learning rate; median of 3 runs). Both sides also
+report held-out AUC so the comparison is at matched quality, per the
+"identical AUC" clause of the ≥10× north star (BASELINE.md).
 """
 
 import json
@@ -20,103 +26,115 @@ import numpy as np
 
 N_ROWS = int(os.environ.get("BENCH_ROWS", 400_000))
 N_FEATURES = int(os.environ.get("BENCH_FEATURES", 28))
-N_ITERS = int(os.environ.get("BENCH_ITERS", 10))
-N_WARMUP = 2
-CPU_ROWS = min(N_ROWS, 100_000)  # CPU baseline measured at reduced scale
+N_ITERS = int(os.environ.get("BENCH_ITERS", 30))
+N_TEST = 50_000
+NUM_LEAVES = 31
+LEARNING_RATE = 0.1
+MAX_BIN = 255
+CPU_RUNS = 3
 
 
 def _make_data(n, f, seed=0):
     rng = np.random.default_rng(seed)
-    X = rng.normal(size=(n, f))
-    logit = X[:, 0] * 1.5 + X[:, 1] * X[:, 2] + 0.5 * rng.normal(size=n)
+    X = rng.normal(size=(n, f)).astype(np.float64)
+    logit = (
+        X[:, 0] * 1.5
+        + X[:, 1] * X[:, 2]
+        + 0.8 * np.sin(X[:, 3])
+        + 0.5 * rng.normal(size=n)
+    )
     y = (logit > 0).astype(np.float64)
     return X, y
 
 
-def _throughput(n_rows, n_feat, iters, warmup):
-    """Steady-state row-iterations/sec of the jitted boosting step on the
-    current JAX backend."""
-    import jax
+def _auc(y, score):
+    from mmlspark_tpu.lightgbm.objectives import auc
 
+    return auc(y, score, np.ones(len(y)))
+
+
+def _fit_tpu(X, y, Xt):
+    """Returns (fit_seconds excluding compile, test margins)."""
     from mmlspark_tpu.lightgbm.binning import bin_dataset
-    from mmlspark_tpu.lightgbm.objectives import get_objective
-    from mmlspark_tpu.lightgbm.train import TrainOptions, _make_step
+    from mmlspark_tpu.lightgbm.train import TrainOptions, train
 
-    X, y = _make_data(n_rows, n_feat)
-    bins, mapper = bin_dataset(X)
-    opts = TrainOptions(objective="binary", num_leaves=31)
-    objective = get_objective("binary")
-    num_bins = opts.max_bin + 1
-    step = _make_step(opts, objective, num_bins)
-
-    import jax.numpy as jnp
-
-    edges = np.where(np.isfinite(mapper.edges), mapper.edges, np.finfo(np.float32).max)
-    bins_d = jnp.asarray(bins, dtype=jnp.int32)
-    y_d = jnp.asarray(y, dtype=jnp.float32)
-    w_d = jnp.ones(n_rows, dtype=jnp.float32)
-    edges_d = jnp.asarray(edges, dtype=jnp.float32)
-    bag = jnp.ones(n_rows, dtype=jnp.float32)
-    fm = jnp.ones(n_feat, dtype=jnp.float32)
-    init = objective.init_score(y, 1, np.ones(n_rows))
-    margins = jnp.broadcast_to(jnp.asarray(init)[None, :], (n_rows, 1)).astype(jnp.float32)
-
-    for _ in range(warmup):
-        sf, sb, st, lv, margins = step(bins_d, y_d, w_d, margins, edges_d, bag, fm)
-    jax.block_until_ready(margins)
+    opts = TrainOptions(
+        objective="binary",
+        num_iterations=N_ITERS,
+        num_leaves=NUM_LEAVES,
+        learning_rate=LEARNING_RATE,
+        max_bin=MAX_BIN,
+        growth="leafwise",
+    )
+    # Compile warm-up: jit programs are shape-specialized, so run ONE
+    # full-size fit untimed; the timed run below then hits the in-process
+    # executable cache and measures binning + boosting only.
+    bins, mapper = bin_dataset(X, max_bin=MAX_BIN)
+    train(bins, y, opts, mapper=mapper)
 
     t0 = time.perf_counter()
-    for _ in range(iters):
-        sf, sb, st, lv, margins = step(bins_d, y_d, w_d, margins, edges_d, bag, fm)
-    jax.block_until_ready(margins)
+    bins, mapper = bin_dataset(X, max_bin=MAX_BIN)
+    result = train(bins, y, opts, mapper=mapper)
     dt = time.perf_counter() - t0
-    return n_rows * iters / dt
+    margins = result.booster.raw_margin(Xt)[:, 0]
+    return dt, margins
 
 
-def _cpu_baseline_subprocess() -> float:
-    """Measure the CPU baseline in a clean subprocess: once TPU compute has
-    run in a process, backend switching silently keeps dispatching to TPU,
-    so an in-process 'CPU' measurement would be bogus."""
-    import subprocess
+def _fit_cpu(X, y, Xt):
+    """sklearn HistGradientBoosting (LightGBM-style CPU GBDT); median of
+    CPU_RUNS fits for a stable baseline."""
+    from sklearn.ensemble import HistGradientBoostingClassifier
 
-    env = dict(os.environ, JAX_PLATFORMS="cpu")
-    out = subprocess.run(
-        [sys.executable, os.path.abspath(__file__), "--cpu-baseline"],
-        capture_output=True, text=True, env=env, timeout=1800,
-        cwd=os.path.dirname(os.path.abspath(__file__)),
-    )
-    for line in out.stdout.strip().splitlines()[::-1]:
-        try:
-            return float(line)
-        except ValueError:
-            continue
-    raise RuntimeError(f"cpu baseline failed: {out.stderr[-500:]}")
+    times, margins = [], None
+    for run in range(CPU_RUNS):
+        clf = HistGradientBoostingClassifier(
+            max_iter=N_ITERS,
+            max_leaf_nodes=NUM_LEAVES,
+            learning_rate=LEARNING_RATE,
+            max_bins=MAX_BIN,
+            early_stopping=False,
+            random_state=run,
+        )
+        t0 = time.perf_counter()
+        clf.fit(X, y)
+        times.append(time.perf_counter() - t0)
+        margins = clf.decision_function(Xt)
+    return float(np.median(times)), margins
 
 
 def main():
-    if "--cpu-baseline" in sys.argv:
-        print(_throughput(CPU_ROWS, N_FEATURES, 3, 1))
-        return
+    X, y = _make_data(N_ROWS + N_TEST, N_FEATURES)
+    Xtr, ytr = X[:N_ROWS], y[:N_ROWS]
+    Xte, yte = X[N_ROWS:], y[N_ROWS:]
 
     import jax
 
-    tpu_backend = jax.default_backend()
-    tpu_tput = _throughput(N_ROWS, N_FEATURES, N_ITERS, N_WARMUP)
+    backend = jax.default_backend()
+    tpu_secs, tpu_margins = _fit_tpu(Xtr, ytr, Xte)
+    tpu_tput = N_ROWS * N_ITERS / tpu_secs
+    auc_tpu = _auc(yte, tpu_margins)
 
     try:
-        cpu_tput = _cpu_baseline_subprocess()
-        vs_baseline = tpu_tput / cpu_tput
+        cpu_secs, cpu_margins = _fit_cpu(Xtr, ytr, Xte)
+        cpu_tput = N_ROWS * N_ITERS / cpu_secs
+        auc_cpu = _auc(yte, cpu_margins)
+        vs = tpu_tput / cpu_tput
     except Exception as e:  # pragma: no cover
         print(f"cpu baseline failed: {e}", file=sys.stderr)
-        vs_baseline = 0.0
+        cpu_secs, auc_cpu, vs = 0.0, 0.0, 0.0
 
     print(
         json.dumps(
             {
-                "metric": f"gbdt_train_row_iterations_per_sec_{tpu_backend}",
+                "metric": f"gbdt_leafwise_train_row_iterations_per_sec_{backend}",
                 "value": round(tpu_tput, 1),
                 "unit": "rows*iters/sec",
-                "vs_baseline": round(vs_baseline, 3) if vs_baseline else 0.0,
+                "vs_baseline": round(vs, 3),
+                "tpu_fit_secs": round(tpu_secs, 3),
+                "cpu_fit_secs": round(cpu_secs, 3),
+                "auc_tpu": round(float(auc_tpu), 5),
+                "auc_cpu": round(float(auc_cpu), 5),
+                "cpu_engine": "sklearn.HistGradientBoostingClassifier(median of 3)",
             }
         )
     )

@@ -21,22 +21,26 @@ from mmlspark_tpu.core.pipeline import Estimator, Model, Transformer
 from mmlspark_tpu.data.table import Table
 from mmlspark_tpu.train.statistics import ComputeModelStatistics
 
-# metric name -> (output column of ComputeModelStatistics, higher is better)
-_METRICS: Dict[str, Tuple[str, bool]] = {
-    "accuracy": ("accuracy", True),
-    "precision": ("precision", True),
-    "recall": ("recall", True),
-    "AUC": ("AUC", True),
-    "mse": ("mean_squared_error", False),
-    "rmse": ("root_mean_squared_error", False),
-    "mae": ("mean_absolute_error", False),
-    "r2": ("R^2", True),
+# metric name -> (output column of ComputeModelStatistics, higher is better, kind)
+_METRICS: Dict[str, Tuple[str, bool, str]] = {
+    "accuracy": ("accuracy", True, "classification"),
+    "precision": ("precision", True, "classification"),
+    "recall": ("recall", True, "classification"),
+    "AUC": ("AUC", True, "classification"),
+    "mse": ("mean_squared_error", False, "regression"),
+    "rmse": ("root_mean_squared_error", False, "regression"),
+    "mae": ("mean_absolute_error", False, "regression"),
+    "r2": ("R^2", True, "regression"),
 }
 
 
 def _evaluate(scored: Table, label_col: str, metric: str) -> float:
-    col, _ = _METRICS[metric]
-    stats = ComputeModelStatistics(labelCol=label_col).transform(scored)
+    # The metric name fixes the task kind: 'auto' detection misclassifies
+    # integer-valued regression targets (counts, ratings) as classification.
+    col, _, kind = _METRICS[metric]
+    stats = ComputeModelStatistics(
+        labelCol=label_col, evaluationMetric=kind
+    ).transform(scored)
     if col not in stats:
         raise ValueError(
             f"metric {metric!r} not produced — got columns {stats.columns}"
@@ -116,8 +120,15 @@ class TuneHyperparameters(HasLabelCol, Estimator):
             metrics = [run(c) for c in candidates]
 
         higher = _is_larger_better(self.getEvaluationMetric())
-        order = np.argsort(metrics)
-        best_i = int(order[-1] if higher else order[0])
+        # NaN metrics (single-class CV fold, constant labels) rank as worst,
+        # never best; an all-NaN sweep is an error, not a silent winner.
+        metrics_arr = np.asarray(metrics, dtype=np.float64)
+        if np.isnan(metrics_arr).all():
+            raise ValueError(
+                "all candidate metrics are NaN — check folds/label distribution"
+            )
+        ranked = np.where(np.isnan(metrics_arr), -np.inf if higher else np.inf, metrics_arr)
+        best_i = int(np.argmax(ranked) if higher else np.argmin(ranked))
         best_est, best_params = candidates[best_i]
         best_model = best_est.copy(best_params).fit(table)
         model = TuneHyperparametersModel(

@@ -35,14 +35,15 @@ class AddDocuments(CognitiveServicesBase):
         if self.getUrl() is None:
             raise ValueError("AddDocuments requires url")
         client = HTTPClient(retries=(0.2, 0.8, 3.2))  # exponential backoff
-        key = self._resolve_service_param("subscriptionKey", table, 0)
-        headers = {"Content-Type": "application/json"}
-        if key:
-            headers["api-key"] = key
         action_col = self.getActionCol()
         statuses: List[int] = []
         n = table.num_rows
         for start in range(0, n, self.getBatchSize()):
+            # Column-bound keys resolve per batch (row `start`), not row 0.
+            key = self._resolve_service_param("subscriptionKey", table, start)
+            headers = {"Content-Type": "application/json"}
+            if key:
+                headers["api-key"] = key
             docs = []
             for row in range(start, min(start + self.getBatchSize(), n)):
                 doc: Dict[str, Any] = {

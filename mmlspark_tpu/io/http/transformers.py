@@ -12,7 +12,7 @@ through few shared clients).
 from __future__ import annotations
 
 import json
-from typing import Any, Callable, Dict, Optional
+from typing import Any, Callable, Dict, Optional, Tuple
 
 import numpy as np
 
@@ -174,13 +174,15 @@ class PartitionConsolidator(HasInputCol, HasOutputCol, Transformer):
                         validator=gt(0))
     timeout = Param("Per-request timeout seconds", default=60.0, converter=to_float)
 
-    _shared: Dict[int, AsyncHTTPClient] = {}
+    _shared: Dict[Tuple[int, float], AsyncHTTPClient] = {}
 
     def transform(self, table: Table) -> Table:
-        # per-JVM SharedVariable analogue (io/http/SharedVariable.scala:65)
-        key = self.getConcurrency()
+        # per-JVM SharedVariable analogue (io/http/SharedVariable.scala:65);
+        # keyed by (concurrency, timeout) so a different timeout never
+        # silently reuses another transformer's client.
+        key = (self.getConcurrency(), self.getTimeout())
         client = self._shared.setdefault(
-            key, AsyncHTTPClient(concurrency=key, timeout=self.getTimeout())
+            key, AsyncHTTPClient(concurrency=key[0], timeout=key[1])
         )
         requests = list(table.column(self.getInputCol()))
         responses = client.send_all(requests)

@@ -78,6 +78,11 @@ class LightGBMParams(
         validator=one_of("data_parallel", "voting_parallel", "serial"),
     )
     topK = Param("Top features for voting parallel", default=20, converter=to_int, validator=gt(0))
+    growthPolicy = Param(
+        "leafwise (LightGBM best-first, numLeaves-bounded) or depthwise "
+        "(balanced levels — fewer, larger MXU passes)",
+        default="leafwise", converter=to_str, validator=one_of("leafwise", "depthwise"),
+    )
     numBatches = Param("Split training into sequential batches (0=off)", default=0, converter=to_int, validator=ge(0))
     modelString = Param("Warm-start booster string", default="", converter=to_str)
     verbosity = Param("Verbosity", default=-1, converter=to_int)
@@ -116,6 +121,13 @@ class LightGBMParams(
             early_stopping_round=self.getEarlyStoppingRound(),
             improvement_tolerance=self.getImprovementTolerance(),
             seed=self.getSeed(),
+            growth=self.getGrowthPolicy(),
+            tree_learner=(
+                "voting_parallel"
+                if self.getParallelism() == "voting_parallel"
+                else "data_parallel"
+            ),
+            top_k=self.getTopK(),
         )
         kwargs.update(self._extra_train_options())
         return TrainOptions(**kwargs)
@@ -241,6 +253,7 @@ class LightGBMBase(LightGBMParams, Estimator):
 
 
 def _ensemble_margin(boosters: List[Booster], bins: np.ndarray, mapper: BinMapper) -> np.ndarray:
+    import jax
     import jax.numpy as jnp
 
     from mmlspark_tpu.lightgbm.train import _route_binned
@@ -248,15 +261,18 @@ def _ensemble_margin(boosters: List[Booster], bins: np.ndarray, mapper: BinMappe
     total = None
     for b in boosters:
         # Route in bin space (bins built with the shared mapper).
-        import jax
-
         def margin_fn(bv):
             m = jnp.broadcast_to(
                 jnp.asarray(b.init_score)[None, :], (bv.shape[0], b.num_classes)
             )
             for t in range(b.num_trees):
                 leaf = _route_binned(
-                    bv, jnp.asarray(b.split_feature[t]), jnp.asarray(b.split_bin[t]),
+                    bv,
+                    jnp.asarray(b.split_feature[t]),
+                    jnp.asarray(b.split_bin[t]),
+                    jnp.asarray(b.left_child[t]),
+                    jnp.asarray(b.right_child[t]),
+                    jnp.asarray(b.is_leaf[t]),
                     b.max_depth,
                 )
                 m = m.at[:, t % b.num_classes].add(jnp.asarray(b.leaf_values[t])[leaf])
@@ -273,15 +289,25 @@ def _merge_boosters(boosters: List[Booster]) -> Booster:
     if len(boosters) == 1:
         return boosters[0]
     first = boosters[0]
+
+    def cat(field):
+        arrs = [getattr(b, field) for b in boosters]
+        return None if any(a is None for a in arrs) else np.concatenate(arrs)
+
     return Booster(
-        split_feature=np.concatenate([b.split_feature for b in boosters]),
-        split_bin=np.concatenate([b.split_bin for b in boosters]),
-        split_threshold=np.concatenate([b.split_threshold for b in boosters]),
-        leaf_values=np.concatenate([b.leaf_values for b in boosters]),
+        split_feature=cat("split_feature"),
+        split_bin=cat("split_bin"),
+        split_threshold=cat("split_threshold"),
+        left_child=cat("left_child"),
+        right_child=cat("right_child"),
+        is_leaf=cat("is_leaf"),
+        leaf_values=cat("leaf_values"),
+        cover=cat("cover"),
+        split_gain=cat("split_gain"),
         init_score=first.init_score,
         num_classes=first.num_classes,
         objective=first.objective,
-        max_depth=first.max_depth,
+        max_depth=max(b.max_depth for b in boosters),
         best_iteration=-1,
         feature_names=first.feature_names,
         bin_edges=first.bin_edges,
@@ -325,4 +351,13 @@ class LightGBMModelBase(HasFeaturesCol, HasPredictionCol, Model):
         if self.getLeafPredictionCol():
             leaves = self.booster.predict_leaf(X).astype(np.float64)
             table = table.with_column(self.getLeafPredictionCol(), leaves)
+        if self.getFeaturesShapCol():
+            # (N, C, F+1) → (N, C*(F+1)) — LightGBM's contrib layout: per
+            # class, per-feature contributions then the bias term
+            # (LightGBMBooster.scala:240-275 featuresShap).
+            shap = self.booster.features_shap(X)
+            n = shap.shape[0]
+            table = table.with_column(
+                self.getFeaturesShapCol(), shap.reshape(n, -1).astype(np.float64)
+            )
         return table

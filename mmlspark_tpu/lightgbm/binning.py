@@ -74,6 +74,12 @@ def fit_bin_mapper(
         k = len(e)
         edges[j, :k] = e
         num_bins[j] = k + 2  # +1 missing bin, +1 overflow bin above last edge
+    # Snap edges to the float32 grid: prediction routes raw float32 values
+    # against float32 thresholds, so binning must use the identical
+    # comparison grid or boundary values (x == edge) route differently in
+    # train vs predict vs SHAP.
+    finite = np.isfinite(edges)
+    edges[finite] = edges[finite].astype(np.float32).astype(np.float64)
     return BinMapper(edges=edges, num_bins=num_bins, max_bin=max_bin)
 
 
@@ -82,10 +88,11 @@ def apply_bins(X: np.ndarray, mapper: BinMapper) -> np.ndarray:
     n, f = X.shape
     out = np.zeros((n, f), dtype=np.uint8)
     for j in range(f):
-        col = X[:, j]
+        # float32 comparison grid — identical to the predict/SHAP paths.
+        col = X[:, j].astype(np.float32)
         nan_mask = np.isnan(col)
         # 'left' => v <= edge stays at that edge's bin; v > last edge -> overflow bin.
-        b = 1 + np.searchsorted(mapper.edges[j], col, side="left")
+        b = 1 + np.searchsorted(mapper.edges[j].astype(np.float32), col, side="left")
         b = np.where(nan_mask, MISSING_BIN, b)
         out[:, j] = np.clip(b, 0, mapper.max_bin).astype(np.uint8)
     return out

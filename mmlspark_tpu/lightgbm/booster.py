@@ -1,20 +1,30 @@
-"""Booster: the trained forest, with jitted batch predict and model serde.
+"""Booster: the trained forest, with jitted batch predict, SHAP, and serde.
 
 Equivalent of ``LightGBMBooster`` (reference ``lightgbm/LightGBMBooster.scala``):
-score / predictLeaf / raw-margin output, iteration slicing for early stopping,
-string serde. Instead of per-row JNI calls with ThreadLocal native buffers
-(``LightGBMBooster.scala:37-128``), prediction is one jitted XLA program over
-the whole batch; trees are dense implicit-heap arrays so traversal is D
-gathers per tree — no data-dependent control flow.
+score / predictLeaf / featuresShap / raw-margin output, iteration slicing for
+early stopping, string serde. Instead of per-row JNI calls with ThreadLocal
+native buffers (``LightGBMBooster.scala:37-128``), prediction is one jitted
+XLA program over the whole batch.
 
-Tree layout (depth D, per tree):
-- ``split_feature``  (2^D - 1,) int32   — heap order; dead nodes = 0
-- ``split_threshold``(2^D - 1,) float32 — raw-value "go left if x <= t or NaN";
-                                           dead nodes = +inf (all rows left)
-- ``split_bin``      (2^D - 1,) int32   — binned-space threshold (training path)
-- ``leaf_values``    (2^D,)    float32  — learning-rate-scaled outputs
+Tree layout — pointer-based node arrays (per tree, ``M`` node slots), the
+layout LightGBM's own model text uses, supporting both level-wise and
+LightGBM's defining *leaf-wise* growth (unbalanced trees would explode an
+implicit heap: depth can reach ``num_leaves - 1``):
 
-Forest arrays stack trees as (num_trees, ...) where tree ``i*C + c`` is
+- ``split_feature``   (M,) int32   — internal nodes; 0 at leaves/dead slots
+- ``split_threshold`` (M,) float32 — raw-value "go left if NaN or x <= t";
+                                      +inf at dead slots
+- ``split_bin``       (M,) int32   — binned-space threshold (training path)
+- ``left_child`` / ``right_child`` (M,) int32 — slot indices
+- ``is_leaf``         (M,) bool
+- ``leaf_values``     (M,) float32 — learning-rate-scaled outputs at leaves
+- ``cover``           (M,) float32 — training rows through the node (TreeSHAP)
+- ``split_gain``      (M,) float32 — realized gain (importance_type="gain")
+
+Routing is ``max_depth`` rounds of gathers — no data-dependent control flow;
+rows that reach a leaf early simply stay there (``is_leaf`` gate).
+
+Forest arrays stack trees as (num_trees, M) where tree ``i*C + c`` is
 iteration i, class c (LightGBM's tree ordering).
 """
 
@@ -34,14 +44,19 @@ from mmlspark_tpu.lightgbm.binning import BinMapper
 
 @dataclasses.dataclass
 class Booster:
-    split_feature: np.ndarray  # (T, I)
-    split_threshold: np.ndarray  # (T, I)
-    split_bin: np.ndarray  # (T, I)
-    leaf_values: np.ndarray  # (T, L)
+    split_feature: np.ndarray  # (T, M) int32
+    split_threshold: np.ndarray  # (T, M) float32
+    split_bin: np.ndarray  # (T, M) int32
+    left_child: np.ndarray  # (T, M) int32
+    right_child: np.ndarray  # (T, M) int32
+    is_leaf: np.ndarray  # (T, M) bool
+    leaf_values: np.ndarray  # (T, M) float32
     init_score: np.ndarray  # (C,)
     num_classes: int  # margin columns C
     objective: str
-    max_depth: int
+    max_depth: int  # routing steps (>= realized depth of every tree)
+    cover: Optional[np.ndarray] = None  # (T, M) float32
+    split_gain: Optional[np.ndarray] = None  # (T, M) float32
     best_iteration: int = -1  # -1 = use all
     feature_names: Optional[list] = None
     bin_edges: Optional[np.ndarray] = None  # (F, max_bin-1) for re-binning
@@ -75,37 +90,59 @@ class Booster:
             jnp.asarray(X, dtype=jnp.float32),
             jnp.asarray(self.split_feature[:t]),
             jnp.asarray(self.split_threshold[:t]),
+            jnp.asarray(self.left_child[:t]),
+            jnp.asarray(self.right_child[:t]),
+            jnp.asarray(self.is_leaf[:t]),
             jnp.asarray(self.leaf_values[:t]),
             jnp.asarray(self.init_score),
             self.num_classes,
+            self.max_depth,
         )
         return np.asarray(out)
 
     def predict_leaf(
         self, X: np.ndarray, num_iteration: Optional[int] = None
     ) -> np.ndarray:
-        """(N, T) leaf index per tree (``predictLeaf``, LightGBMBooster.scala:240+)."""
+        """(N, T) leaf slot per tree (``predictLeaf``, LightGBMBooster.scala:240+)."""
         t = self._used_trees(num_iteration)
         out = _predict_leaf_jit(
             jnp.asarray(X, dtype=jnp.float32),
             jnp.asarray(self.split_feature[:t]),
             jnp.asarray(self.split_threshold[:t]),
+            jnp.asarray(self.left_child[:t]),
+            jnp.asarray(self.right_child[:t]),
+            jnp.asarray(self.is_leaf[:t]),
+            self.max_depth,
         )
         return np.asarray(out)
+
+    def features_shap(
+        self, X: np.ndarray, num_iteration: Optional[int] = None
+    ) -> np.ndarray:
+        """(N, C, F+1) per-feature SHAP values plus bias term (last column);
+        ``sum(axis=-1) == raw_margin`` (``featuresShap``,
+        LightGBMBooster.scala:240-275). Path-dependent TreeSHAP using the
+        training covers recorded per node."""
+        from mmlspark_tpu.lightgbm.shap import tree_shap
+
+        return tree_shap(self, np.asarray(X, dtype=np.float64), num_iteration)
 
     # -- serde ---------------------------------------------------------------
 
     def to_dict(self) -> Dict[str, Any]:
-        d = dataclasses.asdict(self)
-        return d
+        return dataclasses.asdict(self)
 
     @staticmethod
     def from_dict(d: Dict[str, Any]) -> "Booster":
         d = dict(d)
-        for k in ("split_feature", "split_bin"):
+        for k in ("split_feature", "split_bin", "left_child", "right_child"):
             d[k] = np.asarray(d[k], dtype=np.int32)
         for k in ("split_threshold", "leaf_values", "init_score"):
             d[k] = np.asarray(d[k], dtype=np.float32)
+        d["is_leaf"] = np.asarray(d["is_leaf"], dtype=bool)
+        for k in ("cover", "split_gain"):
+            if d.get(k) is not None:
+                d[k] = np.asarray(d[k], dtype=np.float32)
         if d.get("bin_edges") is not None:
             d["bin_edges"] = np.asarray(d["bin_edges"], dtype=np.float64)
         return Booster(**d)
@@ -128,15 +165,27 @@ class Booster:
         return Booster.from_dict(d)
 
     def feature_importances(self, importance_type: str = "split") -> np.ndarray:
-        """Split-count or total-gain-free importance
+        """Split-count or total-gain importance
         (``getFeatureImportances``, LightGBMBooster.scala:295-310)."""
-        alive = np.isfinite(self.split_threshold)
-        feats = self.split_feature[alive]
+        internal = (~self.is_leaf) & np.isfinite(self.split_threshold)
+        feats = self.split_feature[internal]
         num_features = (
             len(self.feature_names)
             if self.feature_names
             else (int(feats.max()) + 1 if feats.size else 0)
         )
+        if importance_type == "gain":
+            if self.split_gain is None:
+                raise ValueError(
+                    "importance_type='gain' requires split_gain (absent on "
+                    "this booster — e.g. merged from a booster without it)"
+                )
+            gains = self.split_gain[internal]
+            out = np.zeros(num_features, dtype=np.float64)
+            np.add.at(out, feats.ravel(), gains.ravel())
+            return out
+        if importance_type != "split":
+            raise ValueError(f"unknown importance_type {importance_type!r}")
         return np.bincount(feats.ravel(), minlength=num_features).astype(np.float64)
 
 
@@ -145,50 +194,54 @@ class Booster:
 # ---------------------------------------------------------------------------
 
 
-def _route_rows(X, feat, thr):
-    """One tree, all rows: D gather steps through the implicit heap.
-    X (N,F) raw float32; feat/thr (I,). Returns final leaf index (N,)."""
+def _route_rows(X, feat, thr, left, right, is_leaf, depth: int):
+    """One tree, all rows: ``depth`` gather steps through the pointer arrays.
+    X (N,F) raw float32. Returns final leaf slot (N,). Rows at a leaf stay."""
     n = X.shape[0]
-    num_internal = feat.shape[0]
-    depth = int(np.log2(num_internal + 1))
     node = jnp.zeros(n, dtype=jnp.int32)
     for _ in range(depth):
         f = feat[node]  # (N,)
         t = thr[node]
         x = jnp.take_along_axis(X, f[:, None], axis=1)[:, 0]
-        go_right = jnp.logical_not(jnp.isnan(x) | (x <= t))
-        node = 2 * node + 1 + go_right.astype(jnp.int32)
-    return node - num_internal  # leaf index in [0, 2^D)
+        go_left = jnp.isnan(x) | (x <= t)
+        nxt = jnp.where(go_left, left[node], right[node])
+        node = jnp.where(is_leaf[node], node, nxt)
+    return node
 
 
-@partial(jax.jit, static_argnames=("num_classes",))
-def _predict_margin_jit(X, feat, thr, leaf_vals, init_score, num_classes):
+@partial(jax.jit, static_argnames=("num_classes", "depth"))
+def _predict_margin_jit(
+    X, feat, thr, left, right, is_leaf, leaf_vals, init_score, num_classes, depth
+):
     t = feat.shape[0]
     rounds = t // num_classes
-    featr = feat.reshape(rounds, num_classes, -1)
-    thrr = thr.reshape(rounds, num_classes, -1)
-    lvr = leaf_vals.reshape(rounds, num_classes, -1)
+
+    def r(a):
+        return a.reshape(rounds, num_classes, -1)
+
     n = X.shape[0]
 
     def one_round(margins, tree):
-        f, th, lv = tree
+        f, th, lc, rc, il, lv = tree
 
         def one_class(c):
-            leaf = _route_rows(X, f[c], th[c])
+            leaf = _route_rows(X, f[c], th[c], lc[c], rc[c], il[c], depth)
             return lv[c][leaf]
 
         contrib = jax.vmap(one_class, out_axes=1)(jnp.arange(num_classes))
         return margins + contrib, None
 
     init = jnp.broadcast_to(init_score[None, :], (n, num_classes))
-    margins, _ = jax.lax.scan(one_round, init, (featr, thrr, lvr))
+    margins, _ = jax.lax.scan(
+        one_round, init, (r(feat), r(thr), r(left), r(right), r(is_leaf), r(leaf_vals))
+    )
     return margins
 
 
-@jax.jit
-def _predict_leaf_jit(X, feat, thr):
+@partial(jax.jit, static_argnames=("depth",))
+def _predict_leaf_jit(X, feat, thr, left, right, is_leaf, depth):
     def one_tree(tree):
-        f, th = tree
-        return _route_rows(X, f, th)
+        f, th, lc, rc, il = tree
+        return _route_rows(X, f, th, lc, rc, il, depth)
 
-    return jax.vmap(one_tree, out_axes=1)((feat, thr))
+    return jax.vmap(one_tree, out_axes=1)((feat, thr, left, right, is_leaf))

@@ -1,15 +1,25 @@
-"""GBDT training loop: level-wise tree growth, jitted per-iteration step.
+"""GBDT training loop: leaf-wise (LightGBM semantics) and level-wise growth,
+jitted per-iteration step.
 
 Replaces the reference's native training core (``LGBM_BoosterUpdateOneIter``
 driven from ``lightgbm/TrainUtils.scala:220-315``) with a single jitted XLA
 program per boosting iteration:
 
-  gradients → per-depth histogram pass → split search over the
+  gradients → histogram pass(es) → split search over the
   (node, feature, bin) lattice → routing update → leaf values → margins.
 
-Trees grow level-wise to a static depth (derived from ``numLeaves`` when
-``maxDepth`` is unset): every level is ONE dense histogram pass over all
-rows — static shapes, no per-leaf work queues, exactly what XLA/MXU want.
+Two growth policies, both emitting pointer-based trees (see booster.py):
+
+- ``leafwise`` (default — LightGBM's defining best-first algorithm,
+  ``numLeaves`` bounds the *leaf count*, ``LightGBMParams.scala:13-251``):
+  ``num_leaves - 1`` sequential splits; each step picks the frontier leaf
+  with the best cached gain, routes its rows, and builds the two-child
+  histogram in ONE masked one-hot pass over all rows. Static shapes
+  throughout — the per-split histogram matmul is (N x 2B) so total FLOPs
+  match a level-wise build of the same leaf count.
+- ``depthwise``: every level is ONE dense histogram pass over all rows —
+  fewer, larger MXU matmuls; the fast path when balanced trees are fine.
+
 Early stopping, eval-metric direction, and improvement tolerance follow
 ``TrainUtils.scala:276-315``.
 
@@ -18,6 +28,8 @@ mesh ``data`` axis; the histogram is a row-sum, so XLA inserts the
 cross-device all-reduce — the ``lax.psum`` equivalent of LightGBM's socket
 allreduce. Split decisions are computed identically on every device from the
 reduced histogram, so routing needs no further communication.
+``tree_learner=voting_parallel`` (``topK``, ``LightGBMParams.scala:20-24``)
+reduces only the top-K-voted features' histograms — see ``ops/voting.py``.
 """
 
 from __future__ import annotations
@@ -25,7 +37,7 @@ from __future__ import annotations
 import dataclasses
 import math
 from functools import partial
-from typing import Any, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Dict, List, NamedTuple, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -51,7 +63,7 @@ class TrainOptions:
     num_iterations: int = 100
     learning_rate: float = 0.1
     num_leaves: int = 31
-    max_depth: int = -1  # -1: derived from num_leaves
+    max_depth: int = -1  # -1: unbounded (leafwise) / derived (depthwise)
     max_bin: int = 255
     lambda_l1: float = 0.0
     lambda_l2: float = 0.0
@@ -71,13 +83,33 @@ class TrainOptions:
     improvement_tolerance: float = 0.0
     seed: int = 0
     histogram_method: Optional[str] = None
+    growth: str = "leafwise"  # leafwise | depthwise
+    tree_learner: str = "data_parallel"  # data_parallel | voting_parallel
+    top_k: int = 20  # voting_parallel vote width
     verbosity: int = -1
 
     @property
     def depth(self) -> int:
+        """Static depth of a depthwise tree."""
         if self.max_depth and self.max_depth > 0:
             return self.max_depth
         return max(1, math.ceil(math.log2(max(2, self.num_leaves))))
+
+    @property
+    def num_nodes(self) -> int:
+        """Node-slot count M of one tree in pointer layout."""
+        if self.growth == "depthwise":
+            return 2 ** (self.depth + 1) - 1
+        return 2 * self.num_leaves - 1
+
+    @property
+    def routing_steps(self) -> int:
+        """Static bound on tree depth for routing loops."""
+        if self.growth == "depthwise":
+            return self.depth
+        if self.max_depth and self.max_depth > 0:
+            return min(self.max_depth, self.num_leaves - 1)
+        return self.num_leaves - 1
 
 
 @dataclasses.dataclass
@@ -87,13 +119,143 @@ class TrainResult:
     best_iteration: int
 
 
+class TreeArrays(NamedTuple):
+    """One tree in pointer layout (each (M,) — or (C, M) after vmap)."""
+
+    feat: jax.Array
+    bin: jax.Array
+    thr: jax.Array
+    left: jax.Array
+    right: jax.Array
+    is_leaf: jax.Array
+    leaf_val: jax.Array
+    cover: jax.Array
+    gain: jax.Array
+    row_leaf: jax.Array  # (N,) final leaf slot of every training row
+
+
+class SplitSearch(NamedTuple):
+    """Per-node best-split candidates from one histogram batch (each (k,))."""
+
+    value: jax.Array  # own leaf value (lr-scaled)
+    cover: jax.Array  # row count
+    hess: jax.Array  # hessian sum
+    gain: jax.Array  # best gain, -inf if unsplittable
+    feat: jax.Array
+    bin: jax.Array
+    thr: jax.Array  # raw-value threshold
+    lval: jax.Array  # left child value if split (lr-scaled)
+    rval: jax.Array
+    lcov: jax.Array
+    rcov: jax.Array
+
+
 def _soft_threshold(g: jax.Array, l1: float) -> jax.Array:
     if l1 == 0.0:
         return g
     return jnp.sign(g) * jnp.maximum(jnp.abs(g) - l1, 0.0)
 
 
-def _build_tree_single(
+def _split_search(
+    hist: jax.Array,  # (k, F, B, 3)
+    totals: jax.Array,  # (k, 3) exact per-node [sum_g, sum_h, count]
+    edges: jax.Array,  # (F, E)
+    feature_mask: jax.Array,  # (F,)
+    opts: TrainOptions,
+) -> SplitSearch:
+    """Best split per node from its histogram — the split-finding core the
+    native library runs per leaf (``TrainUtils.scala:220-315`` inner loop)."""
+    k, f, b, _ = hist.shape
+    l1, l2, lr = opts.lambda_l1, opts.lambda_l2, opts.learning_rate
+
+    g_tot, h_tot, c_tot = totals[:, 0], totals[:, 1], totals[:, 2]
+
+    cum = jnp.cumsum(hist, axis=2)  # (k, F, B, 3) left stats at "<= bin"
+    gl, hl, cl = cum[..., 0], cum[..., 1], cum[..., 2]
+    gr = g_tot[:, None, None] - gl
+    hr = h_tot[:, None, None] - hl
+    cr = c_tot[:, None, None] - cl
+
+    tl, tr = _soft_threshold(gl, l1), _soft_threshold(gr, l1)
+    tg = _soft_threshold(g_tot, l1)
+    parent_score = (tg * tg) / (h_tot + l2)  # (k,)
+    gain = tl * tl / (hl + l2) + tr * tr / (hr + l2) - parent_score[:, None, None]
+
+    valid = (
+        (cl >= opts.min_data_in_leaf)
+        & (cr >= opts.min_data_in_leaf)
+        & (hl >= opts.min_sum_hessian_in_leaf)
+        & (hr >= opts.min_sum_hessian_in_leaf)
+        & (jnp.arange(b)[None, None, :] < b - 1)
+        & (feature_mask[None, :, None] > 0)
+    )
+    gain = jnp.where(valid, gain, -jnp.inf)
+
+    flat = gain.reshape(k, f * b)
+    best_idx = jnp.argmax(flat, axis=1)  # (k,)
+    best_gain = jnp.take_along_axis(flat, best_idx[:, None], axis=1)[:, 0]
+    best_f = (best_idx // b).astype(jnp.int32)
+    best_b = (best_idx % b).astype(jnp.int32)
+
+    def leaf_value(g, h):
+        v = -_soft_threshold(g, l1) / (h + l2)
+        if opts.max_delta_step > 0:
+            v = jnp.clip(v, -opts.max_delta_step, opts.max_delta_step)
+        return v * lr
+
+    iota = jnp.arange(k)
+    glb = gl[iota, best_f, best_b]
+    hlb = hl[iota, best_f, best_b]
+    clb = cl[iota, best_f, best_b]
+
+    # Raw threshold: split bin t means "x <= edges[f, t-1]"; t=0 ⇒ NaN-only left.
+    thr_raw = edges[best_f, jnp.maximum(best_b - 1, 0)]
+    thr_raw = jnp.where(best_b == 0, -jnp.inf, thr_raw).astype(jnp.float32)
+
+    return SplitSearch(
+        value=leaf_value(g_tot, h_tot),
+        cover=c_tot,
+        hess=h_tot,
+        gain=best_gain,
+        feat=best_f,
+        bin=best_b,
+        thr=thr_raw,
+        lval=leaf_value(glb, hlb),
+        rval=leaf_value(g_tot - glb, h_tot - hlb),
+        lcov=clb,
+        rcov=c_tot - clb,
+    )
+
+
+def _hist_fn(opts: TrainOptions, mesh=None):
+    """Histogram builder honoring the tree_learner choice. Returns a
+    callable producing (hist (k,F,B,3), totals (k,3))."""
+    if opts.tree_learner == "voting_parallel":
+        from mmlspark_tpu.ops.voting import build_histograms_voting
+
+        return partial(
+            build_histograms_voting,
+            top_k=opts.top_k,
+            mesh=mesh,
+            method=opts.histogram_method,
+        )
+
+    def full(bins, grad, hess, count, node, num_nodes, num_bins):
+        h = build_histograms(
+            bins, grad, hess, count, node, num_nodes, num_bins,
+            method=opts.histogram_method,
+        )
+        return h, h[:, 0, :, :].sum(axis=1)  # feature 0 covers all rows
+
+    return full
+
+
+# ---------------------------------------------------------------------------
+# Depthwise (level-wise) growth — one histogram pass per level.
+# ---------------------------------------------------------------------------
+
+
+def _build_tree_depthwise(
     bins: jax.Array,  # (N, F) int32
     grad: jax.Array,  # (N,)
     hess: jax.Array,  # (N,)
@@ -101,132 +263,233 @@ def _build_tree_single(
     edges: jax.Array,  # (F, E) float32 raw-value bin edges
     feature_mask: jax.Array,  # (F,) float32 0/1
     *,
-    depth: int,
     num_bins: int,
     opts: TrainOptions,
-) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array, jax.Array]:
-    """Grow one tree. Returns (split_feature (I,), split_bin (I,),
-    split_threshold (I,), leaf_values (L,), final_node_leaf (N,))."""
+    histf,
+) -> TreeArrays:
     n, f = bins.shape
     b = num_bins
-    lr = opts.learning_rate
-    l1, l2 = opts.lambda_l1, opts.lambda_l2
+    depth = opts.depth
 
     node = jnp.zeros(n, dtype=jnp.int32)  # heap position
     alive = jnp.ones(1, dtype=bool)
     inherited = jnp.zeros(1, dtype=jnp.float32)
+    cover_cur = jnp.zeros(1, dtype=jnp.float32)
 
-    feat_levels, bin_levels, thr_levels = [], [], []
+    feat_lv, bin_lv, thr_lv, cover_lv, gain_lv = [], [], [], [], []
 
     for d in range(depth):
         k = 1 << d
         offset = k - 1
         local = node - offset
-        hist = build_histograms(
-            bins, grad, hess, count, local, k, b, method=opts.histogram_method
-        )  # (k, F, B, 3) — row-sum: XLA all-reduces across data shards here.
+        hist, totals = histf(bins, grad, hess, count, local, k, b)
+        # (k, F, B, 3) — row-sum: XLA all-reduces across data shards here.
+        s = _split_search(hist, totals, edges, feature_mask, opts)
 
-        totals = hist[:, 0, :, :].sum(axis=1)  # (k, 3) — feature 0 covers all rows
-        g_tot, h_tot, c_tot = totals[:, 0], totals[:, 1], totals[:, 2]
-
-        cum = jnp.cumsum(hist, axis=2)  # (k, F, B, 3) left stats at "<= bin"
-        gl, hl, cl = cum[..., 0], cum[..., 1], cum[..., 2]
-        gr = g_tot[:, None, None] - gl
-        hr = h_tot[:, None, None] - hl
-        cr = c_tot[:, None, None] - cl
-
-        tl, tr = _soft_threshold(gl, l1), _soft_threshold(gr, l1)
-        tg = _soft_threshold(g_tot, l1)
-        parent_score = (tg * tg) / (h_tot + l2)  # (k,)
-        gain = tl * tl / (hl + l2) + tr * tr / (hr + l2) - parent_score[:, None, None]
-
-        valid = (
-            (cl >= opts.min_data_in_leaf)
-            & (cr >= opts.min_data_in_leaf)
-            & (hl >= opts.min_sum_hessian_in_leaf)
-            & (hr >= opts.min_sum_hessian_in_leaf)
-            & (jnp.arange(b)[None, None, :] < b - 1)
-            & (feature_mask[None, :, None] > 0)
-        )
-        gain = jnp.where(valid, gain, -jnp.inf)
-
-        flat = gain.reshape(k, f * b)
-        best_idx = jnp.argmax(flat, axis=1)  # (k,)
-        best_gain = jnp.take_along_axis(flat, best_idx[:, None], axis=1)[:, 0]
-        best_f = (best_idx // b).astype(jnp.int32)
-        best_b = (best_idx % b).astype(jnp.int32)
-
-        can_split = alive & jnp.isfinite(best_gain) & (best_gain > opts.min_gain_to_split)
-
-        # Leaf value if growth stops here (LightGBM leaf output, lr-scaled).
-        own_value = -tg / (h_tot + l2)
-        if opts.max_delta_step > 0:
-            own_value = jnp.clip(own_value, -opts.max_delta_step, opts.max_delta_step)
-        own_value = own_value * lr
-        value_cur = jnp.where(alive, own_value, inherited)
-
-        # Child values from the winning split's left/right stats.
-        iota = jnp.arange(k)
-        glb = gl[iota, best_f, best_b]
-        hlb = hl[iota, best_f, best_b]
-        grb = g_tot - glb
-        hrb = h_tot - hlb
-        left_value = -_soft_threshold(glb, l1) / (hlb + l2) * lr
-        right_value = -_soft_threshold(grb, l1) / (hrb + l2) * lr
-        if opts.max_delta_step > 0:
-            lim = opts.max_delta_step * lr
-            left_value = jnp.clip(left_value, -lim, lim)
-            right_value = jnp.clip(right_value, -lim, lim)
+        can_split = alive & jnp.isfinite(s.gain) & (s.gain > opts.min_gain_to_split)
+        value_cur = jnp.where(alive, s.value, inherited)
+        cover_here = jnp.where(alive, s.cover, cover_cur)
 
         # Record this level (dead/non-split nodes: bin=b ⇒ every row left, thr=+inf).
-        feat_rec = jnp.where(can_split, best_f, 0)
-        bin_rec = jnp.where(can_split, best_b, b)
-        # Raw threshold: split bin t means "x <= edges[f, t-1]"; t=0 ⇒ NaN-only left.
-        thr_raw = edges[best_f, jnp.maximum(best_b - 1, 0)]
-        thr_raw = jnp.where(best_b == 0, -jnp.inf, thr_raw)
-        thr_rec = jnp.where(can_split, thr_raw, jnp.inf).astype(jnp.float32)
-        feat_levels.append(feat_rec)
-        bin_levels.append(bin_rec)
-        thr_levels.append(thr_rec)
+        feat_lv.append(jnp.where(can_split, s.feat, 0))
+        bin_lv.append(jnp.where(can_split, s.bin, b))
+        thr_lv.append(jnp.where(can_split, s.thr, jnp.inf).astype(jnp.float32))
+        cover_lv.append(cover_here)
+        gain_lv.append(jnp.where(can_split, s.gain, 0.0))
 
         # Route rows down one level.
-        row_f = feat_rec[local]
-        row_b = bin_rec[local]
+        row_f = feat_lv[-1][local]
+        row_b = bin_lv[-1][local]
         x_bin = jnp.take_along_axis(bins, row_f[:, None], axis=1)[:, 0]
         go_right = (x_bin > row_b).astype(jnp.int32)
         node = 2 * node + 1 + go_right
 
         inherited = jnp.stack(
             [
-                jnp.where(can_split, left_value, value_cur),
-                jnp.where(can_split, right_value, value_cur),
+                jnp.where(can_split, s.lval, value_cur),
+                jnp.where(can_split, s.rval, value_cur),
+            ],
+            axis=1,
+        ).reshape(2 * k)
+        cover_cur = jnp.stack(
+            [
+                jnp.where(can_split, s.lcov, cover_here),
+                jnp.where(can_split, s.rcov, 0.0),
             ],
             axis=1,
         ).reshape(2 * k)
         alive = jnp.repeat(can_split, 2)
 
-    leaf_values = inherited  # (2^depth,)
-    split_feature = jnp.concatenate(feat_levels)
-    split_bin = jnp.concatenate(bin_levels)
-    split_threshold = jnp.concatenate(thr_levels)
-    final_leaf = node - ((1 << depth) - 1)
-    return split_feature, split_bin, split_threshold, leaf_values, final_leaf
+    # Heap → pointer layout: internal slots 0..2^D-2, leaves 2^D-1..2^(D+1)-2.
+    internal = 2**depth - 1
+    leaves = 2**depth
+    iota = jnp.arange(internal, dtype=jnp.int32)
+    zeros_l = jnp.zeros(leaves, dtype=jnp.int32)
+    return TreeArrays(
+        feat=jnp.concatenate([jnp.concatenate(feat_lv), zeros_l]),
+        bin=jnp.concatenate([jnp.concatenate(bin_lv), jnp.full(leaves, b, jnp.int32)]),
+        thr=jnp.concatenate(
+            [jnp.concatenate(thr_lv), jnp.full(leaves, jnp.inf, jnp.float32)]
+        ),
+        left=jnp.concatenate([2 * iota + 1, zeros_l]),
+        right=jnp.concatenate([2 * iota + 2, zeros_l]),
+        is_leaf=jnp.concatenate(
+            [jnp.zeros(internal, bool), jnp.ones(leaves, bool)]
+        ),
+        leaf_val=jnp.concatenate([jnp.zeros(internal, jnp.float32), inherited]),
+        cover=jnp.concatenate([jnp.concatenate(cover_lv), cover_cur]),
+        gain=jnp.concatenate([jnp.concatenate(gain_lv), jnp.zeros(leaves, jnp.float32)]),
+        row_leaf=node,  # already absolute pointer slots
+    )
 
 
-def _route_binned(bins: jax.Array, feat: jax.Array, binthr: jax.Array, depth: int):
-    """Route binned rows through one tree using bin-space thresholds."""
+# ---------------------------------------------------------------------------
+# Leaf-wise (best-first) growth — LightGBM's algorithm.
+# ---------------------------------------------------------------------------
+
+
+def _build_tree_leafwise(
+    bins: jax.Array,
+    grad: jax.Array,
+    hess: jax.Array,
+    count: jax.Array,
+    edges: jax.Array,
+    feature_mask: jax.Array,
+    *,
+    num_bins: int,
+    opts: TrainOptions,
+    histf,
+) -> TreeArrays:
+    """Best-first growth: ``num_leaves - 1`` split steps, each splitting the
+    frontier leaf with the highest cached candidate gain. Slots are allocated
+    sequentially: step s creates slots 2s+1 and 2s+2, so the layout is
+    deterministic and static-shaped (M = 2*num_leaves - 1)."""
+    n, f = bins.shape
+    b = num_bins
+    m = 2 * opts.num_leaves - 1
+    max_depth = opts.max_depth if (opts.max_depth and opts.max_depth > 0) else m
+
+    def search2(hist2, totals2, depth2):
+        """Candidate searches for a freshly split pair; depth-capped."""
+        s = _split_search(hist2, totals2, edges, feature_mask, opts)
+        capped = jnp.where(depth2 >= max_depth, -jnp.inf, s.gain)
+        return s._replace(gain=capped)
+
+    # Root: one-node histogram over all rows.
+    root_hist, root_tot = histf(bins, grad, hess, count, jnp.zeros(n, jnp.int32), 1, b)
+    root = _split_search(root_hist, root_tot, edges, feature_mask, opts)
+
+    def at0(template, s_):
+        return template.at[0].set(s_[0])
+
+    zi = jnp.zeros(m, jnp.int32)
+    zf = jnp.zeros(m, jnp.float32)
+    state = dict(
+        node=jnp.zeros(n, dtype=jnp.int32),
+        feat=zi,
+        bin=jnp.full(m, b, jnp.int32),
+        thr=jnp.full(m, jnp.inf, jnp.float32),
+        left=zi,
+        right=zi,
+        is_leaf=jnp.zeros(m, bool).at[0].set(True),
+        leaf_val=at0(zf, root.value),
+        cover=at0(zf, root.cover),
+        gain=zf,
+        depth=zi,
+        # frontier candidates
+        c_gain=jnp.full(m, -jnp.inf).at[0].set(root.gain[0]),
+        c_feat=at0(zi, root.feat),
+        c_bin=at0(zi, root.bin),
+        c_thr=at0(zf, root.thr),
+    )
+
+    def body(s_i, st):
+        # Pick the best frontier leaf (argmax over cached candidate gains).
+        frontier = jnp.where(jnp.isfinite(st["c_gain"]), st["c_gain"], -jnp.inf)
+        l = jnp.argmax(frontier).astype(jnp.int32)
+        can = frontier[l] > opts.min_gain_to_split
+        lslot = (2 * s_i + 1).astype(jnp.int32)
+        rslot = lslot + 1
+
+        fl, bl = st["c_feat"][l], st["c_bin"][l]
+        in_l = (st["node"] == l) & can
+        x_bin = bins[:, fl]
+        go_right = (x_bin > bl).astype(jnp.int32)
+        node = jnp.where(in_l, jnp.where(go_right == 1, rslot, lslot), st["node"])
+
+        # ONE masked histogram pass builds both children (2 local nodes):
+        # every row participates with its in-leaf mask so shapes stay static.
+        in_l_f = in_l.astype(grad.dtype)
+        hist2, tot2 = histf(
+            bins, grad * in_l_f, hess * in_l_f, count * in_l_f, go_right, 2, b
+        )
+        child_depth = st["depth"][l] + 1
+        cs = search2(hist2, tot2, jnp.full(2, child_depth))
+
+        def upd(arr, idx, val):
+            return arr.at[idx].set(jnp.where(can, val, arr[idx]))
+
+        st = dict(st)
+        st["node"] = node
+        st["feat"] = upd(st["feat"], l, fl)
+        st["bin"] = upd(st["bin"], l, bl)
+        st["thr"] = upd(st["thr"], l, st["c_thr"][l])
+        st["left"] = upd(st["left"], l, lslot)
+        st["right"] = upd(st["right"], l, rslot)
+        st["is_leaf"] = upd(upd(upd(st["is_leaf"], l, False), lslot, True), rslot, True)
+        st["leaf_val"] = upd(upd(st["leaf_val"], lslot, cs.value[0]), rslot, cs.value[1])
+        st["cover"] = upd(upd(st["cover"], lslot, cs.cover[0]), rslot, cs.cover[1])
+        st["gain"] = upd(st["gain"], l, st["c_gain"][l])
+        st["depth"] = upd(upd(st["depth"], lslot, child_depth), rslot, child_depth)
+        st["c_gain"] = upd(
+            upd(upd(st["c_gain"], l, -jnp.inf), lslot, cs.gain[0]), rslot, cs.gain[1]
+        )
+        st["c_feat"] = upd(upd(st["c_feat"], lslot, cs.feat[0]), rslot, cs.feat[1])
+        st["c_bin"] = upd(upd(st["c_bin"], lslot, cs.bin[0]), rslot, cs.bin[1])
+        st["c_thr"] = upd(upd(st["c_thr"], lslot, cs.thr[0]), rslot, cs.thr[1])
+        return st
+
+    state = jax.lax.fori_loop(0, opts.num_leaves - 1, body, state)
+
+    return TreeArrays(
+        feat=state["feat"],
+        bin=state["bin"],
+        thr=state["thr"],
+        left=state["left"],
+        right=state["right"],
+        is_leaf=state["is_leaf"],
+        leaf_val=state["leaf_val"],
+        cover=state["cover"],
+        gain=state["gain"],
+        row_leaf=state["node"],
+    )
+
+
+# ---------------------------------------------------------------------------
+# Boosting step
+# ---------------------------------------------------------------------------
+
+
+def _route_binned(
+    bins: jax.Array, feat, binthr, left, right, is_leaf, steps: int
+) -> jax.Array:
+    """Route binned rows through one pointer tree; returns final leaf slot."""
     n = bins.shape[0]
     node = jnp.zeros(n, dtype=jnp.int32)
-    for _ in range(depth):
+    for _ in range(steps):
         fcur = feat[node]
         bcur = binthr[node]
         x_bin = jnp.take_along_axis(bins, fcur[:, None], axis=1)[:, 0]
-        node = 2 * node + 1 + (x_bin > bcur).astype(jnp.int32)
-    return node - (feat.shape[0])
+        nxt = jnp.where(x_bin <= bcur, left[node], right[node])
+        node = jnp.where(is_leaf[node], node, nxt)
+    return node
 
 
-def _make_step(opts: TrainOptions, objective: Objective, num_bins: int):
-    depth = opts.depth
+def _make_step(opts: TrainOptions, objective: Objective, num_bins: int, mesh=None):
+    build = (
+        _build_tree_leafwise if opts.growth == "leafwise" else _build_tree_depthwise
+    )
+    histf = _hist_fn(opts, mesh)
     obj_kwargs = {
         "num_classes": opts.num_class,
         "alpha": opts.alpha,
@@ -240,26 +503,28 @@ def _make_step(opts: TrainOptions, objective: Objective, num_bins: int):
         count = bag_mask
 
         def per_class(g, h):
-            return _build_tree_single(
+            return build(
                 bins, g, h, count, edges, feature_mask,
-                depth=depth, num_bins=num_bins, opts=opts,
+                num_bins=num_bins, opts=opts, histf=histf,
             )
 
-        sf, sb, st, lv, leaf = jax.vmap(per_class, in_axes=(1, 1))(grad, hess)
-        # margins update: leaf (C, N) indices into lv (C, L)
-        contrib = jnp.take_along_axis(lv, leaf, axis=1).T  # (N, C)
-        return sf, sb, st, lv, margins + contrib
+        tree = jax.vmap(per_class, in_axes=(1, 1))(grad, hess)  # (C, ...) arrays
+        # margins update: row_leaf (C, N) slots into leaf_val (C, M)
+        contrib = jnp.take_along_axis(tree.leaf_val, tree.row_leaf, axis=1).T  # (N, C)
+        return tree, margins + contrib
 
     return jax.jit(step, donate_argnums=(3,))
 
 
-def _make_valid_update(depth: int):
-    def update(bins_v, margins_v, sf, sb, lv):
-        def per_class(f, bthr, vals):
-            leaf = _route_binned(bins_v, f, bthr, depth)
+def _make_valid_update(steps: int):
+    def update(bins_v, margins_v, tree):
+        def per_class(f, bthr, lc, rc, il, vals):
+            leaf = _route_binned(bins_v, f, bthr, lc, rc, il, steps)
             return vals[leaf]
 
-        contrib = jax.vmap(per_class, out_axes=1)(sf, sb, lv)
+        contrib = jax.vmap(per_class, out_axes=1)(
+            tree.feat, tree.bin, tree.left, tree.right, tree.is_leaf, tree.leaf_val
+        )
         return margins_v + contrib
 
     return jax.jit(update, donate_argnums=(1,))
@@ -352,8 +617,8 @@ def train(
     w_dev = put_rows(w)
     margins = put_rows(margins0.astype(np.float32))
 
-    step = _make_step(opts, objective, num_bins)
-    valid_update = _make_valid_update(opts.depth)
+    step = _make_step(opts, objective, num_bins, mesh)
+    valid_update = _make_valid_update(opts.routing_steps)
 
     valid_sets = list(valid_sets or [])
     valid_state = []
@@ -380,7 +645,7 @@ def train(
     num_bag = max(1, int(round(n * opts.bagging_fraction)))
     num_feat = max(1, int(round(f * opts.feature_fraction)))
 
-    trees_sf, trees_sb, trees_st, trees_lv = [], [], [], []
+    trees: List[TreeArrays] = []
     best_score = -np.inf if higher_better else np.inf
     best_iter = 0
     stale = 0
@@ -397,43 +662,78 @@ def train(
         else:
             fm = np.ones(f, dtype=np.float32)
 
-        sf, sb, st, lv, margins = step(
+        tree, margins = step(
             bins_dev, y_dev, w_dev, margins, edges_dev,
             put_rows(bag_mask_np), put_rep(fm),
         )
-        trees_sf.append(np.asarray(sf))
-        trees_sb.append(np.asarray(sb))
-        trees_st.append(np.asarray(st))
-        trees_lv.append(np.asarray(lv))
+        trees.append(
+            TreeArrays(*[np.asarray(a) for a in tree[:-1]], row_leaf=None)
+        )
 
         improved_any = False
         for vs in valid_state:
-            vs["margins"] = valid_update(vs["bins"], vs["margins"], sf, sb, lv)
+            vs["margins"] = valid_update(vs["bins"], vs["margins"], tree)
             score = _evaluate(
                 metric, opts.objective, vs["y"], np.asarray(vs["margins"]), vs["w"],
                 opts.alpha,
             )
             evals[vs["name"]][metric].append(score)
+            # best-so-far from the true score (TrainUtils.scala:276-315);
+            # the first finite eval improves on the ±inf sentinel naturally,
+            # and a NaN score never registers as an improvement.
             delta = (score - best_score) if higher_better else (best_score - score)
-            if delta > opts.improvement_tolerance or it == 0:
+            if delta > opts.improvement_tolerance:
                 best_score, best_iter, improved_any = score, it + 1, True
         if valid_state and opts.early_stopping_round > 0:
             stale = 0 if improved_any else stale + 1
             if stale >= opts.early_stopping_round:
                 break
 
-    t = len(trees_sf)
+    t = len(trees)
+    m = opts.num_nodes
+
+    def stack(field, dtype):
+        return np.concatenate(
+            [np.asarray(getattr(tr, field)) for tr in trees], axis=0
+        ).reshape(t * num_classes, m).astype(dtype)
+
+    left = stack("left", np.int32)
+    right = stack("right", np.int32)
+    is_leaf = stack("is_leaf", bool)
     booster = Booster(
-        split_feature=np.concatenate([a for a in trees_sf], axis=0).reshape(t * num_classes, -1),
-        split_bin=np.concatenate(trees_sb, axis=0).reshape(t * num_classes, -1),
-        split_threshold=np.concatenate(trees_st, axis=0).reshape(t * num_classes, -1),
-        leaf_values=np.concatenate(trees_lv, axis=0).reshape(t * num_classes, -1),
+        split_feature=stack("feat", np.int32),
+        split_bin=stack("bin", np.int32),
+        split_threshold=stack("thr", np.float32),
+        left_child=left,
+        right_child=right,
+        is_leaf=is_leaf,
+        leaf_values=stack("leaf_val", np.float32),
+        cover=stack("cover", np.float32),
+        split_gain=stack("gain", np.float32),
         init_score=np.asarray(init_score, dtype=np.float32),
         num_classes=num_classes,
         objective=opts.objective,
-        max_depth=opts.depth,
+        max_depth=_realized_depth(left, right, is_leaf, opts.routing_steps),
         best_iteration=best_iter if (valid_state and opts.early_stopping_round > 0) else -1,
         feature_names=feature_names,
         bin_edges=None if mapper is None else mapper.edges,
     )
     return TrainResult(booster=booster, evals=evals, best_iteration=best_iter)
+
+
+def _realized_depth(left, right, is_leaf, bound: int) -> int:
+    """Max root→leaf depth over all trees (host-side; the static routing
+    step count for predict). One forward pass over slots suffices: children
+    always occupy a higher slot index than their parent in both layouts."""
+    t, m = left.shape
+    depth = np.zeros((t, m), dtype=np.int64)
+    rows = np.arange(t)
+    for j in range(m):
+        internal = ~is_leaf[:, j] & (left[:, j] > j)  # real internal nodes only
+        if not internal.any():
+            continue
+        for child in (left[:, j], right[:, j]):
+            depth[rows[internal], child[internal]] = depth[internal, j] + 1
+    reachable = depth[is_leaf]
+    realized = int(reachable.max()) if reachable.size else 1
+    return max(1, min(realized, bound))

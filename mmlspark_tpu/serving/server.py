@@ -31,18 +31,18 @@ import time
 import uuid
 from dataclasses import dataclass, field
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-
-
-class _Server(ThreadingHTTPServer):
-    # many concurrent clients: deep accept backlog, daemon worker threads
-    request_queue_size = 128
-    daemon_threads = True
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
 from mmlspark_tpu.core.pipeline import Transformer
 from mmlspark_tpu.data.table import Table
+
+
+class _Server(ThreadingHTTPServer):
+    # many concurrent clients: deep accept backlog, daemon worker threads
+    request_queue_size = 128
+    daemon_threads = True
 
 
 @dataclass

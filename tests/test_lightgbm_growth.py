@@ -1,0 +1,175 @@
+"""Leaf-wise growth, SHAP, and voting-parallel tests.
+
+Reference anchors: leaf-wise is LightGBM's defining algorithm
+(``numLeaves`` bounds leaves, ``lightgbm/LightGBMParams.scala:13-251``);
+SHAP is ``LightGBMBooster.featuresShap`` (``LightGBMBooster.scala:240-275``);
+voting-parallel is ``tree_learner=voting_parallel`` + ``topK``
+(``LightGBMParams.scala:20-24``).
+"""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.data.table import Table
+from mmlspark_tpu.lightgbm import LightGBMClassifier, LightGBMRegressor
+from mmlspark_tpu.lightgbm.binning import bin_dataset
+from mmlspark_tpu.lightgbm.booster import Booster
+from mmlspark_tpu.lightgbm.objectives import auc as auc_metric
+from mmlspark_tpu.lightgbm.train import TrainOptions, train
+
+
+def _make_binary(n=3000, f=10, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f))
+    logit = X[:, 0] * 1.5 + X[:, 1] * X[:, 2] + 0.3 * rng.normal(size=n)
+    y = (logit > 0).astype(np.float64)
+    return X, y
+
+
+def _to_table(X, y):
+    return Table({"features": X.astype(np.float64), "label": y})
+
+
+def test_leafwise_honors_num_leaves():
+    X, y = _make_binary()
+    bins, mapper = bin_dataset(X, max_bin=63)
+    opts = TrainOptions(
+        objective="binary", num_iterations=3, num_leaves=8, max_bin=63,
+        growth="leafwise", min_data_in_leaf=5,
+    )
+    r = train(bins, y, opts, mapper=mapper)
+    b = r.booster
+    # Every tree has at most num_leaves reachable leaves, and the tree can be
+    # deeper than ceil(log2(num_leaves)) — the signature of best-first growth.
+    for t in range(b.num_trees):
+        n_leaves = int(b.is_leaf[t].sum())
+        assert 1 <= n_leaves <= 8
+    assert b.max_depth >= 3
+
+
+def test_leafwise_beats_or_matches_depthwise_quality():
+    X, y = _make_binary(seed=3)
+    n_train = 2400
+    bins, mapper = bin_dataset(X, max_bin=63)
+    scores = {}
+    for growth in ("leafwise", "depthwise"):
+        opts = TrainOptions(
+            objective="binary", num_iterations=30, num_leaves=15, max_bin=63,
+            growth=growth,
+        )
+        r = train(bins[:n_train], y[:n_train], opts, mapper=mapper)
+        m = r.booster.raw_margin(X[n_train:])[:, 0]
+        scores[growth] = auc_metric(
+            y[n_train:], m, np.ones(len(y) - n_train)
+        )
+    assert scores["leafwise"] > 0.9
+    # Leaf-wise should be competitive with the balanced-tree fast path.
+    assert scores["leafwise"] >= scores["depthwise"] - 0.02
+
+
+def test_leafwise_max_depth_cap():
+    X, y = _make_binary()
+    bins, mapper = bin_dataset(X, max_bin=63)
+    opts = TrainOptions(
+        objective="binary", num_iterations=3, num_leaves=31, max_depth=3,
+        max_bin=63, growth="leafwise",
+    )
+    r = train(bins, y, opts, mapper=mapper)
+    assert r.booster.max_depth <= 3
+
+
+def test_shap_sums_to_margin():
+    X, y = _make_binary(n=800)
+    bins, mapper = bin_dataset(X, max_bin=63)
+    opts = TrainOptions(objective="binary", num_iterations=8, num_leaves=7, max_bin=63)
+    r = train(bins, y, opts, mapper=mapper)
+    phi = r.booster.features_shap(X[:100])  # (N, 1, F+1)
+    margins = r.booster.raw_margin(X[:100])
+    np.testing.assert_allclose(phi.sum(axis=-1), margins, rtol=1e-4, atol=1e-4)
+    # The two informative features should dominate attribution mass.
+    mass = np.abs(phi[:, 0, :-1]).mean(axis=0)
+    assert mass[0] == mass.max()
+
+
+def test_shap_multiclass_sums_to_margin():
+    rng = np.random.default_rng(5)
+    n = 900
+    X = rng.normal(size=(n, 6))
+    y = (X[:, 0] > 0.4).astype(int) + (X[:, 1] > 0.2).astype(int)
+    bins, mapper = bin_dataset(X, max_bin=31)
+    opts = TrainOptions(
+        objective="multiclass", num_class=3, num_iterations=5, num_leaves=7,
+        max_bin=31,
+    )
+    r = train(bins, y.astype(np.float64), opts, mapper=mapper)
+    phi = r.booster.features_shap(X[:40])  # (N, 3, F+1)
+    np.testing.assert_allclose(
+        phi.sum(axis=-1), r.booster.raw_margin(X[:40]), rtol=1e-4, atol=1e-4
+    )
+
+
+def test_features_shap_col_output():
+    X, y = _make_binary(n=600)
+    clf = LightGBMClassifier(
+        numIterations=5, numLeaves=7, featuresShapCol="shap", minDataInLeaf=5
+    )
+    model = clf.fit(_to_table(X, y))
+    out = model.transform(_to_table(X[:30], y[:30]))
+    shap = out["shap"]
+    assert shap.shape == (30, X.shape[1] + 1)  # binary: C=1 → F+1 contribs
+    raw = out["rawPrediction"][:, 1]  # positive-class margin
+    np.testing.assert_allclose(shap.sum(axis=1), raw, rtol=1e-4, atol=1e-4)
+
+
+def test_shap_serde_roundtrip():
+    X, y = _make_binary(n=500)
+    bins, mapper = bin_dataset(X, max_bin=31)
+    opts = TrainOptions(objective="binary", num_iterations=3, num_leaves=7, max_bin=31)
+    b = train(bins, y, opts, mapper=mapper).booster
+    b2 = Booster.from_string(b.model_to_string())
+    np.testing.assert_allclose(
+        b2.features_shap(X[:20]), b.features_shap(X[:20]), rtol=1e-6
+    )
+
+
+def test_voting_parallel_quality(mesh8):
+    X, y = _make_binary(n=2048, f=16, seed=7)
+    bins, mapper = bin_dataset(X, max_bin=63)
+    base = dict(
+        objective="binary", num_iterations=15, num_leaves=15, max_bin=63,
+    )
+    r_full = train(
+        bins, y, TrainOptions(**base), mapper=mapper, mesh=mesh8
+    )
+    r_vote = train(
+        bins, y,
+        TrainOptions(**base, tree_learner="voting_parallel", top_k=6),
+        mapper=mapper, mesh=mesh8,
+    )
+    w = np.ones(len(y))
+    auc_full = auc_metric(y, r_full.booster.raw_margin(X)[:, 0], w)
+    auc_vote = auc_metric(y, r_vote.booster.raw_margin(X)[:, 0], w)
+    # Voting reduces comms F→topK; quality must stay close to the full
+    # data_parallel reduction (PV-Tree guarantee).
+    assert auc_vote > auc_full - 0.02, (auc_vote, auc_full)
+
+
+def test_voting_parallel_estimator_param(mesh8):
+    X, y = _make_binary(n=1024)
+    clf = LightGBMClassifier(
+        numIterations=5, numLeaves=7, parallelism="voting_parallel", topK=4
+    )
+    model = clf.fit(_to_table(X, y))
+    out = model.transform(_to_table(X[:50], y[:50]))
+    assert "prediction" in out.columns
+
+
+def test_regressor_leafwise_quality():
+    rng = np.random.default_rng(11)
+    X = rng.normal(size=(2000, 8))
+    y = X[:, 0] * 2 + np.sin(X[:, 1] * 3) + 0.1 * rng.normal(size=2000)
+    reg = LightGBMRegressor(numIterations=40, numLeaves=31)
+    model = reg.fit(_to_table(X, y))
+    pred = model.transform(_to_table(X, y))["prediction"]
+    r2 = 1 - np.var(y - pred) / np.var(y)
+    assert r2 > 0.9, r2
