@@ -133,9 +133,19 @@ class LightGBMParams(
         return TrainOptions(**kwargs)
 
 
-def extract_features(table: Table, features_col: str) -> np.ndarray:
+def extract_features(table: Table, features_col: str, num_features: int = 0):
+    """Dense (N, F) float64 — or a :class:`CSRMatrix` when the column holds
+    per-row (indices, values) sparse tuples (the
+    ``LGBM_DatasetCreateFromCSRSpark`` ingest path,
+    LightGBMUtils.scala:246-266). ``num_features`` pins the sparse feature
+    count (pass the trained F at predict/valid time so a batch whose highest
+    explicit index is smaller does not silently shrink the matrix)."""
+    from mmlspark_tpu.data.sparse import csr_column_to_matrix, is_sparse_column
+
     feats = table.column(features_col)
     if feats.dtype == object:
+        if is_sparse_column(feats):
+            return csr_column_to_matrix(feats, num_features=num_features)
         feats = np.stack([np.asarray(row, dtype=np.float64) for row in feats])
     return np.asarray(feats, dtype=np.float64)
 
@@ -163,8 +173,8 @@ class LightGBMBase(LightGBMParams, Estimator):
 
         return best_mesh(n)
 
-    def _prepare(self, table: Table):
-        X = extract_features(table, self.getFeaturesCol())
+    def _prepare(self, table: Table, num_features: int = 0):
+        X = extract_features(table, self.getFeaturesCol(), num_features)
         y = np.asarray(table.column(self.getLabelCol()), dtype=np.float64)
         w = None
         if self.isSet("weightCol"):
@@ -181,14 +191,20 @@ class LightGBMBase(LightGBMParams, Estimator):
             ind = np.asarray(table.column(self.getValidationIndicatorCol()), dtype=bool)
             valid_table, table = table.filter(ind), table.filter(~ind)
 
-        X, y, w, init = self._prepare(table)
+        warm = self.getModelString()
+        prev = Booster.from_string(warm) if warm else None
+        # Warm start: pin sparse extraction to the previous booster's feature
+        # count so its trees never gather past the new batch's explicit width.
+        X, y, w, init = self._prepare(
+            table, num_features=prev.num_features if prev else 0
+        )
         num_class = self._num_classes(y)
         opts = self._make_options(num_class)
 
         bins, mapper = bin_dataset(X, max_bin=opts.max_bin)
         valid_sets = []
         if valid_table is not None and valid_table.num_rows > 0:
-            Xv, yv, wv, _ = self._prepare(valid_table)
+            Xv, yv, wv, _ = self._prepare(valid_table, num_features=X.shape[1])
             bv, _ = bin_dataset(Xv, mapper=mapper)
             valid_sets.append(("valid_0", bv, yv, wv))
 
@@ -198,9 +214,7 @@ class LightGBMBase(LightGBMParams, Estimator):
             init_margins = np.asarray(init, dtype=np.float32)
             if init_margins.ndim == 1:
                 init_margins = init_margins[:, None]
-        warm = self.getModelString()
-        if warm:
-            prev = Booster.from_string(warm)
+        if prev is not None:
             init_margins = prev.raw_margin(X)
 
         num_batches = self.getNumBatches()

@@ -58,22 +58,35 @@ def fit_bin_mapper(
     # max_bin usable value bins (bin 0 reserved for missing) -> max_bin-1 edges.
     edges = np.full((f, max_bin - 1), np.inf, dtype=np.float64)
     num_bins = np.zeros(f, dtype=np.int32)
+    qs = np.linspace(0, 1, max_bin)
     for j in range(f):
         col = sample[:, j]
         col = col[~np.isnan(col)]
         if col.size == 0:
             num_bins[j] = 1
             continue
-        uniq = np.unique(col)
-        if len(uniq) <= max_bin - 1:
-            # One bin per distinct value; edge = the value itself ("<= v" left).
-            e = uniq
-        else:
-            qs = np.quantile(col, np.linspace(0, 1, max_bin), method="linear")
-            e = np.unique(qs)[:-1]  # drop max so the top quantile maps inside
+        u, counts = np.unique(col, return_counts=True)
+        e = _edges_from_counts(u, counts, max_bin, qs)
         k = len(e)
         edges[j, :k] = e
         num_bins[j] = k + 2  # +1 missing bin, +1 overflow bin above last edge
+    return _snap_edges(edges, num_bins, max_bin)
+
+
+def _edges_from_counts(
+    u: np.ndarray, counts: np.ndarray, max_bin: int, qs: np.ndarray
+) -> np.ndarray:
+    """Edges for one feature from its sorted unique non-NaN values + counts —
+    the single edge rule shared by the dense and CSR fits (the two must stay
+    bit-identical for sparse/dense training parity)."""
+    if len(u) <= max_bin - 1:
+        # One bin per distinct value; edge = the value itself ("<= v" left).
+        return u
+    qvals = _weighted_quantile(u, counts, qs)
+    return np.unique(qvals)[:-1]  # drop max so the top quantile maps inside
+
+
+def _snap_edges(edges: np.ndarray, num_bins: np.ndarray, max_bin: int) -> BinMapper:
     # Snap edges to the float32 grid: prediction routes raw float32 values
     # against float32 thresholds, so binning must use the identical
     # comparison grid or boundary values (x == edge) route differently in
@@ -99,9 +112,121 @@ def apply_bins(X: np.ndarray, mapper: BinMapper) -> np.ndarray:
 
 
 def bin_dataset(
-    X: np.ndarray, max_bin: int = 255, mapper: Optional[BinMapper] = None
+    X, max_bin: int = 255, mapper: Optional[BinMapper] = None
 ) -> Tuple[np.ndarray, BinMapper]:
+    from mmlspark_tpu.data.sparse import CSRMatrix
+
+    if isinstance(X, CSRMatrix):
+        if mapper is None:
+            mapper = fit_bin_mapper_csr(X, max_bin=max_bin)
+        return apply_bins_csr(X, mapper), mapper
     X = np.asarray(X, dtype=np.float64)
     if mapper is None:
         mapper = fit_bin_mapper(X, max_bin=max_bin)
     return apply_bins(X, mapper), mapper
+
+
+# ---------------------------------------------------------------------------
+# Sparse (CSR) ingest — the LGBM_DatasetCreateFromCSRSpark analogue
+# (reference lightgbm/LightGBMUtils.scala:246-266). Implicit entries are 0.0;
+# the dense float matrix is never materialized: quantiles fold the implicit
+# zero mass in analytically, and bin assignment scatters explicit entries over
+# a zero-bin-initialized uint8 matrix (the layout training wants anyway).
+# ---------------------------------------------------------------------------
+
+
+def _weighted_quantile(u: np.ndarray, c: np.ndarray, qs: np.ndarray) -> np.ndarray:
+    """Quantiles of the multiset {u[k] repeated c[k] times}, matching
+    ``np.quantile(..., method='linear')`` bit-for-bit: position p = q*(W-1),
+    linear interpolation between virtual sorted elements floor(p)/ceil(p)."""
+    w = int(c.sum())
+    cum = np.cumsum(c)
+    p = qs * (w - 1)
+    i = np.floor(p).astype(np.int64)
+    frac = p - i
+    i2 = np.minimum(i + 1, w - 1)
+    a_lo = u[np.searchsorted(cum, i, side="right")]
+    a_hi = u[np.searchsorted(cum, i2, side="right")]
+    # numpy's _lerp switches formula at t >= 0.5 for monotonicity; reproduce
+    # it so these edges are bitwise np.quantile's.
+    diff = a_hi - a_lo
+    out = a_lo + frac * diff
+    return np.where(frac >= 0.5, a_hi - diff * (1 - frac), out)
+
+
+def fit_bin_mapper_csr(csr, max_bin: int = 255, sample_cnt: int = 200_000, seed: int = 0) -> BinMapper:
+    """Per-feature quantile edges from CSR without densifying. Matches
+    :func:`fit_bin_mapper` on the equivalent dense matrix exactly (same
+    sampling rng, same quantile arithmetic with the implicit-zero mass)."""
+    n, f = csr.shape
+    if n > sample_cnt:
+        rng = np.random.default_rng(seed)
+        idx = rng.choice(n, size=sample_cnt, replace=False)
+        sel = np.zeros(n, dtype=bool)
+        sel[idx] = True
+        n_sample = sample_cnt
+    else:
+        sel = None
+        n_sample = n
+
+    if sel is not None:
+        row_ids = np.repeat(np.arange(n, dtype=np.int64), np.diff(csr.indptr))
+        keep = sel[row_ids]
+        cols, vals = csr.indices[keep], csr.data[keep]
+    else:
+        cols, vals = csr.indices, csr.data
+
+    order = np.argsort(cols, kind="stable")
+    cols_s, vals_s = cols[order], vals[order]
+    col_starts = np.searchsorted(cols_s, np.arange(f + 1))
+
+    edges = np.full((f, max_bin - 1), np.inf, dtype=np.float64)
+    num_bins = np.zeros(f, dtype=np.int32)
+    qs = np.linspace(0, 1, max_bin)
+    for j in range(f):
+        explicit = vals_s[col_starts[j] : col_starts[j + 1]]
+        n_zero = n_sample - len(explicit)  # implicit entries are 0.0
+        explicit = explicit[~np.isnan(explicit)]
+        if len(explicit) + n_zero == 0:
+            num_bins[j] = 1
+            continue
+        # Fold the implicit zero mass into the (value, count) multiset, then
+        # defer to the shared edge rule.
+        u, counts = np.unique(explicit, return_counts=True)
+        pos = np.searchsorted(u, 0.0)
+        if pos < len(u) and u[pos] == 0.0:
+            counts = counts.copy()
+            counts[pos] += n_zero
+        elif n_zero > 0:
+            u = np.insert(u, pos, 0.0)
+            counts = np.insert(counts, pos, n_zero)
+        e = _edges_from_counts(u, counts, max_bin, qs)
+        k = len(e)
+        edges[j, :k] = e
+        num_bins[j] = k + 2
+    return _snap_edges(edges, num_bins, max_bin)
+
+
+def apply_bins_csr(csr, mapper: BinMapper) -> np.ndarray:
+    """CSR → dense row-major uint8 bins: initialize every cell to its
+    feature's zero-bin, then scatter the explicit entries column-by-column.
+    Bit-identical to ``apply_bins`` on the densified matrix."""
+    n, f = csr.shape
+    edges32 = mapper.edges.astype(np.float32)
+    zero_bins = np.clip(
+        1 + np.array([np.searchsorted(edges32[j], np.float32(0.0), side="left") for j in range(f)]),
+        0,
+        mapper.max_bin,
+    ).astype(np.uint8)
+    out = np.broadcast_to(zero_bins[None, :], (n, f)).copy()
+
+    col_indptr, row_ids, values = csr.to_csc()
+    for j in range(f):
+        lo, hi = col_indptr[j], col_indptr[j + 1]
+        if hi == lo:
+            continue
+        v = values[lo:hi].astype(np.float32)
+        b = 1 + np.searchsorted(edges32[j], v, side="left")
+        b = np.where(np.isnan(v), MISSING_BIN, b)
+        out[row_ids[lo:hi], j] = np.clip(b, 0, mapper.max_bin).astype(np.uint8)
+    return out

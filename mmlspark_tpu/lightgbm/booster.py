@@ -66,6 +66,18 @@ class Booster:
         return self.split_feature.shape[0]
 
     @property
+    def num_features(self) -> int:
+        """Trained feature-space width (pins CSR predict batches to the
+        training F so narrower sparse batches can't silently shrink)."""
+        if self.feature_names:
+            return len(self.feature_names)
+        if self.bin_edges is not None:
+            return self.bin_edges.shape[0]
+        internal = (~self.is_leaf) & np.isfinite(self.split_threshold)
+        feats = self.split_feature[internal]
+        return int(feats.max()) + 1 if feats.size else 0
+
+    @property
     def num_iterations(self) -> int:
         return self.num_trees // self.num_classes
 
@@ -78,9 +90,15 @@ class Booster:
     # -- predict -------------------------------------------------------------
 
     def raw_margin(
-        self, X: np.ndarray, num_iteration: Optional[int] = None
+        self, X, num_iteration: Optional[int] = None
     ) -> np.ndarray:
-        """(N, C) raw margins (init_score + sum of tree outputs)."""
+        """(N, C) raw margins (init_score + sum of tree outputs). ``X`` may be
+        dense (N, F) or a CSRMatrix (densified in bounded row chunks)."""
+        chunks = _csr_chunks(X)
+        if chunks is not None:
+            return np.concatenate(
+                [self.raw_margin(c, num_iteration) for c in chunks], axis=0
+            )
         t = self._used_trees(num_iteration)
         if t == 0:
             return np.broadcast_to(
@@ -101,9 +119,14 @@ class Booster:
         return np.asarray(out)
 
     def predict_leaf(
-        self, X: np.ndarray, num_iteration: Optional[int] = None
+        self, X, num_iteration: Optional[int] = None
     ) -> np.ndarray:
         """(N, T) leaf slot per tree (``predictLeaf``, LightGBMBooster.scala:240+)."""
+        chunks = _csr_chunks(X)
+        if chunks is not None:
+            return np.concatenate(
+                [self.predict_leaf(c, num_iteration) for c in chunks], axis=0
+            )
         t = self._used_trees(num_iteration)
         out = _predict_leaf_jit(
             jnp.asarray(X, dtype=jnp.float32),
@@ -117,7 +140,7 @@ class Booster:
         return np.asarray(out)
 
     def features_shap(
-        self, X: np.ndarray, num_iteration: Optional[int] = None
+        self, X, num_iteration: Optional[int] = None
     ) -> np.ndarray:
         """(N, C, F+1) per-feature SHAP values plus bias term (last column);
         ``sum(axis=-1) == raw_margin`` (``featuresShap``,
@@ -125,6 +148,11 @@ class Booster:
         training covers recorded per node."""
         from mmlspark_tpu.lightgbm.shap import tree_shap
 
+        chunks = _csr_chunks(X)
+        if chunks is not None:
+            return np.concatenate(
+                [self.features_shap(c, num_iteration) for c in chunks], axis=0
+            )
         return tree_shap(self, np.asarray(X, dtype=np.float64), num_iteration)
 
     # -- serde ---------------------------------------------------------------
@@ -169,11 +197,7 @@ class Booster:
         (``getFeatureImportances``, LightGBMBooster.scala:295-310)."""
         internal = (~self.is_leaf) & np.isfinite(self.split_threshold)
         feats = self.split_feature[internal]
-        num_features = (
-            len(self.feature_names)
-            if self.feature_names
-            else (int(feats.max()) + 1 if feats.size else 0)
-        )
+        num_features = self.num_features
         if importance_type == "gain":
             if self.split_gain is None:
                 raise ValueError(
@@ -187,6 +211,21 @@ class Booster:
         if importance_type != "split":
             raise ValueError(f"unknown importance_type {importance_type!r}")
         return np.bincount(feats.ravel(), minlength=num_features).astype(np.float64)
+
+
+def _csr_chunks(X, target_bytes: int = 256 << 20):
+    """None for dense inputs; for CSRMatrix, an iterator of densified float32
+    row chunks sized so each chunk stays under ``target_bytes`` regardless of
+    feature count (wide sparse data shrinks the row window)."""
+    from mmlspark_tpu.data.sparse import CSRMatrix
+
+    if not isinstance(X, CSRMatrix):
+        return None
+    chunk_rows = min(65536, max(1, target_bytes // (4 * max(X.num_features, 1))))
+    return (
+        X.row_slice(lo, min(lo + chunk_rows, X.num_rows)).to_dense(np.float32)
+        for lo in range(0, max(X.num_rows, 1), chunk_rows)
+    )
 
 
 # ---------------------------------------------------------------------------

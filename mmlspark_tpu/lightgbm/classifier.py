@@ -62,8 +62,8 @@ class LightGBMClassificationModel(LightGBMModelBase):
     numClasses = Param("Number of classes", default=2, converter=to_int)
 
     def transform(self, table: Table) -> Table:
-        X = extract_features(table, self.getFeaturesCol())
         booster = self.booster
+        X = extract_features(table, self.getFeaturesCol(), booster.num_features)
         margins = booster.raw_margin(X)  # (N, C)
         if booster.num_classes == 1:
             # binary: sigmoid fixup (LightGBMBooster.scala:312-328)

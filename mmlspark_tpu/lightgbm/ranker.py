@@ -161,7 +161,8 @@ class LightGBMRanker(HasGroupCol, LightGBMBase):
 
 class LightGBMRankerModel(LightGBMModelBase):
     def transform(self, table: Table) -> Table:
-        X = extract_features(table, self.getFeaturesCol())
-        margins = self.booster.raw_margin(X)[:, 0]
+        booster = self.booster
+        X = extract_features(table, self.getFeaturesCol(), booster.num_features)
+        margins = booster.raw_margin(X)[:, 0]
         out = table.with_column(self.getPredictionCol(), margins.astype(np.float64))
         return self._with_leaf_col(out, X)

@@ -57,8 +57,9 @@ class LightGBMRegressionModel(LightGBMModelBase):
     objective = Param("Objective the booster was trained with", default="regression", converter=to_str)
 
     def transform(self, table: Table) -> Table:
-        X = extract_features(table, self.getFeaturesCol())
-        margins = self.booster.raw_margin(X)[:, 0]
+        booster = self.booster
+        X = extract_features(table, self.getFeaturesCol(), booster.num_features)
+        margins = booster.raw_margin(X)[:, 0]
         if self.getObjective() in ("poisson", "tweedie"):
             margins = np.exp(margins)
         out = table.with_column(self.getPredictionCol(), margins.astype(np.float64))

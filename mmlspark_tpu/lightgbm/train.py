@@ -229,7 +229,9 @@ def _split_search(
 
 def _hist_fn(opts: TrainOptions, mesh=None):
     """Histogram builder honoring the tree_learner choice. Returns a
-    callable producing (hist (k,F,B,3), totals (k,3))."""
+    callable producing (hist (k,F,B,3), totals (k,3)); ``feature_mask``
+    (featureFraction) steers voting so reduced histograms are spent only
+    on splittable features."""
     if opts.tree_learner == "voting_parallel":
         from mmlspark_tpu.ops.voting import build_histograms_voting
 
@@ -240,7 +242,7 @@ def _hist_fn(opts: TrainOptions, mesh=None):
             method=opts.histogram_method,
         )
 
-    def full(bins, grad, hess, count, node, num_nodes, num_bins):
+    def full(bins, grad, hess, count, node, num_nodes, num_bins, feature_mask=None):
         h = build_histograms(
             bins, grad, hess, count, node, num_nodes, num_bins,
             method=opts.histogram_method,
@@ -282,7 +284,7 @@ def _build_tree_depthwise(
         k = 1 << d
         offset = k - 1
         local = node - offset
-        hist, totals = histf(bins, grad, hess, count, local, k, b)
+        hist, totals = histf(bins, grad, hess, count, local, k, b, feature_mask=feature_mask)
         # (k, F, B, 3) — row-sum: XLA all-reduces across data shards here.
         s = _split_search(hist, totals, edges, feature_mask, opts)
 
@@ -376,7 +378,9 @@ def _build_tree_leafwise(
         return s._replace(gain=capped)
 
     # Root: one-node histogram over all rows.
-    root_hist, root_tot = histf(bins, grad, hess, count, jnp.zeros(n, jnp.int32), 1, b)
+    root_hist, root_tot = histf(
+        bins, grad, hess, count, jnp.zeros(n, jnp.int32), 1, b, feature_mask=feature_mask
+    )
     root = _split_search(root_hist, root_tot, edges, feature_mask, opts)
 
     def at0(template, s_):
@@ -421,7 +425,8 @@ def _build_tree_leafwise(
         # every row participates with its in-leaf mask so shapes stay static.
         in_l_f = in_l.astype(grad.dtype)
         hist2, tot2 = histf(
-            bins, grad * in_l_f, hess * in_l_f, count * in_l_f, go_right, 2, b
+            bins, grad * in_l_f, hess * in_l_f, count * in_l_f, go_right, 2, b,
+            feature_mask=feature_mask,
         )
         child_depth = st["depth"][l] + 1
         cs = search2(hist2, tot2, jnp.full(2, child_depth))

@@ -55,9 +55,12 @@ def build_histograms_voting(
     top_k: int = 20,
     mesh=None,
     method: Optional[str] = None,
+    feature_mask: Optional[jax.Array] = None,  # (F,) 0/1
 ) -> Tuple[jax.Array, jax.Array]:
     """Returns (hist (k, F, B, 3) with non-winning features zeroed,
-    totals (k, 3) exact). Falls back to the full reduction when unsharded."""
+    totals (k, 3) exact). Falls back to the full reduction when unsharded.
+    ``feature_mask`` (featureFraction subsampling) excludes features from the
+    vote so the K reduced histograms are spent only on splittable features."""
     f = bins.shape[1]
     k_sel = min(top_k, f)
 
@@ -67,15 +70,17 @@ def build_histograms_voting(
         )
         return hist, hist[:, 0, :, :].sum(axis=1)
 
-    def local_fn(bins_l, grad_l, hess_l, count_l, node_l):
+    def local_fn(bins_l, grad_l, hess_l, count_l, node_l, fmask):
         h = build_histograms(
             bins_l, grad_l, hess_l, count_l, node_l, num_nodes, num_bins,
             method=method,
         )  # LOCAL (k, F, B, 3)
         totals = lax.psum(h[:, 0, :, :].sum(axis=1), "data")  # (k, 3) exact
 
-        # Local vote: top-K features per node by local split gain.
+        # Local vote: top-K features per node by local split gain; masked-out
+        # features (featureFraction) may not spend vote slots.
         gains = _local_feature_gains(h)  # (k, F)
+        gains = jnp.where(fmask[None, :] > 0, gains, -jnp.inf)
         _, local_top = lax.top_k(gains, k_sel)  # (k, K)
         votes = jnp.zeros((num_nodes, f), dtype=jnp.int32)
         votes = jax.vmap(lambda v, idx: v.at[idx].add(1))(votes, local_top)
@@ -104,8 +109,11 @@ def build_histograms_voting(
             P("data"),
             P("data"),
             P("data"),
+            P(),  # feature mask replicated
         ),
         out_specs=(P(), P()),
         check_rep=False,
     )
-    return sharded(bins, grad, hess, count, node)
+    if feature_mask is None:
+        feature_mask = jnp.ones(f, dtype=jnp.float32)
+    return sharded(bins, grad, hess, count, node, feature_mask)

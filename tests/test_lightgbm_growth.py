@@ -173,3 +173,23 @@ def test_regressor_leafwise_quality():
     pred = model.transform(_to_table(X, y))["prediction"]
     r2 = 1 - np.var(y - pred) / np.var(y)
     assert r2 > 0.9, r2
+
+
+def test_voting_parallel_feature_fraction(mesh8):
+    """featureFraction masks must steer the vote: masked-out features may
+    not spend top-K slots, so growth continues on the allowed ones."""
+    X, y = _make_binary(n=2048, f=16, seed=9)
+    bins, mapper = bin_dataset(X, max_bin=63)
+    r = train(
+        bins, y,
+        TrainOptions(
+            objective="binary", num_iterations=10, num_leaves=15, max_bin=63,
+            tree_learner="voting_parallel", top_k=4, feature_fraction=0.5, seed=3,
+        ),
+        mapper=mapper, mesh=mesh8,
+    )
+    w = np.ones(len(y))
+    score = auc_metric(y, r.booster.raw_margin(X)[:, 0], w)
+    assert score > 0.8, score
+    # trees actually grew (premature-leaf regression guard)
+    assert (~r.booster.is_leaf).sum() > 0

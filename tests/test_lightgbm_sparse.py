@@ -1,0 +1,238 @@
+"""Sparse (CSR) GBDT ingest — the ``LGBM_DatasetCreateFromCSRSpark`` path
+(reference ``lightgbm/LightGBMUtils.scala:246-266``): binning, training, and
+predict on sparse features must match the equivalent dense pipeline exactly
+(implicit entries are 0.0; explicit NaN is missing)."""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.data.sparse import (
+    CSRMatrix,
+    csr_column_to_matrix,
+    is_sparse_column,
+)
+from mmlspark_tpu.data.table import Table
+from mmlspark_tpu.lightgbm import LightGBMClassifier, LightGBMRegressor
+from mmlspark_tpu.lightgbm.binning import (
+    apply_bins_csr,
+    bin_dataset,
+    fit_bin_mapper,
+    fit_bin_mapper_csr,
+)
+
+
+def _random_sparse(rng, n, f, density=0.3, nan_frac=0.02):
+    dense = np.zeros((n, f))
+    mask = rng.random((n, f)) < density
+    dense[mask] = rng.normal(size=mask.sum())
+    nan_mask = rng.random((n, f)) < nan_frac
+    dense[nan_mask] = np.nan
+    return dense
+
+
+class TestCSRMatrix:
+    def test_from_dense_roundtrip(self):
+        rng = np.random.default_rng(0)
+        dense = _random_sparse(rng, 50, 7)
+        csr = CSRMatrix.from_dense(dense)
+        back = csr.to_dense()
+        np.testing.assert_array_equal(np.isnan(back), np.isnan(dense))
+        np.testing.assert_array_equal(back[~np.isnan(dense)], dense[~np.isnan(dense)])
+
+    def test_from_rows_and_column(self):
+        rows = [
+            (np.array([0, 3]), np.array([1.0, 2.0])),
+            (np.array([], dtype=np.int64), np.array([])),
+            (np.array([1]), np.array([-4.0])),
+        ]
+        csr = CSRMatrix.from_rows(rows, num_features=5)
+        assert csr.shape == (3, 5)
+        assert csr.nnz == 3
+        dense = csr.to_dense()
+        assert dense[0, 3] == 2.0 and dense[2, 1] == -4.0 and dense[1].sum() == 0
+
+        col = np.empty(3, dtype=object)
+        for i, r in enumerate(rows):
+            col[i] = r
+        assert is_sparse_column(col)
+        csr2 = csr_column_to_matrix(col, num_features=5)
+        np.testing.assert_array_equal(csr2.to_dense(), dense)
+
+    def test_row_slice_and_take(self):
+        rng = np.random.default_rng(1)
+        dense = _random_sparse(rng, 40, 5, nan_frac=0)
+        csr = CSRMatrix.from_dense(dense)
+        np.testing.assert_array_equal(csr.row_slice(10, 25).to_dense(), dense[10:25])
+        idx = np.array([3, 1, 39, 7])
+        np.testing.assert_array_equal(csr.take_rows(idx).to_dense(), dense[idx])
+        mask = rng.random(40) < 0.5
+        np.testing.assert_array_equal(csr.take_rows(mask).to_dense(), dense[mask])
+
+    def test_to_csc(self):
+        rng = np.random.default_rng(2)
+        dense = _random_sparse(rng, 30, 4, nan_frac=0)
+        csr = CSRMatrix.from_dense(dense)
+        col_indptr, row_ids, values = csr.to_csc()
+        for j in range(4):
+            lo, hi = col_indptr[j], col_indptr[j + 1]
+            got = np.zeros(30)
+            got[row_ids[lo:hi]] = values[lo:hi]
+            np.testing.assert_array_equal(got, dense[:, j])
+
+
+class TestSparseBinning:
+    @pytest.mark.parametrize("max_bin", [255, 15])
+    def test_mapper_matches_dense(self, max_bin):
+        rng = np.random.default_rng(3)
+        dense = _random_sparse(rng, 800, 6, density=0.4)
+        # one low-cardinality column to hit the unique-values path
+        dense[:, 5] = rng.choice([0.0, 1.0, 2.5], size=800)
+        csr = CSRMatrix.from_dense(dense)
+        m_dense = fit_bin_mapper(dense, max_bin=max_bin)
+        m_csr = fit_bin_mapper_csr(csr, max_bin=max_bin)
+        np.testing.assert_array_equal(m_dense.num_bins, m_csr.num_bins)
+        np.testing.assert_array_equal(m_dense.edges, m_csr.edges)
+
+    def test_mapper_matches_dense_sampled(self):
+        rng = np.random.default_rng(4)
+        dense = _random_sparse(rng, 3000, 3, density=0.5)
+        csr = CSRMatrix.from_dense(dense)
+        m_dense = fit_bin_mapper(dense, max_bin=31, sample_cnt=1000, seed=7)
+        m_csr = fit_bin_mapper_csr(csr, max_bin=31, sample_cnt=1000, seed=7)
+        np.testing.assert_array_equal(m_dense.edges, m_csr.edges)
+
+    def test_bins_match_dense(self):
+        rng = np.random.default_rng(5)
+        dense = _random_sparse(rng, 500, 8, density=0.25)
+        csr = CSRMatrix.from_dense(dense)
+        bins_dense, mapper = bin_dataset(dense, max_bin=63)
+        bins_csr = apply_bins_csr(csr, mapper)
+        np.testing.assert_array_equal(bins_dense, bins_csr)
+
+    def test_bin_dataset_dispatches(self):
+        rng = np.random.default_rng(6)
+        dense = _random_sparse(rng, 200, 4)
+        bins_d, m_d = bin_dataset(dense, max_bin=31)
+        bins_s, m_s = bin_dataset(CSRMatrix.from_dense(dense), max_bin=31)
+        np.testing.assert_array_equal(bins_d, bins_s)
+        np.testing.assert_array_equal(m_d.edges, m_s.edges)
+
+
+def _sparse_table(dense, y):
+    col = np.empty(len(dense), dtype=object)
+    for i in range(len(dense)):
+        row = dense[i]
+        nz = np.nonzero((row != 0) | np.isnan(row))[0]
+        col[i] = (nz, row[nz])
+    return Table({"features": col, "label": y.astype(np.float64)})
+
+
+class TestSparseTraining:
+    def test_classifier_sparse_matches_dense(self):
+        rng = np.random.default_rng(7)
+        n = 400
+        dense = _random_sparse(rng, n, 6, density=0.4, nan_frac=0)
+        y = (dense[:, 0] + 0.5 * dense[:, 1] > 0).astype(np.float64)
+        t_dense = Table({"features": dense, "label": y})
+        t_sparse = _sparse_table(dense, y)
+
+        kw = dict(numIterations=15, numLeaves=7, parallelism="serial")
+        m_dense = LightGBMClassifier(**kw).fit(t_dense)
+        m_sparse = LightGBMClassifier(**kw).fit(t_sparse)
+
+        np.testing.assert_array_equal(
+            m_dense.booster.split_feature, m_sparse.booster.split_feature
+        )
+        np.testing.assert_allclose(
+            m_dense.booster.leaf_values, m_sparse.booster.leaf_values, rtol=1e-6
+        )
+        out_d = m_dense.transform(t_dense)
+        out_s = m_sparse.transform(t_sparse)
+        np.testing.assert_allclose(
+            out_d.column("probability"), out_s.column("probability"), rtol=1e-6
+        )
+
+    def test_regressor_sparse_fits(self):
+        rng = np.random.default_rng(8)
+        dense = _random_sparse(rng, 300, 5, density=0.5, nan_frac=0.01)
+        yr = np.nan_to_num(dense[:, 0]) * 2 + rng.normal(scale=0.1, size=300)
+        t = _sparse_table(dense, yr)
+        model = LightGBMRegressor(numIterations=20, numLeaves=7, parallelism="serial").fit(t)
+        out = model.transform(t)
+        pred = out.column("prediction")
+        assert np.corrcoef(pred, yr)[0, 1] > 0.8
+
+    def test_booster_csr_predict_matches_dense(self):
+        rng = np.random.default_rng(9)
+        dense = _random_sparse(rng, 250, 6, density=0.4, nan_frac=0)
+        y = (dense.sum(axis=1) > 0).astype(np.float64)
+        model = LightGBMClassifier(
+            numIterations=10, numLeaves=7, parallelism="serial"
+        ).fit(Table({"features": dense, "label": y}))
+        b = model.booster
+        csr = CSRMatrix.from_dense(dense)
+        np.testing.assert_allclose(b.raw_margin(csr), b.raw_margin(dense), rtol=1e-6)
+        np.testing.assert_array_equal(b.predict_leaf(csr), b.predict_leaf(dense))
+        shap_s = b.features_shap(csr)
+        shap_d = b.features_shap(dense)
+        np.testing.assert_allclose(shap_s, shap_d, rtol=1e-5, atol=1e-6)
+
+    def test_sparse_shap_column(self):
+        rng = np.random.default_rng(10)
+        dense = _random_sparse(rng, 120, 4, density=0.5, nan_frac=0)
+        y = (dense[:, 0] > 0).astype(np.float64)
+        t = _sparse_table(dense, y)
+        model = LightGBMClassifier(
+            numIterations=5, numLeaves=5, parallelism="serial", featuresShapCol="shap"
+        ).fit(t)
+        out = model.transform(t)
+        shap = out.column("shap")
+        assert shap.shape == (120, 5)  # F + bias
+
+
+class TestSparseFeatureCount:
+    def _fit(self):
+        rng = np.random.default_rng(11)
+        dense = _random_sparse(rng, 300, 6, density=0.4, nan_frac=0)
+        y = (dense[:, 0] > 0).astype(np.float64)
+        model = LightGBMClassifier(
+            numIterations=10, numLeaves=7, parallelism="serial"
+        ).fit(_sparse_table(dense, y))
+        return model, dense, y
+
+    def test_narrow_predict_batch_keeps_trained_width(self):
+        """A predict batch whose explicit indices stop short of the trained F
+        must densify to the full width, not silently shrink."""
+        model, dense, y = self._fit()
+        narrow = dense.copy()
+        narrow[:, 4:] = 0.0  # rows now only reach index 3
+        out_sparse = model.transform(_sparse_table(narrow, y))
+        out_dense = model.transform(Table({"features": narrow, "label": y}))
+        np.testing.assert_allclose(
+            out_sparse.column("probability"),
+            out_dense.column("probability"),
+            rtol=1e-6,
+        )
+
+    def test_out_of_range_index_raises(self):
+        model, dense, y = self._fit()
+        col = np.empty(2, dtype=object)
+        col[0] = (np.array([0, 2]), np.array([1.0, 1.0]))
+        col[1] = (np.array([99]), np.array([1.0]))  # beyond trained F=6
+        bad = Table({"features": col, "label": y[:2]})
+        with pytest.raises(ValueError, match="out of range"):
+            model.transform(bad)
+
+
+def test_weighted_quantile_matches_numpy_bitwise():
+    from mmlspark_tpu.lightgbm.binning import _weighted_quantile
+
+    rng = np.random.default_rng(12)
+    qs = np.linspace(0, 1, 64)
+    for _ in range(50):
+        col = rng.normal(size=rng.integers(5, 500))
+        col = np.round(col, 2)  # force repeats
+        u, c = np.unique(col, return_counts=True)
+        ours = _weighted_quantile(u, c, qs)
+        theirs = np.quantile(col, qs, method="linear")
+        np.testing.assert_array_equal(ours, theirs)
