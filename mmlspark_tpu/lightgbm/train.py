@@ -42,6 +42,7 @@ from typing import Any, Dict, List, NamedTuple, Optional, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import lax
 
 from mmlspark_tpu.lightgbm.binning import BinMapper
 from mmlspark_tpu.lightgbm.booster import Booster
@@ -242,10 +243,16 @@ def _hist_fn(opts: TrainOptions, mesh=None):
             method=opts.histogram_method,
         )
 
+    method = opts.histogram_method
+    if mesh is not None and method in (None, "pallas"):
+        # pallas_call has no GSPMD partitioning rule: under jit with
+        # row-sharded inputs it cannot shard over the data axis the way the
+        # plain-XLA formulations do, so the mesh path sticks to those.
+        method = "onehot" if jax.default_backend() in ("tpu", "axon") else "segment"
+
     def full(bins, grad, hess, count, node, num_nodes, num_bins, feature_mask=None):
         h = build_histograms(
-            bins, grad, hess, count, node, num_nodes, num_bins,
-            method=opts.histogram_method,
+            bins, grad, hess, count, node, num_nodes, num_bins, method=method,
         )
         return h, h[:, 0, :, :].sum(axis=1)  # feature 0 covers all rows
 
@@ -383,6 +390,19 @@ def _build_tree_leafwise(
     )
     root = _split_search(root_hist, root_tot, edges, feature_mask, opts)
 
+    # Histogram subtraction (LightGBM's core trick): cache every frontier
+    # leaf's (F, B, 3) histogram, build only the LEFT child per split, and
+    # derive the right child as parent - left — halving the one-hot width of
+    # the hot pass from 2B to B. Gated by a memory budget on the (M, F, B, 3)
+    # cache — which the boosting step vmaps over num_class, so the budget
+    # multiplies by the class count — and off under voting-parallel (its
+    # histograms only carry the top-K winner features, so parent - left is
+    # garbage elsewhere).
+    use_sub = (
+        max(1, opts.num_class) * m * f * b * 3 * 4 <= (256 << 20)
+        and opts.tree_learner != "voting_parallel"
+    )
+
     def at0(template, s_):
         return template.at[0].set(s_[0])
 
@@ -406,6 +426,11 @@ def _build_tree_leafwise(
         c_bin=at0(zi, root.bin),
         c_thr=at0(zf, root.thr),
     )
+    if use_sub:
+        state["leaf_hist"] = (
+            jnp.zeros((m, f, b, 3), jnp.float32).at[0].set(root_hist[0])
+        )
+        state["leaf_tot"] = jnp.zeros((m, 3), jnp.float32).at[0].set(root_tot[0])
 
     def body(s_i, st):
         # Pick the best frontier leaf (argmax over cached candidate gains).
@@ -421,13 +446,27 @@ def _build_tree_leafwise(
         go_right = (x_bin > bl).astype(jnp.int32)
         node = jnp.where(in_l, jnp.where(go_right == 1, rslot, lslot), st["node"])
 
-        # ONE masked histogram pass builds both children (2 local nodes):
-        # every row participates with its in-leaf mask so shapes stay static.
-        in_l_f = in_l.astype(grad.dtype)
-        hist2, tot2 = histf(
-            bins, grad * in_l_f, hess * in_l_f, count * in_l_f, go_right, 2, b,
-            feature_mask=feature_mask,
-        )
+        if use_sub:
+            # Masked pass over the LEFT child only (one B-wide node);
+            # right = parent - left from the frontier cache.
+            maskL = (in_l & (go_right == 0)).astype(grad.dtype)
+            histL, totL = histf(
+                bins, grad * maskL, hess * maskL, count * maskL,
+                jnp.zeros(n, jnp.int32), 1, b, feature_mask=feature_mask,
+            )
+            histR = st["leaf_hist"][l] - histL[0]
+            totR = st["leaf_tot"][l] - totL[0]
+            hist2 = jnp.stack([histL[0], histR])
+            tot2 = jnp.stack([totL[0], totR])
+        else:
+            # ONE masked histogram pass builds both children (2 local
+            # nodes): every row participates with its in-leaf mask so
+            # shapes stay static.
+            in_l_f = in_l.astype(grad.dtype)
+            hist2, tot2 = histf(
+                bins, grad * in_l_f, hess * in_l_f, count * in_l_f, go_right, 2, b,
+                feature_mask=feature_mask,
+            )
         child_depth = st["depth"][l] + 1
         cs = search2(hist2, tot2, jnp.full(2, child_depth))
 
@@ -435,6 +474,9 @@ def _build_tree_leafwise(
             return arr.at[idx].set(jnp.where(can, val, arr[idx]))
 
         st = dict(st)
+        if use_sub:
+            st["leaf_hist"] = upd(upd(st["leaf_hist"], lslot, hist2[0]), rslot, hist2[1])
+            st["leaf_tot"] = upd(upd(st["leaf_tot"], lslot, tot2[0]), rslot, tot2[1])
         st["node"] = node
         st["feat"] = upd(st["feat"], l, fl)
         st["bin"] = upd(st["bin"], l, bl)
@@ -518,7 +560,54 @@ def _make_step(opts: TrainOptions, objective: Objective, num_bins: int, mesh=Non
         contrib = jnp.take_along_axis(tree.leaf_val, tree.row_leaf, axis=1).T  # (N, C)
         return tree, margins + contrib
 
-    return jax.jit(step, donate_argnums=(3,))
+    return step
+
+
+def _make_scan_steps(step, per_iter_bag: bool):
+    """All boosting iterations in ONE device program: ``lax.scan`` over the
+    per-tree step, per-iteration bagging/feature masks as scanned inputs,
+    stacked tree arrays as the scan output. One dispatch and one bulk fetch
+    replace per-iteration round-trips — on remote-attached chips (axon
+    tunnel) dispatch latency otherwise dominates the entire fit.
+
+    When bagging never resamples (``per_iter_bag=False``) the single (N,)
+    mask is closed over inside the program rather than scanned, so no
+    (iterations, N) buffer is ever materialized."""
+
+    def run(bins, y, w, margins, edges, bag, fm_all):
+        def body(m, per_iter):
+            if per_iter_bag:
+                bag_i, fmv = per_iter
+            else:
+                bag_i, fmv = bag, per_iter
+            tree, m2 = step(bins, y, w, m, edges, bag_i, fmv)
+            return m2, tree._replace(row_leaf=jnp.zeros((), jnp.int32))
+
+        xs = (bag, fm_all) if per_iter_bag else fm_all
+        margins_out, trees = lax.scan(body, margins, xs)
+        return margins_out, trees
+
+    return jax.jit(run, donate_argnums=(3,))
+
+
+def _mask_schedule(opts: "TrainOptions", rng, n, pad, num_bag, num_feat, f, presence):
+    """Per-iteration (bag_mask, bag_changed, feature_mask_or_None) — the ONE
+    definition of the bagging/feature-sampling schedule and its rng stream,
+    shared by the scan and loop paths so they cannot diverge."""
+    bag = presence
+    for it in range(opts.num_iterations):
+        changed = False
+        if opts.bagging_fraction < 1.0 and opts.bagging_freq > 0:
+            if it % opts.bagging_freq == 0:
+                bag = np.zeros(n + pad, dtype=np.float32)
+                bag[rng.choice(n, size=num_bag, replace=False)] = 1.0
+                changed = True
+        if opts.feature_fraction < 1.0:
+            fm = np.zeros(f, dtype=np.float32)
+            fm[rng.choice(f, size=num_feat, replace=False)] = 1.0
+        else:
+            fm = None
+        yield bag, changed, fm
 
 
 def _make_valid_update(steps: int):
@@ -573,6 +662,7 @@ def train(
     n, f = bins.shape
     num_bins = opts.max_bin + 1  # + missing bin
 
+    w_is_default = w is None
     w = np.ones(n, dtype=np.float32) if w is None else np.asarray(w, dtype=np.float32)
     y_np = np.asarray(y, dtype=np.float32)
 
@@ -617,12 +707,36 @@ def train(
     else:
         edges = np.zeros((f, 1))
     edges_dev = put_rep(edges.astype(np.float32))
-    bins_dev = put_rows(np.asarray(bins, dtype=np.int32))
-    y_dev = put_rows(y_np)
-    w_dev = put_rows(w)
-    margins = put_rows(margins0.astype(np.float32))
 
-    step = _make_step(opts, objective, num_bins, mesh)
+    def dev_rows(a):
+        """Re-shard a device-created array onto the row sharding (device-to-
+        device; no host wire traffic)."""
+        return jax.device_put(a, sh_rows) if mesh is not None else a
+
+    # Ship bins as uint8 when they fit (4x less wire traffic — host->device
+    # transfers are the fixed cost of a fit on remote-attached chips);
+    # consumers compare/gather fine on uint8 and the histogram kernels
+    # upcast per-tile.
+    if num_bins <= 256:
+        bins_dev = put_rows(np.ascontiguousarray(bins.astype(np.uint8)))
+    else:
+        bins_dev = put_rows(np.asarray(bins, dtype=np.int32))
+    y_dev = put_rows(y_np)
+    # Constant-valued operands are created ON device instead of uploaded.
+    if w_is_default:
+        w_dev = dev_rows(jnp.ones(n + pad, jnp.float32))
+    else:
+        w_dev = put_rows(w)
+    if init_margins is None:
+        margins = dev_rows(
+            jnp.asarray(init_score, dtype=jnp.float32)[None, :]
+            * jnp.ones((n + pad, 1), jnp.float32)
+        )
+    else:
+        margins = put_rows(margins0.astype(np.float32))
+
+    step_raw = _make_step(opts, objective, num_bins, mesh)
+    step = jax.jit(step_raw, donate_argnums=(3,))
     valid_update = _make_valid_update(opts.routing_steps)
 
     valid_sets = list(valid_sets or [])
@@ -655,52 +769,88 @@ def train(
     best_iter = 0
     stale = 0
 
-    bag_mask_np = presence.copy()
-    for it in range(opts.num_iterations):
-        if opts.bagging_fraction < 1.0 and opts.bagging_freq > 0:
-            if it % opts.bagging_freq == 0:
-                bag_mask_np = np.zeros(n + pad, dtype=np.float32)
-                bag_mask_np[rng.choice(n, size=num_bag, replace=False)] = 1.0
-        if opts.feature_fraction < 1.0:
-            fm = np.zeros(f, dtype=np.float32)
-            fm[rng.choice(f, size=num_feat, replace=False)] = 1.0
+    # Device-resident inputs are uploaded once and only re-uploaded when
+    # bagging/feature-fraction actually resamples, and per-tree outputs stay
+    # on device until one bulk fetch after the loop — host<->device
+    # round-trips per iteration are what dominate wall time on remote-attached
+    # chips (each transfer is a full tunnel round-trip).
+    # presence mask built on device (zeroed pad tail) — no upload
+    bag_dev = dev_rows(
+        jnp.ones(n + pad, jnp.float32)
+        if pad == 0
+        else jnp.ones(n + pad, jnp.float32).at[n:].set(0.0)
+    )
+    fm_ones_dev = put_rep(np.ones(f, dtype=np.float32))
+
+    # Fast path: no per-iteration host decisions (no valid-set metrics, no
+    # mesh special-casing) — run every boosting iteration in ONE device
+    # program via lax.scan. Per-iteration masks come from the same
+    # _mask_schedule as the loop path, so semantics (bagging schedule,
+    # feature sampling, rng stream order) are identical.
+    stacked_trees = None
+    schedule = _mask_schedule(opts, rng, n, pad, num_bag, num_feat, f, presence)
+    if mesh is None and not valid_state and opts.num_iterations > 0:
+        bag_resampling = opts.bagging_fraction < 1.0 and opts.bagging_freq > 0
+        bag_list, fm_list = [], []
+        for bag_np, _, fm_np in schedule:
+            bag_list.append(bag_np)
+            fm_list.append(fm_np if fm_np is not None else np.ones(f, np.float32))
+        if bag_resampling:
+            bag_arg = jnp.asarray(np.stack(bag_list))  # (T, N) scanned
         else:
-            fm = np.ones(f, dtype=np.float32)
-
-        tree, margins = step(
-            bins_dev, y_dev, w_dev, margins, edges_dev,
-            put_rows(bag_mask_np), put_rep(fm),
+            bag_arg = bag_dev  # (N,) closed over inside the program
+        fm_all = jnp.asarray(np.stack(fm_list))
+        runner = _make_scan_steps(step_raw, per_iter_bag=bag_resampling)
+        margins, stacked_trees = runner(
+            bins_dev, y_dev, w_dev, margins, edges_dev, bag_arg, fm_all
         )
-        trees.append(
-            TreeArrays(*[np.asarray(a) for a in tree[:-1]], row_leaf=None)
-        )
+    else:
+        for it, (bag_np, bag_changed, fm_np) in enumerate(schedule):
+            if bag_changed:
+                bag_dev = put_rows(bag_np)
+            fm_dev = put_rep(fm_np) if fm_np is not None else fm_ones_dev
 
-        improved_any = False
-        for vs in valid_state:
-            vs["margins"] = valid_update(vs["bins"], vs["margins"], tree)
-            score = _evaluate(
-                metric, opts.objective, vs["y"], np.asarray(vs["margins"]), vs["w"],
-                opts.alpha,
+            tree, margins = step(
+                bins_dev, y_dev, w_dev, margins, edges_dev, bag_dev, fm_dev,
             )
-            evals[vs["name"]][metric].append(score)
-            # best-so-far from the true score (TrainUtils.scala:276-315);
-            # the first finite eval improves on the ±inf sentinel naturally,
-            # and a NaN score never registers as an improvement.
-            delta = (score - best_score) if higher_better else (best_score - score)
-            if delta > opts.improvement_tolerance:
-                best_score, best_iter, improved_any = score, it + 1, True
-        if valid_state and opts.early_stopping_round > 0:
-            stale = 0 if improved_any else stale + 1
-            if stale >= opts.early_stopping_round:
-                break
+            # Synchronize each iteration on the mesh path: an unbounded async
+            # queue of collective programs can starve a device thread past the
+            # XLA rendezvous timeout (hard abort on the host-platform mesh),
+            # and per-iteration sync is the barrier-execution-mode semantics
+            # of the reference anyway (TrainUtils.scala:477-483).
+            jax.block_until_ready(margins)
+            # drop row_leaf, a (C, N) buffer per tree, before retaining
+            trees.append(tree._replace(row_leaf=None))
 
-    t = len(trees)
+            improved_any = False
+            for vs in valid_state:
+                vs["margins"] = valid_update(vs["bins"], vs["margins"], tree)
+                score = _evaluate(
+                    metric, opts.objective, vs["y"], np.asarray(vs["margins"]),
+                    vs["w"], opts.alpha,
+                )
+                evals[vs["name"]][metric].append(score)
+                # best-so-far from the true score (TrainUtils.scala:276-315);
+                # the first finite eval improves on the ±inf sentinel
+                # naturally, and a NaN score never registers as an improvement.
+                delta = (score - best_score) if higher_better else (best_score - score)
+                if delta > opts.improvement_tolerance:
+                    best_score, best_iter, improved_any = score, it + 1, True
+            if valid_state and opts.early_stopping_round > 0:
+                stale = 0 if improved_any else stale + 1
+                if stale >= opts.early_stopping_round:
+                    break
+
+    t = opts.num_iterations if stacked_trees is not None else len(trees)
     m = opts.num_nodes
 
     def stack(field, dtype):
-        return np.concatenate(
-            [np.asarray(getattr(tr, field)) for tr in trees], axis=0
-        ).reshape(t * num_classes, m).astype(dtype)
+        # concatenate on device, fetch once — not one round-trip per tree
+        if stacked_trees is not None:
+            dev = getattr(stacked_trees, field)  # (T, C, M)
+        else:
+            dev = jnp.concatenate([getattr(tr, field) for tr in trees], axis=0)
+        return np.asarray(dev).reshape(t * num_classes, m).astype(dtype)
 
     left = stack("left", np.int32)
     right = stack("right", np.int32)

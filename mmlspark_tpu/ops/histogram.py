@@ -9,6 +9,10 @@ reference ``lightgbm/TrainUtils.scala:220-315``). Two implementations:
 - ``onehot``: per-feature one-hot matmul ``one_hot(node*B + bin) @ [g,h,c]``.
   Dense MXU work with static shapes — the TPU-first formulation: ~N*K*3
   FLOPs per feature beat sparse scatter on the systolic array.
+- ``pallas``: hand-written kernel fusing one-hot construction with the
+  reduction in VMEM (``ops/pallas_histogram.py``); falls back to
+  ``onehot`` when K exceeds its VMEM budget. A/B numbers and the roofline
+  argument live in ``docs/perf_histogram.md``.
 
 Distribution: callers shard rows across the mesh ``data`` axis; the
 histogram is a sum over rows, so under jit XLA inserts the cross-device
@@ -27,7 +31,10 @@ from jax import lax
 
 
 def _default_method() -> str:
-    return "onehot" if jax.default_backend() in ("tpu", "axon") else "segment"
+    # pallas (VMEM-fused one-hot) measures 1.6x faster than the XLA one-hot
+    # at the leafwise hot shape on v5e (docs/perf_histogram.md); it falls
+    # back to onehot itself when K exceeds its VMEM budget.
+    return "pallas" if jax.default_backend() in ("tpu", "axon") else "segment"
 
 
 def build_histograms(
@@ -59,6 +66,21 @@ def build_histograms(
             flat_data, flat_ids, num_segments=num_nodes * f * num_bins
         )
         return seg.reshape(num_nodes, f, num_bins, 3)
+
+    if method == "pallas":
+        from mmlspark_tpu.ops.pallas_histogram import (
+            build_histograms_pallas,
+            pick_bw,
+        )
+
+        k = num_nodes * num_bins
+        # Below one lane group the XLA one-hot wins (measured 6x at K=64,
+        # docs/perf_histogram.md); above the VMEM budget pallas refuses.
+        if k >= 128 and pick_bw(k):
+            return build_histograms_pallas(
+                bins, grad, hess, count, node, num_nodes, num_bins
+            )
+        method = "onehot"
 
     if method == "onehot":
         k = num_nodes * num_bins

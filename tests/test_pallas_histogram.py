@@ -1,0 +1,62 @@
+"""Pallas histogram kernel — interpret-mode correctness on the CPU mesh
+(the real-chip A/B lives in ``benchmarks/hist_ab.py`` and
+``docs/perf_histogram.md``)."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from mmlspark_tpu.ops.histogram import build_histograms
+from mmlspark_tpu.ops.pallas_histogram import (
+    build_histograms_pallas,
+    pick_bw,
+)
+
+
+def _case(n, f, nodes, b, seed=0):
+    rng = np.random.default_rng(seed)
+    bins = jnp.asarray(rng.integers(0, b, size=(n, f)), dtype=jnp.int32)
+    g = jnp.asarray(rng.normal(size=n), dtype=jnp.float32)
+    h = jnp.asarray(rng.random(n), dtype=jnp.float32)
+    c = jnp.asarray((rng.random(n) < 0.8), dtype=jnp.float32)
+    node = jnp.asarray(rng.integers(0, nodes, size=n), dtype=jnp.int32)
+    return bins, g, h, c, node
+
+
+@pytest.mark.parametrize("n,f,nodes,b", [(3000, 5, 2, 33), (1024, 3, 4, 17)])
+def test_pallas_matches_segment(n, f, nodes, b):
+    bins, g, h, c, node = _case(n, f, nodes, b)
+    ref = build_histograms(bins, g, h, c, node, nodes, b, method="segment")
+    pal = build_histograms_pallas(
+        bins, g, h, c, node, nodes, b, interpret=True
+    )
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(pal), rtol=1e-5, atol=1e-5)
+
+
+def test_pallas_pads_ragged_rows():
+    # N not a multiple of the row block: padding rows must contribute nothing.
+    bins, g, h, c, node = _case(2500, 2, 2, 9)
+    ref = build_histograms(bins, g, h, c, node, 2, 9, method="segment")
+    pal = build_histograms_pallas(bins, g, h, c, node, 2, 9, interpret=True)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(pal), rtol=1e-5, atol=1e-5)
+
+
+def test_pick_bw_budget():
+    assert pick_bw(512) >= 128  # leafwise hot shape fits
+    assert pick_bw(100_000) == 0  # absurd K refuses
+
+
+def test_method_dispatch_falls_back():
+    # K too large for the VMEM budget: method="pallas" silently degrades to
+    # the XLA one-hot rather than erroring.
+    bins, g, h, c, node = _case(512, 2, 8, 256)  # K = 2048
+    assert pick_bw(8 * 256) == 0
+    out = build_histograms(bins, g, h, c, node, 8, 256, method="pallas")
+    ref = build_histograms(bins, g, h, c, node, 8, 256, method="segment")
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-4, atol=1e-4)
+
+
+def test_pallas_oob_value_error():
+    bins, g, h, c, node = _case(512, 2, 2, 9)
+    with pytest.raises(ValueError, match="VMEM budget"):
+        build_histograms_pallas(bins, g, h, c, node, 2, 9, bw=0)
