@@ -3,11 +3,12 @@
 The reference evaluates serialized CNTK graphs per-partition over JNI
 (``cntk/CNTKModel.scala``). Here the model is a jittable JAX function +
 params pytree evaluated in fixed-shape device batches; external graphs
-arrive via :mod:`torch_import` (torch.fx → JAX) or, when the ``onnx``
-package is present, :mod:`onnx_import`.
+arrive via :mod:`torch_import` (torch.fx → JAX) or :mod:`onnx_import`
+(vendored protobuf decoder — no ``onnx`` package required).
 """
 
 from mmlspark_tpu.dnn.model import DNNModel
+from mmlspark_tpu.dnn.onnx_import import from_onnx
 from mmlspark_tpu.dnn.torch_import import from_torch
 
-__all__ = ["DNNModel", "from_torch"]
+__all__ = ["DNNModel", "from_onnx", "from_torch"]

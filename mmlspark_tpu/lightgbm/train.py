@@ -580,7 +580,7 @@ def _make_scan_steps(step, per_iter_bag: bool):
                 bag_i, fmv = per_iter
             else:
                 bag_i, fmv = bag, per_iter
-            tree, m2 = step(bins, y, w, m, edges, bag_i, fmv)
+            tree, m2 = step(bins, y, w, m, edges, bag_i.astype(jnp.float32), fmv)
             return m2, tree._replace(row_leaf=jnp.zeros((), jnp.int32))
 
         xs = (bag, fm_all) if per_iter_bag else fm_all
@@ -796,7 +796,9 @@ def train(
             bag_list.append(bag_np)
             fm_list.append(fm_np if fm_np is not None else np.ones(f, np.float32))
         if bag_resampling:
-            bag_arg = jnp.asarray(np.stack(bag_list))  # (T, N) scanned
+            # uint8 on the wire (masks are 0/1; 4x less than f32 — transfers
+            # are the fixed cost on remote-attached chips); cast per scan step
+            bag_arg = jnp.asarray(np.stack(bag_list).astype(np.uint8))
         else:
             bag_arg = bag_dev  # (N,) closed over inside the program
         fm_all = jnp.asarray(np.stack(fm_list))

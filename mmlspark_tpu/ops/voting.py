@@ -64,9 +64,15 @@ def build_histograms_voting(
     f = bins.shape[1]
     k_sel = min(top_k, f)
 
-    if mesh is None or int(mesh.shape.get("data", 1)) <= 1 or k_sel == f:
+    meshed = mesh is not None and int(mesh.shape.get("data", 1)) > 1
+    if not meshed or k_sel == f:
+        m = method
+        if meshed and m in (None, "pallas"):
+            # Under jit with row-sharded inputs pallas_call has no GSPMD
+            # partitioning rule — keep the shardable XLA formulations.
+            m = "onehot" if jax.default_backend() in ("tpu", "axon") else "segment"
         hist = build_histograms(
-            bins, grad, hess, count, node, num_nodes, num_bins, method=method
+            bins, grad, hess, count, node, num_nodes, num_bins, method=m
         )
         return hist, hist[:, 0, :, :].sum(axis=1)
 

@@ -1,0 +1,239 @@
+"""ONNX import: vendored protobuf codec + graph walker vs torch forward
+with identical weights (the CNTK-evaluator replacement, SURVEY.md §7 step 5;
+reference ``com/microsoft/CNTK/SerializableFunction.scala:17-143``)."""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.data.table import Table
+from mmlspark_tpu.dnn import DNNModel
+from mmlspark_tpu.dnn.onnx_import import from_onnx
+from mmlspark_tpu.dnn.onnx_proto import (
+    decode_model,
+    decode_tensor,
+    encode_model,
+    encode_node,
+    encode_tensor,
+)
+
+
+class TestProtoCodec:
+    def test_tensor_roundtrip(self):
+        for arr in (
+            np.arange(12, dtype=np.float32).reshape(3, 4),
+            np.array([1, -2, 3], dtype=np.int64),
+            np.float32(2.5).reshape(()),
+        ):
+            name, back = decode_tensor(encode_tensor("w", np.atleast_1d(arr)))
+            assert name == "w"
+            np.testing.assert_array_equal(back, np.atleast_1d(arr))
+
+    def test_model_roundtrip(self):
+        w = np.random.default_rng(0).normal(size=(4, 3)).astype(np.float32)
+        node = encode_node("MatMul", ["x", "w"], ["y"])
+        buf = encode_model([node], {"w": w}, ["x", "w"], ["y"], opset=13)
+        model = decode_model(buf)
+        assert model["opset"] == 13
+        g = model["graph"]
+        assert g["nodes"][0]["op_type"] == "MatMul"
+        assert g["nodes"][0]["input"] == ["x", "w"]
+        np.testing.assert_array_equal(g["initializers"]["w"], w)
+        assert g["outputs"] == ["y"]
+
+    def test_attributes_roundtrip(self):
+        node_buf = encode_node(
+            "Conv", ["x", "w"], ["y"],
+            attrs={"strides": [2, 2], "pads": [1, 1, 1, 1], "alpha": 0.5},
+        )
+        buf = encode_model([node_buf], {}, ["x"], ["y"])
+        node = decode_model(buf)["graph"]["nodes"][0]
+        assert node["attrs"]["strides"] == [2, 2]
+        assert node["attrs"]["pads"] == [1, 1, 1, 1]
+        assert abs(node["attrs"]["alpha"] - 0.5) < 1e-7
+
+
+def _mlp_onnx_and_torch(seed=0):
+    import torch
+    import torch.nn as nn
+
+    rng = np.random.default_rng(seed)
+    w1 = rng.normal(size=(16, 10)).astype(np.float32) * 0.3
+    b1 = rng.normal(size=16).astype(np.float32)
+    w2 = rng.normal(size=(4, 16)).astype(np.float32) * 0.3
+    b2 = rng.normal(size=4).astype(np.float32)
+
+    nodes = [
+        encode_node("Gemm", ["x", "w1", "b1"], ["h"], attrs={"transB": 1}),
+        encode_node("Relu", ["h"], ["hr"]),
+        encode_node("Gemm", ["hr", "w2", "b2"], ["logits"], attrs={"transB": 1}),
+        encode_node("Softmax", ["logits"], ["probs"], attrs={"axis": -1}),
+    ]
+    buf = encode_model(
+        nodes, {"w1": w1, "b1": b1, "w2": w2, "b2": b2}, ["x"], ["probs"]
+    )
+
+    tm = nn.Sequential(nn.Linear(10, 16), nn.ReLU(), nn.Linear(16, 4), nn.Softmax(-1))
+    with torch.no_grad():
+        tm[0].weight.copy_(torch.from_numpy(w1))
+        tm[0].bias.copy_(torch.from_numpy(b1))
+        tm[2].weight.copy_(torch.from_numpy(w2))
+        tm[2].bias.copy_(torch.from_numpy(b2))
+    return buf, tm.eval()
+
+
+def _cnn_onnx_and_torch(seed=1):
+    import torch
+    import torch.nn as nn
+
+    rng = np.random.default_rng(seed)
+    wc = rng.normal(size=(6, 3, 3, 3)).astype(np.float32) * 0.2
+    bc = rng.normal(size=6).astype(np.float32)
+    scale = rng.random(6).astype(np.float32) + 0.5
+    bias = rng.normal(size=6).astype(np.float32)
+    mean = rng.normal(size=6).astype(np.float32) * 0.1
+    var = rng.random(6).astype(np.float32) + 0.5
+    wl = rng.normal(size=(5, 6 * 8 * 8)).astype(np.float32) * 0.1
+    bl = rng.normal(size=5).astype(np.float32)
+
+    nodes = [
+        encode_node(
+            "Conv", ["x", "wc", "bc"], ["c"],
+            attrs={"pads": [1, 1, 1, 1], "strides": [1, 1], "kernel_shape": [3, 3]},
+        ),
+        encode_node(
+            "BatchNormalization",
+            ["c", "scale", "bias", "mean", "var"], ["bn"],
+            attrs={"epsilon": 1e-5},
+        ),
+        encode_node("Relu", ["bn"], ["r"]),
+        encode_node(
+            "MaxPool", ["r"], ["p"],
+            attrs={"kernel_shape": [2, 2], "strides": [2, 2]},
+        ),
+        encode_node("Flatten", ["p"], ["fl"], attrs={"axis": 1}),
+        encode_node("Gemm", ["fl", "wl", "bl"], ["y"], attrs={"transB": 1}),
+    ]
+    inits = {
+        "wc": wc, "bc": bc, "scale": scale, "bias": bias,
+        "mean": mean, "var": var, "wl": wl, "bl": bl,
+    }
+    buf = encode_model(nodes, inits, ["x"], ["y"])
+
+    tm = nn.Sequential(
+        nn.Conv2d(3, 6, 3, padding=1),
+        nn.BatchNorm2d(6),
+        nn.ReLU(),
+        nn.MaxPool2d(2),
+        nn.Flatten(),
+        nn.Linear(6 * 8 * 8, 5),
+    )
+    with torch.no_grad():
+        tm[0].weight.copy_(torch.from_numpy(wc))
+        tm[0].bias.copy_(torch.from_numpy(bc))
+        tm[1].weight.copy_(torch.from_numpy(scale))
+        tm[1].bias.copy_(torch.from_numpy(bias))
+        tm[1].running_mean.copy_(torch.from_numpy(mean))
+        tm[1].running_var.copy_(torch.from_numpy(var))
+        tm[5].weight.copy_(torch.from_numpy(wl))
+        tm[5].bias.copy_(torch.from_numpy(bl))
+    return buf, tm.eval()
+
+
+class TestFromOnnx:
+    def test_mlp_matches_torch(self):
+        import torch
+
+        buf, tm = _mlp_onnx_and_torch()
+        fn, params = from_onnx(buf)
+        x = np.random.default_rng(2).normal(size=(7, 10)).astype(np.float32)
+        ours = np.asarray(fn(params, {"x": x})["probs"])
+        with torch.no_grad():
+            theirs = tm(torch.from_numpy(x)).numpy()
+        np.testing.assert_allclose(ours, theirs, rtol=1e-4, atol=1e-5)
+
+    def test_cnn_matches_torch(self):
+        import torch
+
+        buf, tm = _cnn_onnx_and_torch()
+        fn, params = from_onnx(buf)
+        x = np.random.default_rng(3).normal(size=(2, 3, 16, 16)).astype(np.float32)
+        ours = np.asarray(fn(params, {"x": x})["y"])
+        with torch.no_grad():
+            theirs = tm(torch.from_numpy(x)).numpy()
+        np.testing.assert_allclose(ours, theirs, rtol=1e-3, atol=1e-4)
+
+    def test_file_roundtrip(self, tmp_path):
+        buf, _ = _mlp_onnx_and_torch()
+        p = tmp_path / "mlp.onnx"
+        p.write_bytes(buf)
+        fn, params = from_onnx(str(p))
+        x = np.zeros((1, 10), np.float32)
+        out = fn(params, {"x": x})["probs"]
+        np.testing.assert_allclose(np.asarray(out).sum(), 1.0, rtol=1e-5)
+
+    def test_unsupported_op_raises(self):
+        buf = encode_model(
+            [encode_node("FancyCustomOp", ["x"], ["y"])], {}, ["x"], ["y"]
+        )
+        fn, params = from_onnx(buf)
+        with pytest.raises(NotImplementedError, match="FancyCustomOp"):
+            fn(params, {"x": np.zeros((1, 2), np.float32)})
+
+    def test_dnnmodel_integration(self):
+        buf, _ = _mlp_onnx_and_torch()
+        fn, params = from_onnx(buf)
+        rng = np.random.default_rng(4)
+        X = rng.normal(size=(9, 10)).astype(np.float64)
+        t = Table({"feats": X})
+        model = DNNModel(
+            applyFn=fn,
+            modelParams=params,
+            feedDict={"x": "feats"},
+            fetchDict={"scores": "probs"},
+            batchSize=4,
+        )
+        out = model.transform(t)
+        scores = out.column("scores")
+        assert scores.shape == (9, 4)
+        np.testing.assert_allclose(np.sum(scores, axis=1), 1.0, rtol=1e-4)
+
+
+def test_default_valued_attrs_decode():
+    """proto3 omits default-valued scalars: an attribute carrying axis=0
+    arrives as name+type only and must decode to 0, not None."""
+    from mmlspark_tpu.dnn.onnx_proto import _ld, _tag, _varint, decode_attribute
+
+    # name="axis", type=INT(2), no 'i' field on the wire
+    buf = _ld(1, b"axis") + _tag(20, 0) + _varint(2)
+    name, val = decode_attribute(buf)
+    assert name == "axis" and val == 0
+    buf_f = _ld(1, b"beta") + _tag(20, 0) + _varint(1)
+    assert decode_attribute(buf_f) == ("beta", 0.0)
+
+
+def test_concat_axis_zero_via_wire_default():
+    from mmlspark_tpu.dnn.onnx_proto import _ld, _tag, _varint
+
+    # Hand-build Concat with the axis attribute omitted-as-default.
+    attr = _ld(1, b"axis") + _tag(20, 0) + _varint(2)
+    node = (
+        _ld(1, b"a") + _ld(1, b"b") + _ld(2, b"y")
+        + _ld(3, b"c0") + _ld(4, b"Concat") + _ld(5, attr)
+    )
+    buf = encode_model([node], {}, ["a", "b"], ["y"])
+    fn, params = from_onnx(buf)
+    a = np.ones((2, 3), np.float32)
+    b = np.zeros((1, 3), np.float32)
+    out = np.asarray(fn(params, {"a": a, "b": b})["y"])
+    assert out.shape == (3, 3)
+
+
+def test_multi_output_node_raises():
+    node = encode_node(
+        "MaxPool", ["x"], ["y", "indices"],
+        attrs={"kernel_shape": [2, 2], "strides": [2, 2]},
+    )
+    buf = encode_model([node], {}, ["x"], ["y"])
+    fn, params = from_onnx(buf)
+    with pytest.raises(NotImplementedError, match="2 outputs"):
+        fn(params, {"x": np.zeros((1, 1, 4, 4), np.float32)})
