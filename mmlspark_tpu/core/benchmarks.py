@@ -1,0 +1,118 @@
+"""Golden-file benchmark regression harness.
+
+The reference keeps itself honest with ``Benchmark(name, value, precision,
+higherIsBetter)`` rows compared against golden CSVs checked into the test
+tree (``core/test/benchmarks/Benchmarks.scala:16-110``;
+``src/test/resources/benchmarks/*.csv``). Same contract here: a suite
+accumulates benchmarks, writes the "new" CSV next to the golden one for
+easy promotion, and ``verify`` fails with a per-row report when a value
+regresses beyond its precision.
+"""
+
+from __future__ import annotations
+
+import csv
+import dataclasses
+import os
+from typing import Dict, List, Optional
+
+
+@dataclasses.dataclass
+class Benchmark:
+    name: str
+    value: float
+    precision: float
+    higher_is_better: bool = True
+
+    def compare(self, golden: "Benchmark") -> Optional[str]:
+        """None when within tolerance, else a human-readable failure. The
+        golden row's direction governs (a measuring-side direction mistake
+        must not flip the check) and disagreement is itself a failure."""
+        if self.higher_is_better != golden.higher_is_better:
+            return (
+                f"{self.name}: higher_is_better mismatch (measured "
+                f"{self.higher_is_better}, golden {golden.higher_is_better})"
+            )
+        if golden.higher_is_better:
+            # regressions fail; improvements beyond precision pass
+            if self.value < golden.value - golden.precision:
+                return (
+                    f"{self.name}: {self.value:.5f} regressed below golden "
+                    f"{golden.value:.5f} - {golden.precision}"
+                )
+        else:
+            if self.value > golden.value + golden.precision:
+                return (
+                    f"{self.name}: {self.value:.5f} regressed above golden "
+                    f"{golden.value:.5f} + {golden.precision}"
+                )
+        return None
+
+
+class BenchmarkSuite:
+    """Accumulate benchmarks, then verify against a golden CSV
+    (columns: name,value,precision,higher_is_better)."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.benchmarks: List[Benchmark] = []
+
+    def add(
+        self, name: str, value: float, precision: float, higher_is_better: bool = True
+    ) -> None:
+        self.benchmarks.append(
+            Benchmark(name, float(value), float(precision), higher_is_better)
+        )
+
+    @staticmethod
+    def read_csv(path: str) -> Dict[str, Benchmark]:
+        out: Dict[str, Benchmark] = {}
+        with open(path, newline="") as fh:
+            for row in csv.DictReader(fh):
+                out[row["name"]] = Benchmark(
+                    name=row["name"],
+                    value=float(row["value"]),
+                    precision=float(row["precision"]),
+                    higher_is_better=row.get("higher_is_better", "true").lower()
+                    in ("1", "true", "yes"),
+                )
+        return out
+
+    def write_csv(self, path: str) -> None:
+        with open(path, "w", newline="") as fh:
+            w = csv.writer(fh)
+            w.writerow(["name", "value", "precision", "higher_is_better"])
+            for b in self.benchmarks:
+                w.writerow([b.name, f"{b.value:.6f}", b.precision, str(b.higher_is_better).lower()])
+
+    def verify(self, golden_path: str, new_dir: Optional[str] = None) -> None:
+        """Compare against the golden CSV; raises AssertionError listing every
+        regressed or unknown row. Writes the measured values to
+        ``<golden>.new.csv`` (or into ``new_dir``) so promoting a new golden
+        is one file copy — the reference workflow."""
+        new_path = (
+            os.path.join(new_dir, os.path.basename(golden_path) + ".new.csv")
+            if new_dir
+            else golden_path + ".new.csv"
+        )
+        self.write_csv(new_path)
+        golden = self.read_csv(golden_path)
+        failures: List[str] = []
+        for b in self.benchmarks:
+            g = golden.get(b.name)
+            if g is None:
+                failures.append(
+                    f"{b.name}: no golden row (promote {new_path} to add it)"
+                )
+            else:
+                msg = b.compare(g)
+                if msg:
+                    failures.append(msg)
+        missing = set(golden) - {b.name for b in self.benchmarks}
+        for name in sorted(missing):
+            failures.append(f"{name}: golden row never measured this run")
+        if failures:
+            raise AssertionError(
+                f"benchmark regressions in suite {self.name!r}:\n  "
+                + "\n  ".join(failures)
+            )

@@ -78,6 +78,9 @@ class LightGBMParams(
         validator=one_of("data_parallel", "voting_parallel", "serial"),
     )
     topK = Param("Top features for voting parallel", default=20, converter=to_int, validator=gt(0))
+    topRate = Param("GOSS: kept fraction of large-gradient rows", default=0.2, converter=to_float, validator=in_range(0, 1))
+    otherRate = Param("GOSS: sampled fraction of remaining rows", default=0.1, converter=to_float, validator=in_range(0, 1))
+    dropRate = Param("DART: per-tree dropout probability", default=0.1, converter=to_float, validator=in_range(0, 1))
     growthPolicy = Param(
         "leafwise (LightGBM best-first, numLeaves-bounded) or depthwise "
         "(balanced levels — fewer, larger MXU passes)",
@@ -128,6 +131,9 @@ class LightGBMParams(
                 else "data_parallel"
             ),
             top_k=self.getTopK(),
+            top_rate=self.getTopRate(),
+            other_rate=self.getOtherRate(),
+            drop_rate=self.getDropRate(),
         )
         kwargs.update(self._extra_train_options())
         return TrainOptions(**kwargs)

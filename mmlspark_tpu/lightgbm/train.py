@@ -87,6 +87,9 @@ class TrainOptions:
     growth: str = "leafwise"  # leafwise | depthwise
     tree_learner: str = "data_parallel"  # data_parallel | voting_parallel
     top_k: int = 20  # voting_parallel vote width
+    top_rate: float = 0.2  # goss: kept fraction of large-gradient rows
+    other_rate: float = 0.1  # goss: sampled fraction of the rest
+    drop_rate: float = 0.1  # dart: per-tree drop probability
     verbosity: int = -1
 
     @property
@@ -543,11 +546,29 @@ def _make_step(opts: TrainOptions, objective: Objective, num_bins: int, mesh=Non
         "tweedie_variance_power": opts.tweedie_variance_power,
     }
 
-    def step(bins, y, w, margins, edges, bag_mask, feature_mask):
+    def step(bins, y, w, margins, edges, bag_mask, feature_mask, it):
         grad, hess = objective.grad_hess(margins, y, w, **obj_kwargs)  # (N, C)
+
+        if opts.boosting_type == "goss":
+            # Gradient-based One-Side Sampling: keep the top_rate fraction of
+            # rows by |gradient|, sample other_rate of the rest, and amplify
+            # the sampled small-gradient rows by (1-a)/b so histogram sums
+            # stay unbiased (the GOSS estimator from the LightGBM paper).
+            n_rows = grad.shape[0]
+            gabs = jnp.abs(grad).sum(axis=1) * bag_mask
+            n_top = max(1, int(round(n_rows * opts.top_rate)))
+            thresh = lax.top_k(gabs, n_top)[0][-1]
+            top = gabs >= thresh
+            key = jax.random.fold_in(jax.random.PRNGKey(opts.seed), it)
+            p = opts.other_rate / max(1e-12, 1.0 - opts.top_rate)
+            sampled = (~top) & (jax.random.uniform(key, (n_rows,)) < p)
+            amp = (1.0 - opts.top_rate) / max(1e-12, opts.other_rate)
+            goss_w = top.astype(grad.dtype) + sampled.astype(grad.dtype) * amp
+            bag_mask = bag_mask * goss_w
+
         grad = grad * bag_mask[:, None]
         hess = hess * bag_mask[:, None]
-        count = bag_mask
+        count = (bag_mask > 0).astype(grad.dtype)
 
         def per_class(g, h):
             return build(
@@ -556,6 +577,11 @@ def _make_step(opts: TrainOptions, objective: Objective, num_bins: int, mesh=Non
             )
 
         tree = jax.vmap(per_class, in_axes=(1, 1))(grad, hess)  # (C, ...) arrays
+        if opts.boosting_type == "rf":
+            # Random-forest mode: trees fit the init-score residual
+            # independently; margins never accumulate during training and
+            # the final booster's leaf values are averaged post-hoc.
+            return tree, margins
         # margins update: row_leaf (C, N) slots into leaf_val (C, M)
         contrib = jnp.take_along_axis(tree.leaf_val, tree.row_leaf, axis=1).T  # (N, C)
         return tree, margins + contrib
@@ -575,15 +601,19 @@ def _make_scan_steps(step, per_iter_bag: bool):
     (iterations, N) buffer is ever materialized."""
 
     def run(bins, y, w, margins, edges, bag, fm_all):
+        iters = fm_all.shape[0]
+
         def body(m, per_iter):
             if per_iter_bag:
-                bag_i, fmv = per_iter
+                it, bag_i, fmv = per_iter
             else:
-                bag_i, fmv = bag, per_iter
-            tree, m2 = step(bins, y, w, m, edges, bag_i.astype(jnp.float32), fmv)
+                it, fmv = per_iter
+                bag_i = bag
+            tree, m2 = step(bins, y, w, m, edges, bag_i.astype(jnp.float32), fmv, it)
             return m2, tree._replace(row_leaf=jnp.zeros((), jnp.int32))
 
-        xs = (bag, fm_all) if per_iter_bag else fm_all
+        idx = jnp.arange(iters, dtype=jnp.int32)
+        xs = (idx, bag, fm_all) if per_iter_bag else (idx, fm_all)
         margins_out, trees = lax.scan(body, margins, xs)
         return margins_out, trees
 
@@ -610,16 +640,29 @@ def _mask_schedule(opts: "TrainOptions", rng, n, pad, num_bag, num_feat, f, pres
         yield bag, changed, fm
 
 
-def _make_valid_update(steps: int):
-    def update(bins_v, margins_v, tree):
-        def per_class(f, bthr, lc, rc, il, vals):
-            leaf = _route_binned(bins_v, f, bthr, lc, rc, il, steps)
-            return vals[leaf]
+def _make_tree_contrib(steps: int):
+    """(N, C) margin contribution of ONE tree-round on a binned matrix —
+    used by dart mode to subtract dropped trees."""
 
-        contrib = jax.vmap(per_class, out_axes=1)(
-            tree.feat, tree.bin, tree.left, tree.right, tree.is_leaf, tree.leaf_val
+    @jax.jit
+    def contrib(bins_v, feat, bthr, lc, rc, il, vals):
+        def per_class(f_, b_, l_, r_, i_, v_):
+            leaf = _route_binned(bins_v, f_, b_, l_, r_, i_, steps)
+            return v_[leaf]
+
+        return jax.vmap(per_class, out_axes=1)(feat, bthr, lc, rc, il, vals)
+
+    return contrib
+
+
+def _make_valid_update(steps: int):
+    contrib = _make_tree_contrib(steps)
+
+    def update(bins_v, margins_v, tree):
+        return margins_v + contrib(
+            bins_v, tree.feat, tree.bin, tree.left, tree.right, tree.is_leaf,
+            tree.leaf_val,
         )
-        return margins_v + contrib
 
     return jax.jit(update, donate_argnums=(1,))
 
@@ -657,6 +700,31 @@ def train(
     feature_names: Optional[List[str]] = None,
 ) -> TrainResult:
     """Run boosting. ``valid_sets`` entries are (name, bins_v, y_v, w_v)."""
+    # Boosting-type contracts (matching native LightGBM's own errors):
+    if opts.boosting_type == "rf":
+        if not (opts.bagging_fraction < 1.0 and opts.bagging_freq > 0):
+            raise ValueError(
+                "boosting_type='rf' requires bagging "
+                "(bagging_fraction < 1 and bagging_freq > 0)"
+            )
+        if valid_sets:
+            raise ValueError(
+                "boosting_type='rf' does not support validation sets "
+                "(averaged-ensemble eval is not incremental)"
+            )
+        # rf trees are full-strength; averaging happens at the end
+        opts = dataclasses.replace(opts, learning_rate=1.0)
+    elif opts.boosting_type == "goss":
+        if opts.bagging_fraction < 1.0:
+            raise ValueError("boosting_type='goss' cannot be combined with bagging")
+        if opts.top_rate + opts.other_rate > 1.0:
+            raise ValueError(
+                "goss requires top_rate + other_rate <= 1 "
+                f"(got {opts.top_rate} + {opts.other_rate})"
+            )
+    elif opts.boosting_type == "dart":
+        if opts.early_stopping_round > 0:
+            raise ValueError("early stopping is not available in dart mode")
     objective = get_objective(opts.objective)
     num_classes = objective.num_outputs_fn(opts.num_class)
     n, f = bins.shape
@@ -789,7 +857,12 @@ def train(
     # feature sampling, rng stream order) are identical.
     stacked_trees = None
     schedule = _mask_schedule(opts, rng, n, pad, num_bag, num_feat, f, presence)
-    if mesh is None and not valid_state and opts.num_iterations > 0:
+    if (
+        mesh is None
+        and not valid_state
+        and opts.num_iterations > 0
+        and opts.boosting_type != "dart"  # dart drops trees per host decision
+    ):
         bag_resampling = opts.bagging_fraction < 1.0 and opts.bagging_freq > 0
         bag_list, fm_list = [], []
         for bag_np, _, fm_np in schedule:
@@ -807,14 +880,66 @@ def train(
             bins_dev, y_dev, w_dev, margins, edges_dev, bag_arg, fm_all
         )
     else:
+        dart_rng = np.random.default_rng(opts.seed + 7919)
+        tree_contrib = _make_tree_contrib(opts.routing_steps)
+
+        def contrib_of(tr, bins_v):
+            return tree_contrib(
+                bins_v, tr.feat, tr.bin, tr.left, tr.right, tr.is_leaf, tr.leaf_val
+            )
+
         for it, (bag_np, bag_changed, fm_np) in enumerate(schedule):
             if bag_changed:
                 bag_dev = put_rows(bag_np)
             fm_dev = put_rep(fm_np) if fm_np is not None else fm_ones_dev
 
-            tree, margins = step(
-                bins_dev, y_dev, w_dev, margins, edges_dev, bag_dev, fm_dev,
+            # dart: drop a random subset of existing trees from the margins
+            # the new tree fits against (each with prob drop_rate), then
+            # renormalize — new tree x 1/(k+1), dropped trees x k/(k+1)
+            # (the DART weight-shrinkage rule).
+            dropped = []
+            if opts.boosting_type == "dart" and trees:
+                dropped = list(np.nonzero(
+                    dart_rng.random(len(trees)) < opts.drop_rate
+                )[0])
+            if dropped:
+                c_d = contrib_of(trees[dropped[0]], bins_dev)
+                for di in dropped[1:]:
+                    c_d = c_d + contrib_of(trees[di], bins_dev)
+                margins_in = margins - c_d
+            else:
+                margins_in = margins
+
+            tree, new_margins = step(
+                bins_dev, y_dev, w_dev, margins_in, edges_dev, bag_dev, fm_dev,
+                jnp.int32(it),
             )
+
+            if dropped:
+                k = len(dropped)
+                scale_new = 1.0 / (k + 1)
+                scale_drop = k / (k + 1)
+                # margins_in was donated into step — recover the unscaled
+                # new-tree contribution from the row->leaf map it computed
+                c_new = jnp.take_along_axis(tree.leaf_val, tree.row_leaf, axis=1).T
+                # valid-set deltas need the PRE-scaled dropped trees
+                for vs in valid_state:
+                    c_dv = contrib_of(trees[dropped[0]], vs["bins"])
+                    for di in dropped[1:]:
+                        c_dv = c_dv + contrib_of(trees[di], vs["bins"])
+                    c_newv = contrib_of(tree, vs["bins"])
+                    vs["margins"] = (
+                        vs["margins"] - c_dv * scale_new + c_newv * scale_new
+                    )
+                    vs["_updated"] = True
+                tree = tree._replace(leaf_val=tree.leaf_val * scale_new)
+                for di in dropped:
+                    trees[di] = trees[di]._replace(
+                        leaf_val=trees[di].leaf_val * scale_drop
+                    )
+                margins = margins - c_d * scale_new + c_new * scale_new
+            else:
+                margins = new_margins
             # Synchronize each iteration on the mesh path: an unbounded async
             # queue of collective programs can starve a device thread past the
             # XLA rendezvous timeout (hard abort on the host-platform mesh),
@@ -826,7 +951,10 @@ def train(
 
             improved_any = False
             for vs in valid_state:
-                vs["margins"] = valid_update(vs["bins"], vs["margins"], tree)
+                if vs.pop("_updated", False):
+                    pass  # dart already applied this round's delta
+                else:
+                    vs["margins"] = valid_update(vs["bins"], vs["margins"], tree)
                 score = _evaluate(
                     metric, opts.objective, vs["y"], np.asarray(vs["margins"]),
                     vs["w"], opts.alpha,
@@ -857,6 +985,10 @@ def train(
     left = stack("left", np.int32)
     right = stack("right", np.int32)
     is_leaf = stack("is_leaf", bool)
+    leaf_values = stack("leaf_val", np.float32)
+    if opts.boosting_type == "rf":
+        # random-forest mode predicts the AVERAGE of the trees
+        leaf_values = leaf_values / max(1, t)
     booster = Booster(
         split_feature=stack("feat", np.int32),
         split_bin=stack("bin", np.int32),
@@ -864,7 +996,7 @@ def train(
         left_child=left,
         right_child=right,
         is_leaf=is_leaf,
-        leaf_values=stack("leaf_val", np.float32),
+        leaf_values=leaf_values,
         cover=stack("cover", np.float32),
         split_gain=stack("gain", np.float32),
         init_score=np.asarray(init_score, dtype=np.float32),

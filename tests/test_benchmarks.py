@@ -1,0 +1,147 @@
+"""Golden-file benchmark regression tests — the ``Benchmarks.scala:16-110``
+analogue (reference goldens: ``benchmarks_VerifyLightGBMClassifier.csv`` et
+al., e.g. breast-cancer gbdt AUC 0.99247 ± 0.01). Measured values are
+compared against ``tests/benchmarks/golden_metrics.csv``; the harness
+writes ``*.new.csv`` next to it so promoting a new golden is one copy."""
+
+import os
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core.benchmarks import Benchmark, BenchmarkSuite
+from mmlspark_tpu.data.table import Table
+
+GOLDEN = os.path.join(os.path.dirname(__file__), "benchmarks", "golden_metrics.csv")
+
+
+def _auc(y, score):
+    from mmlspark_tpu.lightgbm.objectives import auc
+
+    return float(auc(np.asarray(y), np.asarray(score), np.ones(len(y))))
+
+
+@pytest.fixture(scope="module")
+def datasets():
+    from sklearn.datasets import load_breast_cancer, load_diabetes
+
+    rng = np.random.default_rng(0)
+    bc = load_breast_cancer()
+    perm = rng.permutation(len(bc.target))
+    Xb, yb = bc.data[perm], bc.target[perm].astype(np.float64)
+    nb = int(0.8 * len(yb))
+
+    db = load_diabetes()
+    perm2 = rng.permutation(len(db.target))
+    Xd, yd = db.data[perm2], db.target[perm2].astype(np.float64)
+    nd = int(0.8 * len(yd))
+    return {
+        "bc_train": Table({"features": Xb[:nb], "label": yb[:nb]}),
+        "bc_test": (Xb[nb:], yb[nb:]),
+        "db_train": Table({"features": Xd[:nd], "label": yd[:nd]}),
+        "db_test": (Xd[nd:], yd[nd:]),
+    }
+
+
+def test_golden_metrics(datasets):
+    from mmlspark_tpu.lightgbm import LightGBMClassifier, LightGBMRegressor
+    from mmlspark_tpu.train import TrainClassifier
+    from mmlspark_tpu.vw import VowpalWabbitRegressor
+
+    suite = BenchmarkSuite("core_metrics")
+
+    # LightGBMClassifier AUC per boosting type, mirroring the reference's
+    # dataset x boosting-type golden matrix (VerifyLightGBMClassifier.csv)
+    Xt, yt = datasets["bc_test"]
+    for boosting, extra in (
+        ("gbdt", {}),
+        ("goss", {}),
+        ("dart", {"dropRate": 0.2}),
+        ("rf", {"baggingFraction": 0.6, "baggingFreq": 1}),
+    ):
+        clf = LightGBMClassifier(
+            numIterations=40, numLeaves=15, boostingType=boosting, seed=0,
+            parallelism="serial", **extra,
+        )
+        model = clf.fit(datasets["bc_train"])
+        margins = model.booster.raw_margin(Xt)[:, 0]
+        suite.add(f"breast_cancer_{boosting}_auc", _auc(yt, margins), 0.01)
+
+    # LightGBMRegressor RMSE (VerifyLightGBMRegressor.csv loss rows)
+    Xd, yd = datasets["db_test"]
+    reg = LightGBMRegressor(numIterations=60, numLeaves=15, seed=0, parallelism="serial")
+    rmodel = reg.fit(datasets["db_train"])
+    pred = rmodel.booster.raw_margin(Xd)[:, 0]
+    rmse = float(np.sqrt(np.mean((pred - yd) ** 2)))
+    suite.add("diabetes_gbdt_rmse", rmse, 5.0, higher_is_better=False)
+
+    # VowpalWabbitRegressor loss (VerifyVowpalWabbitRegressor.csv)
+    vw = VowpalWabbitRegressor(numPasses=5)
+    vmodel = vw.fit(datasets["db_train"])
+    vout = vmodel.transform(Table({"features": Xd, "label": yd}))
+    vrmse = float(np.sqrt(np.mean((vout.column("prediction") - yd) ** 2)))
+    suite.add("diabetes_vw_rmse", vrmse, 10.0, higher_is_better=False)
+
+    # TrainClassifier end-to-end accuracy (VerifyTrainClassifier.csv)
+    tc = TrainClassifier(
+        model=LightGBMClassifier(numIterations=20, numLeaves=7, parallelism="serial"),
+        labelCol="label",
+    )
+    tmodel = tc.fit(datasets["bc_train"])
+    tout = tmodel.transform(Table({"features": Xt, "label": yt}))
+    acc = float((tout.column("prediction") == yt).mean())
+    suite.add("breast_cancer_trainclassifier_acc", acc, 0.03)
+
+    suite.verify(GOLDEN)
+
+
+class TestHarness:
+    def test_regression_detected(self, tmp_path):
+        golden = tmp_path / "g.csv"
+        s0 = BenchmarkSuite("s")
+        s0.add("m1", 0.95, 0.01)
+        s0.add("m2", 3.0, 0.5, higher_is_better=False)
+        s0.write_csv(str(golden))
+
+        ok = BenchmarkSuite("s")
+        ok.add("m1", 0.945, 0.01)  # within precision
+        ok.add("m2", 3.4, 0.5, higher_is_better=False)
+        ok.verify(str(golden))
+
+        # direction mistakes on the measuring side must not flip the check
+        flipped = BenchmarkSuite("s")
+        flipped.add("m1", 0.945, 0.01)
+        flipped.add("m2", 500.0, 0.5)  # forgot higher_is_better=False
+        with pytest.raises(AssertionError, match="higher_is_better mismatch"):
+            flipped.verify(str(golden))
+
+        bad = BenchmarkSuite("s")
+        bad.add("m1", 0.90, 0.01)
+        bad.add("m2", 3.0, 0.5, higher_is_better=False)
+        with pytest.raises(AssertionError, match="m1"):
+            bad.verify(str(golden))
+
+    def test_unknown_and_missing_rows(self, tmp_path):
+        golden = tmp_path / "g.csv"
+        s0 = BenchmarkSuite("s")
+        s0.add("m1", 1.0, 0.1)
+        s0.write_csv(str(golden))
+
+        extra = BenchmarkSuite("s")
+        extra.add("m1", 1.0, 0.1)
+        extra.add("new_metric", 2.0, 0.1)
+        with pytest.raises(AssertionError, match="new_metric"):
+            extra.verify(str(golden))
+
+        partial = BenchmarkSuite("s")
+        with pytest.raises(AssertionError, match="never measured"):
+            partial.verify(str(golden))
+
+    def test_improvement_passes(self, tmp_path):
+        golden = tmp_path / "g.csv"
+        s0 = BenchmarkSuite("s")
+        s0.add("auc", 0.9, 0.01)
+        s0.write_csv(str(golden))
+        better = BenchmarkSuite("s")
+        better.add("auc", 0.99, 0.01)
+        better.verify(str(golden))  # improvements never fail

@@ -193,3 +193,109 @@ def test_voting_parallel_feature_fraction(mesh8):
     assert score > 0.8, score
     # trees actually grew (premature-leaf regression guard)
     assert (~r.booster.is_leaf).sum() > 0
+
+
+class TestBoostingTypes:
+    """rf/dart/goss are real algorithms, not accepted-and-ignored strings
+    (LightGBMParams.scala boostingType)."""
+
+    def _data(self, n=800, f=8, seed=21):
+        rng = np.random.default_rng(seed)
+        X = rng.normal(size=(n, f))
+        y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float64)
+        return X, y
+
+    def test_goss_differs_from_gbdt_and_learns(self):
+        X, y = self._data()
+        bins, mapper = bin_dataset(X, max_bin=63)
+        base = dict(objective="binary", num_iterations=15, num_leaves=15, max_bin=63)
+        r_gbdt = train(bins, y, TrainOptions(**base), mapper=mapper)
+        r_goss = train(
+            bins, y, TrainOptions(**base, boosting_type="goss"), mapper=mapper
+        )
+        w = np.ones(len(y))
+        auc_goss = auc_metric(y, r_goss.booster.raw_margin(X)[:, 0], w)
+        assert auc_goss > 0.9, auc_goss
+        # the sampled histogram must actually change the trees
+        assert not np.array_equal(
+            r_gbdt.booster.leaf_values, r_goss.booster.leaf_values
+        )
+
+    def test_goss_rejects_bagging(self):
+        X, y = self._data(n=100)
+        bins, mapper = bin_dataset(X, max_bin=31)
+        with pytest.raises(ValueError, match="goss"):
+            train(
+                bins, y,
+                TrainOptions(
+                    objective="binary", num_iterations=2, boosting_type="goss",
+                    bagging_fraction=0.5, bagging_freq=1,
+                ),
+                mapper=mapper,
+            )
+
+    def test_rf_mode_averages(self):
+        X, y = self._data()
+        bins, mapper = bin_dataset(X, max_bin=63)
+        r = train(
+            bins, y,
+            TrainOptions(
+                objective="binary", num_iterations=10, num_leaves=15, max_bin=63,
+                boosting_type="rf", bagging_fraction=0.6, bagging_freq=1,
+            ),
+            mapper=mapper,
+        )
+        w = np.ones(len(y))
+        score = auc_metric(y, r.booster.raw_margin(X)[:, 0], w)
+        assert score > 0.9, score
+        # averaged leaves: magnitudes an order below full-strength trees
+        mags = np.abs(r.booster.leaf_values[r.booster.is_leaf])
+        assert mags.max() < 2.0
+
+    def test_rf_requires_bagging(self):
+        X, y = self._data(n=100)
+        bins, mapper = bin_dataset(X, max_bin=31)
+        with pytest.raises(ValueError, match="rf"):
+            train(
+                bins, y,
+                TrainOptions(objective="binary", num_iterations=2, boosting_type="rf"),
+                mapper=mapper,
+            )
+
+    def test_dart_learns_and_scales_trees(self):
+        X, y = self._data()
+        bins, mapper = bin_dataset(X, max_bin=63)
+        r = train(
+            bins, y,
+            TrainOptions(
+                objective="binary", num_iterations=20, num_leaves=15, max_bin=63,
+                boosting_type="dart", drop_rate=0.3, seed=5,
+            ),
+            mapper=mapper,
+        )
+        w = np.ones(len(y))
+        score = auc_metric(y, r.booster.raw_margin(X)[:, 0], w)
+        assert score > 0.9, score
+
+    def test_dart_rejects_early_stopping(self):
+        X, y = self._data(n=100)
+        bins, mapper = bin_dataset(X, max_bin=31)
+        with pytest.raises(ValueError, match="dart"):
+            train(
+                bins, y,
+                TrainOptions(
+                    objective="binary", num_iterations=2, boosting_type="dart",
+                    early_stopping_round=2,
+                ),
+                mapper=mapper,
+            )
+
+    def test_estimator_boosting_type_param(self):
+        X, y = self._data(n=300)
+        t = _to_table(X, y)
+        m = LightGBMClassifier(
+            numIterations=5, numLeaves=7, boostingType="dart", dropRate=0.2,
+            parallelism="serial",
+        ).fit(t)
+        out = m.transform(t)
+        assert "prediction" in out.columns
