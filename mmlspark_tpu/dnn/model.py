@@ -69,6 +69,15 @@ class DNNModel(Model):
         converter=to_bool,
     )
     inputDtype = Param("Cast inputs to this dtype", default="float32", converter=to_str)
+    paramShardings = Param(
+        "Tensor-parallel map: param key -> axis index sharded over the mesh "
+        "'model' axis (None = fully replicated params)",
+        default=None, is_complex=True,
+    )
+    meshConfig = Param(
+        "MeshConfig for shardOverMesh (None = all devices on the data axis)",
+        default=None, is_complex=True,
+    )
     shardOverMesh = Param(
         "Shard each batch over the mesh 'data' axis", default=False, converter=to_bool
     )
@@ -102,15 +111,46 @@ class DNNModel(Model):
 
             from mmlspark_tpu.parallel.mesh import make_mesh
 
-            mesh = make_mesh()
+            mesh_config = self.getMeshConfig()
+            mesh = make_mesh(mesh_config)
             batch_sharding = NamedSharding(mesh, P("data"))
             replicated = NamedSharding(mesh, P())
+            # Tensor parallelism: paramShardings maps a param-pytree key to
+            # the axis index sharded over the mesh "model" axis (e.g. the
+            # output-features dim of a Linear weight). XLA then partitions
+            # the matmuls and inserts the all-gather/reduce-scatter
+            # collectives (the TP recipe: annotate shardings, let GSPMD
+            # place the collectives).
+            tp: Dict[str, int] = self.getParamShardings() or {}
+            if tp and not isinstance(self.getModelParams(), dict):
+                raise ValueError(
+                    "paramShardings requires modelParams to be a flat dict "
+                    f"of arrays (got {type(self.getModelParams()).__name__})"
+                )
+            for key, axis in tp.items():
+                val = self.getModelParams().get(key)
+                if val is None:
+                    raise ValueError(f"paramShardings key {key!r} not in modelParams")
+                if np.ndim(val) <= axis:
+                    raise ValueError(
+                        f"paramShardings[{key!r}]={axis} out of range for a "
+                        f"{np.ndim(val)}-d param"
+                    )
+
+            def shard_for(key, value):
+                if key in tp:
+                    spec = [None] * np.ndim(value)
+                    spec[tp[key]] = "model"
+                    return NamedSharding(mesh, P(*spec))
+                return replicated
 
             def run(params, inputs):
                 inputs = {
                     k: jax.device_put(v, batch_sharding) for k, v in inputs.items()
                 }
-                params = jax.device_put(params, replicated)
+                params = {
+                    k: jax.device_put(v, shard_for(k, v)) for k, v in params.items()
+                } if isinstance(params, dict) else jax.device_put(params, replicated)
                 return apply_fn(params, inputs)
 
             return jax.jit(run), mesh
@@ -127,7 +167,7 @@ class DNNModel(Model):
         if self.getShardOverMesh():
             from mmlspark_tpu.parallel.mesh import make_mesh
 
-            n_dev = make_mesh().shape.get("data", 1)
+            n_dev = make_mesh(self.getMeshConfig()).shape.get("data", 1)
             batch_size = max(batch_size, n_dev)
             batch_size += (-batch_size) % n_dev
         dtype = np.dtype(self.getInputDtype())

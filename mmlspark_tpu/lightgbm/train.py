@@ -748,8 +748,15 @@ def train(
     # with zero weight/count so it never influences histograms or stats — the
     # "empty partition sends ignore" analogue (LightGBMUtils.scala:144-161).
     pad = 0
+    sh_bins = None
     if mesh is not None:
-        from mmlspark_tpu.parallel.mesh import data_sharding, pad_to_multiple, replicated
+        from mmlspark_tpu.parallel.mesh import (
+            AXIS_MODEL,
+            data_sharding,
+            feature_parallel_sharding,
+            pad_to_multiple,
+            replicated,
+        )
 
         shard_n = int(mesh.shape["data"])
         padded_n, pad = pad_to_multiple(n, shard_n)
@@ -762,6 +769,14 @@ def train(
             )
         sh_rows = data_sharding(mesh)
         sh_rep = replicated(mesh)
+        model_size = int(mesh.shape.get(AXIS_MODEL, 1))
+        if model_size > 1 and f % model_size == 0:
+            # feature parallel: bins vertically partitioned over the model
+            # axis (LightGBM's feature_parallel layout); XLA partitions the
+            # histogram build/split search and inserts the best-split
+            # argmax collectives across model shards itself. (Indivisible
+            # feature counts stay row-sharded/replicated over model.)
+            sh_bins = feature_parallel_sharding(mesh)
         put_rows = lambda a: jax.device_put(a, sh_rows)
         put_rep = lambda a: jax.device_put(a, sh_rep)
     else:
@@ -785,10 +800,11 @@ def train(
     # transfers are the fixed cost of a fit on remote-attached chips);
     # consumers compare/gather fine on uint8 and the histogram kernels
     # upcast per-tile.
+    put_bins = (lambda a: jax.device_put(a, sh_bins)) if sh_bins is not None else put_rows
     if num_bins <= 256:
-        bins_dev = put_rows(np.ascontiguousarray(bins.astype(np.uint8)))
+        bins_dev = put_bins(np.ascontiguousarray(bins.astype(np.uint8)))
     else:
-        bins_dev = put_rows(np.asarray(bins, dtype=np.int32))
+        bins_dev = put_bins(np.asarray(bins, dtype=np.int32))
     y_dev = put_rows(y_np)
     # Constant-valued operands are created ON device instead of uploaded.
     if w_is_default:

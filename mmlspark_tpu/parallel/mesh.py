@@ -149,6 +149,102 @@ def pad_to_multiple(
     return padded, padded - n
 
 
+def distributed_init(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    executor_ids: Optional[Sequence[str]] = None,
+    local_executor_id: Optional[str] = None,
+) -> Topology:
+    """Multi-host bootstrap — the surviving driver-rendezvous role.
+
+    The reference's driver collects executor host:port lines over a
+    ``ServerSocket`` and broadcasts the worker list
+    (``lightgbm/LightGBMUtils.scala:117-186``, ``ClusterUtil.scala:107-177``);
+    on TPU the collective mesh is the JAX runtime's job and the driver's
+    only duty is numbering the processes. Two calling conventions:
+
+    - explicit: ``coordinator_address`` (driver host:port), ``num_processes``,
+      ``process_id`` — forwarded to :func:`jax.distributed.initialize`;
+    - executor-keyed: pass the full sorted-stable list of ``executor_ids``
+      plus this host's ``local_executor_id``; the process id is the
+      executor's rank in the list (deterministic across hosts, no extra
+      coordination round).
+
+    No-ops (returning the current topology) when the process group is
+    already initialized or when running single-process.
+    """
+    import jax
+
+    if executor_ids is not None:
+        if local_executor_id is None:
+            raise ValueError("local_executor_id required with executor_ids")
+        ordered = sorted(set(map(str, executor_ids)))
+        if str(local_executor_id) not in ordered:
+            raise ValueError(
+                f"local executor {local_executor_id!r} not in executor_ids"
+            )
+        num_processes = len(ordered)
+        process_id = ordered.index(str(local_executor_id))
+
+    already = getattr(jax.distributed, "global_state", None)
+    already_up = already is not None and getattr(already, "client", None) is not None
+    if not already_up and num_processes is not None and num_processes > 1:
+        if coordinator_address is None:
+            raise ValueError(
+                f"{num_processes} processes derived but no coordinator_address "
+                "— pass the driver's host:port (the one piece of rendezvous "
+                "the runtime cannot discover itself)"
+            )
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    return get_topology()
+
+
+def partition_assignment(num_partitions: int, mesh) -> Dict[int, Tuple[int, ...]]:
+    """Map data-partition ids onto mesh coordinates — the partition→chip
+    assignment that replaces ``ClusterUtil``'s executor/core bookkeeping.
+
+    Partitions are assigned round-robin over the ``data`` axis (a partition's
+    rows land on every device in that data-slice's model/seq/... subgroup,
+    which replicates or shards them per the program's NamedShardings).
+    Returns {partition_id: mesh coordinates of its data slice}.
+    """
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    data_size = sizes.get(AXIS_DATA, 1)
+    if num_partitions < data_size:
+        raise ValueError(
+            f"{num_partitions} partitions cannot cover data axis of {data_size} "
+            "(repartition up, or shrink the mesh — empty mesh slices would "
+            "deadlock collectives, the 'empty partition' hazard of "
+            "LightGBMUtils.scala:144-161)"
+        )
+    data_axis_pos = (
+        mesh.axis_names.index(AXIS_DATA) if AXIS_DATA in mesh.axis_names else None
+    )
+    out: Dict[int, Tuple[int, ...]] = {}
+    for pid in range(num_partitions):
+        coord = [0] * len(mesh.axis_names)
+        if data_axis_pos is not None:
+            coord[data_axis_pos] = pid % data_size
+        out[pid] = tuple(coord)  # no data axis: one slice takes everything
+    return out
+
+
+def feature_parallel_sharding(mesh):
+    """NamedSharding for a (rows, features) matrix sharded rows-over-``data``
+    AND features-over-``model`` — LightGBM's ``feature_parallel`` data layout
+    (vertical partitioning), expressed as a sharding annotation: XLA then
+    partitions histogram build + split search across the model axis and
+    inserts the small best-split argmax collectives itself."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return NamedSharding(mesh, P(AXIS_DATA, AXIS_MODEL))
+
+
 def force_platform(platform: str, min_devices: int = 1) -> None:
     """Re-point JAX at a platform mid-process, tearing down already-initialized
     backends (the container sitecustomize pre-creates a TPU client at
