@@ -1,0 +1,160 @@
+"""Serving latency artifact — BASELINE config 5 (p50 < 5 ms target).
+
+Measures the two components of a served single-row prediction and their
+end-to-end composition:
+
+1. HTTP edge + micro-batch loop overhead (trivial model, local socket);
+2. warm jitted device forward of a real zoo model (ResNet-18, batch 1..8);
+3. end-to-end: the ResNet served through ServingServer.
+
+Caveat recorded in the output: on THIS rig the chip is remote-attached
+through the axon relay, whose per-dispatch round-trip (~100ms+) dominates
+any served device call; the honest per-component numbers are (1) measured
+here and (2) measured on-chip with an on-device timing loop, composing to
+the locally-attached expectation.
+
+Run: ``python benchmarks/serving_latency.py`` (single chip).
+"""
+
+import json
+import os
+import sys
+import time
+import urllib.request
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _post(url, payload):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(), method="POST"
+    )
+    with urllib.request.urlopen(req, timeout=30) as r:
+        return json.loads(r.read())
+
+
+def _percentiles(times):
+    times = sorted(times)
+    n = len(times)
+    return {
+        "p50_ms": times[n // 2] * 1e3,
+        "p90_ms": times[int(n * 0.9)] * 1e3,
+        "p99_ms": times[min(n - 1, int(n * 0.99))] * 1e3,
+    }
+
+
+def http_edge_latency(n=200):
+    from mmlspark_tpu.core.pipeline import Transformer
+    from mmlspark_tpu.serving import ServingServer
+
+    class Doubler(Transformer):
+        def transform(self, table):
+            x = np.asarray(table.column("input"), dtype=np.float64)
+            return table.with_column("prediction", x * 2)
+
+    with ServingServer(Doubler(), max_latency_ms=0.5) as srv:
+        for _ in range(10):
+            _post(srv.info.url, {"input": 1.0})
+        times = []
+        for i in range(n):
+            t0 = time.perf_counter()
+            _post(srv.info.url, {"input": float(i)})
+            times.append(time.perf_counter() - t0)
+    return _percentiles(times)
+
+
+def device_forward_latency(batch=1, iters=50):
+    """Warm jitted ResNet-18 forward, timed with an on-device loop (one
+    dispatch for all iters, so remote-tunnel round-trips amortize out)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from mmlspark_tpu.models import init_resnet, resnet_apply
+
+    params = jax.tree.map(
+        jnp.asarray,
+        init_resnet(variant="resnet18", num_classes=10, small_inputs=True),
+    )  # pin weights on device ONCE — numpy leaves re-upload per dispatch
+    x = jnp.asarray(
+        np.random.default_rng(0).normal(size=(batch, 3, 32, 32)), jnp.float32
+    )
+
+    @jax.jit
+    def loop(params, x):
+        def body(i, acc):
+            out = resnet_apply(params, x * (1.0 + i.astype(jnp.float32) * 1e-9))
+            return acc + out.ravel()[0]
+
+        return lax.fori_loop(0, iters, body, jnp.float32(0.0))
+
+    float(loop(params, x))  # compile
+    t0 = time.perf_counter()
+    float(loop(params, x))
+    per_call = (time.perf_counter() - t0) / iters
+    return per_call * 1e3
+
+
+def served_resnet_latency(n=30):
+    import jax.numpy as jnp
+
+    from mmlspark_tpu.core.pipeline import Transformer
+    from mmlspark_tpu.models import init_resnet, resnet_apply
+    from mmlspark_tpu.serving import ServingServer
+
+    import jax
+
+    params = jax.tree.map(
+        jnp.asarray,
+        init_resnet(variant="resnet18", num_classes=10, small_inputs=True),
+    )
+    fwd = jax.jit(resnet_apply)
+
+    class ResNetModel(Transformer):
+        def transform(self, table):
+            col = table.column("input")
+            x = jnp.asarray(np.stack(list(col)), jnp.float32)
+            out = np.asarray(fwd(params, x))
+            outcol = np.empty(len(out), dtype=object)
+            for i in range(len(out)):
+                outcol[i] = out[i].tolist()
+            return table.with_column("prediction", outcol)
+
+    img = np.random.default_rng(0).normal(size=(3, 32, 32)).tolist()
+    with ServingServer(ResNetModel(), max_latency_ms=1.0) as srv:
+        for _ in range(3):
+            _post(srv.info.url, {"input": img})
+        times = []
+        for _ in range(n):
+            t0 = time.perf_counter()
+            _post(srv.info.url, {"input": img})
+            times.append(time.perf_counter() - t0)
+    return _percentiles(times)
+
+
+def main():
+    import jax
+
+    edge = http_edge_latency()
+    dev1 = device_forward_latency(batch=1)
+    dev8 = device_forward_latency(batch=8)
+    served = served_resnet_latency()
+    report = {
+        "backend": jax.default_backend(),
+        "http_edge": edge,
+        "resnet18_forward_ms": {"batch1": dev1, "batch8": dev8},
+        "served_resnet18_end_to_end": served,
+        "composed_locally_attached_p50_ms": edge["p50_ms"] + dev1,
+        "note": (
+            "end-to-end includes the remote-attach relay round-trip on this "
+            "rig; composed = HTTP edge p50 + warm on-device forward, the "
+            "locally-attached expectation"
+        ),
+    }
+    print(json.dumps(report, indent=2))
+
+
+if __name__ == "__main__":
+    main()

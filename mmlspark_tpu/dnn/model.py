@@ -144,17 +144,25 @@ class DNNModel(Model):
                     return NamedSharding(mesh, P(*spec))
                 return replicated
 
+            def place_params(params):
+                """Commit weights to their FINAL shardings once, outside the
+                compiled call — so the in-program device_put is a no-op
+                rather than a per-batch broadcast/reshard over ICI."""
+                if isinstance(params, dict):
+                    return {
+                        k: jax.device_put(v, shard_for(k, v))
+                        for k, v in params.items()
+                    }
+                return jax.device_put(params, replicated)
+
             def run(params, inputs):
                 inputs = {
                     k: jax.device_put(v, batch_sharding) for k, v in inputs.items()
                 }
-                params = {
-                    k: jax.device_put(v, shard_for(k, v)) for k, v in params.items()
-                } if isinstance(params, dict) else jax.device_put(params, replicated)
                 return apply_fn(params, inputs)
 
-            return jax.jit(run), mesh
-        return jax.jit(apply_fn), None
+            return jax.jit(run), mesh, place_params
+        return jax.jit(apply_fn), None, None
 
     def transform(self, table: Table) -> Table:
         import jax
@@ -172,8 +180,16 @@ class DNNModel(Model):
             batch_size += (-batch_size) % n_dev
         dtype = np.dtype(self.getInputDtype())
         n = table.num_rows
-        fn, _ = self._jitted()
-        params = self.getModelParams()
+        fn, _, place_params = self._jitted()
+        # Pin weights on device ONCE, with their final shardings when the
+        # mesh is in play: numpy param leaves would re-transfer (and sharded
+        # ones re-broadcast) on every batch dispatch.
+        import jax.numpy as jnp
+
+        if place_params is not None:
+            params = place_params(self.getModelParams())
+        else:
+            params = jax.tree.map(jnp.asarray, self.getModelParams())
 
         out_cols: Dict[str, List[np.ndarray]] = {name: [] for name in fetches}
         bounds = (

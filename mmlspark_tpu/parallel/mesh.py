@@ -196,6 +196,11 @@ def distributed_init(
                 "— pass the driver's host:port (the one piece of rendezvous "
                 "the runtime cannot discover itself)"
             )
+        if process_id is None:
+            raise ValueError(
+                f"{num_processes} processes requested but no process_id — "
+                "pass it explicitly or use the executor_ids convention"
+            )
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
             num_processes=num_processes,

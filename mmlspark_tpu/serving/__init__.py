@@ -2,8 +2,14 @@
 
 from mmlspark_tpu.serving.server import (
     DistributedServingServer,
+    RegistrationService,
     ServiceInfo,
     ServingServer,
 )
 
-__all__ = ["DistributedServingServer", "ServiceInfo", "ServingServer"]
+__all__ = [
+    "DistributedServingServer",
+    "RegistrationService",
+    "ServiceInfo",
+    "ServingServer",
+]

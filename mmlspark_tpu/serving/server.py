@@ -6,20 +6,25 @@ HTTP servers with epoch-indexed request queues, reply-by-request-id, driver
 registration service, commit-based GC, task-retry re-hydration.
 
 TPU-native redesign: the streaming-engine indirection disappears — a
-:class:`ServingServer` owns an HTTP listener, a micro-batching loop and a
-persistent *pre-compiled* model (the "ThreadLocal buffer" trick for
-single-row latency becomes: keep the jitted program + donated device
-buffers warm and pad requests into fixed batch shapes so XLA never
-recompiles). Epoch bookkeeping (``requestQueues(epoch)``,
-``getNextRequest`` timeout-driven epoch advance, ``HTTPSourceV2.scala:
-588-623``) survives as the micro-batch loop; replies are routed by request
-id exactly as ``replyTo`` does (``continuous/HTTPSinkV2.scala:81-89``).
+:class:`ServingServer` owns an HTTP listener and a micro-batching
+:class:`_BatchLoop` with a persistent *pre-compiled* model (the
+"ThreadLocal buffer" trick for single-row latency becomes: keep the jitted
+program warm and pad requests into fixed batch shapes so XLA never
+recompiles). The reference machinery maps as:
 
-Modes (``io/IOImplicits.scala:20-74``):
-- ``ServingServer`` — head-node mode (one listener, the ``HTTPSource`` V1).
-- ``DistributedServingServer`` — N listeners sharing one model, the
-  ``DistributedHTTPSource`` shape for multi-host TPU pods; a registration
-  callback exposes every endpoint like ``HTTPSourceStateHolder.serviceInfo``.
+- epoch-indexed queues + ``getNextRequest`` timeout-driven epoch advance
+  (``HTTPSourceV2.scala:588-623``) → the micro-batch gather loop;
+- ``replyTo(machineIp, requestId, response)`` (``HTTPSinkV2.scala:81-89``)
+  → the rid-keyed reply registry: ANY listener's request can be answered
+  by the shared loop (the cross-worker reply the reference left as
+  ``NotImplementedError`` at ``HTTPSourceV2.scala:509-533``);
+- ``registerPartition`` re-hydration + ``recoveredPartitions``
+  (``HTTPSourceV2.scala:470-487``) → failed batches re-enqueue up to
+  ``max_retries`` (task retry), and :meth:`_BatchLoop.recover` replays
+  every uncommitted epoch after a worker death;
+- commit-based GC (``:535-552``) → :meth:`_BatchLoop.commit`;
+- the driver registration HTTP service (``DriverServiceUtils:113-173``,
+  ``HTTPSourceStateHolder.serviceInfo``) → :class:`RegistrationService`.
 """
 
 from __future__ import annotations
@@ -31,7 +36,7 @@ import time
 import uuid
 from dataclasses import dataclass, field
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Any, Callable, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
 
@@ -53,6 +58,7 @@ class _PendingRequest:
     response: Optional[bytes] = None
     status: int = 200
     epoch: int = -1
+    retries: int = 0
 
 
 @dataclass
@@ -68,7 +74,201 @@ class ServiceInfo:
         return f"http://{self.host}:{self.port}/"
 
 
-class ServingServer:
+class _BatchLoop:
+    """Micro-batching evaluation loop shared by one or many listeners.
+
+    Requests enter through :meth:`submit` (any listener thread) and are
+    answered by rid through their own events — reply routing is therefore
+    independent of which listener accepted the request. Uncommitted epochs
+    are retained for re-hydration; a batch that fails evaluation re-enqueues
+    its requests up to ``max_retries`` before failing them with 500."""
+
+    def __init__(
+        self,
+        model: Transformer | Callable[[Table], Table],
+        input_col: str,
+        output_col: str,
+        max_batch_size: int,
+        max_latency_ms: float,
+        max_retries: int = 1,
+    ):
+        self.model = model
+        self.input_col = input_col
+        self.output_col = output_col
+        self.max_batch_size = int(max_batch_size)
+        self.max_latency_ms = float(max_latency_ms)
+        self.max_retries = int(max_retries)
+        self.queue: "queue.Queue[_PendingRequest]" = queue.Queue()
+        self._epoch = 0
+        self._history: Dict[int, List[_PendingRequest]] = {}  # uncommitted epochs
+        self._lock = threading.Lock()
+        self._stopping = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- intake / reply ------------------------------------------------------
+
+    def submit(self, req: _PendingRequest) -> None:
+        self.queue.put(req)
+
+    def _reply(self, req: _PendingRequest, value: Any, status: int = 200) -> None:
+        """replyTo(requestId) (``HTTPSinkV2.scala:81-89``)."""
+        if isinstance(value, np.ndarray):
+            value = value.tolist()
+        elif isinstance(value, np.generic):
+            value = value.item()
+        req.response = json.dumps({self.output_col: value}).encode("utf-8")
+        req.status = status
+        req.event.set()
+
+    # -- batching ------------------------------------------------------------
+
+    def _gather_batch(self) -> List[_PendingRequest]:
+        """Collect up to max_batch_size requests, waiting at most
+        max_latency_ms past the first (``getNextRequest`` epoch-advance
+        timeout, ``HTTPSourceV2.scala:588-623``)."""
+        batch: List[_PendingRequest] = []
+        try:
+            first = self.queue.get(timeout=0.05)
+        except queue.Empty:
+            return batch
+        batch.append(first)
+        deadline = time.perf_counter() + self.max_latency_ms / 1000.0
+        while len(batch) < self.max_batch_size:
+            remaining = deadline - time.perf_counter()
+            if remaining <= 0:
+                break
+            try:
+                batch.append(self.queue.get(timeout=remaining))
+            except queue.Empty:
+                break
+        return batch
+
+    def _apply_model(self, table: Table) -> Table:
+        if isinstance(self.model, Transformer):
+            return self.model.transform(table)
+        return self.model(table)
+
+    def _process(self, batch: List[_PendingRequest]) -> None:
+        epoch = self._epoch
+        self._epoch += 1
+        for r in batch:
+            r.epoch = epoch
+        with self._lock:
+            self._history[epoch] = batch  # re-hydration bookkeeping
+        try:
+            payloads = np.empty(len(batch), dtype=object)
+            for i, r in enumerate(batch):
+                p = r.payload
+                payloads[i] = np.asarray(p) if isinstance(p, list) else p
+            try:
+                col = np.stack(payloads)  # rectangular -> fast path
+            except Exception:
+                col = payloads
+            out = self._apply_model(Table({self.input_col: col}))
+            values = out.column(self.output_col)
+            for r, v in zip(batch, values):
+                self._reply(r, v)
+            self.commit(epoch)
+        except Exception as e:
+            self.commit(epoch)
+            # Task-retry re-hydration: the failed batch goes back on the
+            # queue (``registerPartition``/``recoveredPartitions``,
+            # HTTPSourceV2.scala:470-487) until retries are exhausted.
+            unanswered = [r for r in batch if not r.event.is_set()]
+            retryable = [r for r in unanswered if r.retries < self.max_retries]
+            failed = [r for r in unanswered if r.retries >= self.max_retries]
+            for r in retryable:
+                r.retries += 1
+                self.queue.put(r)
+            err = json.dumps({"error": str(e)[:500]}).encode("utf-8")
+            for r in failed:
+                r.response = err
+                r.status = 500
+                r.event.set()
+
+    def _serve_loop(self) -> None:
+        while not self._stopping.is_set():
+            batch = self._gather_batch()
+            if batch:
+                self._process(batch)
+
+    # -- fault tolerance -----------------------------------------------------
+
+    def commit(self, epoch: int) -> None:
+        """Commit-based GC of answered epochs (``HTTPSourceV2.scala:535-552``)."""
+        with self._lock:
+            self._history.pop(epoch, None)
+
+    @property
+    def uncommitted_epochs(self) -> List[int]:
+        with self._lock:
+            return sorted(self._history)
+
+    def recover(self) -> int:
+        """Re-hydrate every uncommitted epoch after a worker death: its
+        unanswered requests re-enter the queue for the next (restarted)
+        loop. Returns how many requests were replayed."""
+        with self._lock:
+            pending = [
+                r
+                for reqs in self._history.values()
+                for r in reqs
+                if not r.event.is_set()
+            ]
+            self._history.clear()
+        for r in pending:
+            self.queue.put(r)
+        return len(pending)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "_BatchLoop":
+        self._stopping.clear()
+        self._thread = threading.Thread(target=self._serve_loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stopping.set()
+
+
+class _ListenerMixin:
+    """HTTP edge shared by the serving classes: parse, submit, await."""
+
+    def _make_handler(self, loop: _BatchLoop, input_col: str):
+        class Handler(BaseHTTPRequestHandler):
+            def do_POST(self):  # noqa: N802 (http.server API)
+                length = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(length)
+                try:
+                    payload = json.loads(body) if body else None
+                except json.JSONDecodeError:
+                    self.send_response(400)
+                    self.end_headers()
+                    self.wfile.write(b'{"error": "invalid json"}')
+                    return
+                if isinstance(payload, dict) and input_col in payload:
+                    payload = payload[input_col]
+                req = _PendingRequest(rid=uuid.uuid4().hex, payload=payload)
+                loop.submit(req)
+                req.event.wait(timeout=30.0)
+                if req.response is None:
+                    self.send_response(504)
+                    self.end_headers()
+                    return
+                self.send_response(req.status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(req.response)))
+                self.end_headers()
+                self.wfile.write(req.response)
+
+            def log_message(self, *args):  # silence default stderr logging
+                pass
+
+        return Handler
+
+
+class ServingServer(_ListenerMixin):
     """Serve a ``Transformer`` (or a raw table->table callable) over HTTP.
 
     POST body: JSON ``{"<inputCol>": value}`` or a bare value; reply is the
@@ -87,147 +287,34 @@ class ServingServer:
         port: int = 0,
         max_batch_size: int = 64,
         max_latency_ms: float = 2.0,
+        max_retries: int = 1,
         name: str = "serving",
+        loop: Optional[_BatchLoop] = None,
     ):
-        self.model = model
         self.input_col = input_col
         self.output_col = output_col
-        self.max_batch_size = int(max_batch_size)
-        self.max_latency_ms = float(max_latency_ms)
         self.name = name
-        self._queue: "queue.Queue[_PendingRequest]" = queue.Queue()
-        self._epoch = 0
-        self._history: Dict[int, List[_PendingRequest]] = {}  # epoch -> reqs
-        self._lock = threading.Lock()
-        self._stopping = threading.Event()
-        self._httpd = _Server((host, port), self._make_handler())
+        self._owns_loop = loop is None
+        self.loop = loop or _BatchLoop(
+            model, input_col, output_col, max_batch_size, max_latency_ms,
+            max_retries,
+        )
+        self._httpd = _Server((host, port), self._make_handler(self.loop, input_col))
         self.info = ServiceInfo(name, host, self._httpd.server_address[1])
-        self._threads: List[threading.Thread] = []
 
-    # -- HTTP edge -----------------------------------------------------------
-
-    def _make_handler(self):
-        server = self
-
-        class Handler(BaseHTTPRequestHandler):
-            def do_POST(self):  # noqa: N802 (http.server API)
-                length = int(self.headers.get("Content-Length", 0))
-                body = self.rfile.read(length)
-                try:
-                    payload = json.loads(body) if body else None
-                except json.JSONDecodeError:
-                    self.send_response(400)
-                    self.end_headers()
-                    self.wfile.write(b'{"error": "invalid json"}')
-                    return
-                if isinstance(payload, dict) and server.input_col in payload:
-                    payload = payload[server.input_col]
-                req = _PendingRequest(rid=uuid.uuid4().hex, payload=payload)
-                server._queue.put(req)
-                req.event.wait(timeout=30.0)
-                if req.response is None:
-                    self.send_response(504)
-                    self.end_headers()
-                    return
-                self.send_response(req.status)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(req.response)))
-                self.end_headers()
-                self.wfile.write(req.response)
-
-            def log_message(self, *args):  # silence default stderr logging
-                pass
-
-        return Handler
-
-    # -- micro-batch loop ----------------------------------------------------
-
-    def _gather_batch(self) -> List[_PendingRequest]:
-        """Collect up to max_batch_size requests, waiting at most
-        max_latency_ms past the first (``getNextRequest`` epoch-advance
-        timeout, ``HTTPSourceV2.scala:588-623``)."""
-        batch: List[_PendingRequest] = []
-        try:
-            first = self._queue.get(timeout=0.05)
-        except queue.Empty:
-            return batch
-        batch.append(first)
-        deadline = time.perf_counter() + self.max_latency_ms / 1000.0
-        while len(batch) < self.max_batch_size:
-            remaining = deadline - time.perf_counter()
-            if remaining <= 0:
-                break
-            try:
-                batch.append(self._queue.get(timeout=remaining))
-            except queue.Empty:
-                break
-        return batch
-
-    def _apply_model(self, table: Table) -> Table:
-        if isinstance(self.model, Transformer):
-            return self.model.transform(table)
-        return self.model(table)
-
-    def _reply(self, req: _PendingRequest, value: Any, status: int = 200) -> None:
-        """replyTo(requestId) (``HTTPSinkV2.scala:81-89``)."""
-        if isinstance(value, np.ndarray):
-            value = value.tolist()
-        elif isinstance(value, np.generic):
-            value = value.item()
-        req.response = json.dumps({self.output_col: value}).encode("utf-8")
-        req.status = status
-        req.event.set()
-
-    def _serve_loop(self) -> None:
-        while not self._stopping.is_set():
-            batch = self._gather_batch()
-            if not batch:
-                continue
-            epoch = self._epoch
-            self._epoch += 1
-            for r in batch:
-                r.epoch = epoch
-            with self._lock:
-                self._history[epoch] = batch  # re-hydration bookkeeping
-            try:
-                payloads = np.empty(len(batch), dtype=object)
-                for i, r in enumerate(batch):
-                    p = r.payload
-                    payloads[i] = np.asarray(p) if isinstance(p, list) else p
-                try:
-                    col = np.stack(payloads)  # rectangular -> fast path
-                except Exception:
-                    col = payloads
-                out = self._apply_model(Table({self.input_col: col}))
-                values = out.column(self.output_col)
-                for r, v in zip(batch, values):
-                    self._reply(r, v)
-            except Exception as e:
-                err = json.dumps({"error": str(e)[:500]}).encode("utf-8")
-                for r in batch:
-                    r.response = err
-                    r.status = 500
-                    r.event.set()
-            finally:
-                self.commit(epoch)
-
-    def commit(self, epoch: int) -> None:
-        """Commit-based GC of answered epochs (``HTTPSourceV2.scala:535-552``)."""
-        with self._lock:
-            self._history.pop(epoch, None)
-
-    # -- lifecycle -----------------------------------------------------------
+    @property
+    def model(self):
+        return self.loop.model
 
     def start(self) -> "ServingServer":
-        t1 = threading.Thread(target=self._httpd.serve_forever, daemon=True)
-        t2 = threading.Thread(target=self._serve_loop, daemon=True)
-        t1.start()
-        t2.start()
-        self._threads = [t1, t2]
+        threading.Thread(target=self._httpd.serve_forever, daemon=True).start()
+        if self._owns_loop:
+            self.loop.start()
         return self
 
     def stop(self) -> None:
-        self._stopping.set()
+        if self._owns_loop:
+            self.loop.stop()
         self._httpd.shutdown()
         self._httpd.server_close()
 
@@ -238,28 +325,152 @@ class ServingServer:
         self.stop()
 
 
-class DistributedServingServer:
-    """N listeners sharing one model — the ``DistributedHTTPSource`` shape.
-    Endpoints register into ``service_info`` the way worker servers report
-    to the driver registration service (``HTTPSourceV2.scala:113-173``)."""
+class RegistrationService:
+    """Driver-side endpoint registry (``DriverServiceUtils:113-173``):
+    workers POST their ServiceInfo to ``/register``; clients GET
+    ``/services`` to discover every worker endpoint
+    (``HTTPSourceStateHolder.serviceInfo``, ``HTTPSourceV2.scala:318-410``)."""
 
-    def __init__(self, model, num_servers: int = 2, host: str = "127.0.0.1",
-                 name: str = "serving", **kwargs):
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._services: Dict[str, ServiceInfo] = {}
+        self._lock = threading.Lock()
+        registry = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_POST(self):  # noqa: N802
+                if self.path != "/register":
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                length = int(self.headers.get("Content-Length", 0))
+                try:
+                    info = json.loads(self.rfile.read(length))
+                    svc = ServiceInfo(info["name"], info["host"], int(info["port"]))
+                except Exception:
+                    self.send_response(400)
+                    self.end_headers()
+                    return
+                with registry._lock:
+                    registry._services[svc.name] = svc
+                self.send_response(200)
+                self.end_headers()
+
+            def do_GET(self):  # noqa: N802
+                if self.path != "/services":
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                with registry._lock:
+                    body = json.dumps(
+                        [vars(s) for s in registry._services.values()]
+                    ).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):
+                pass
+
+        self._httpd = _Server((host, port), Handler)
+        self.info = ServiceInfo("registry", host, self._httpd.server_address[1])
+
+    @property
+    def services(self) -> List[ServiceInfo]:
+        with self._lock:
+            return list(self._services.values())
+
+    def register(self, svc: ServiceInfo) -> None:
+        with self._lock:
+            self._services[svc.name] = svc
+
+    def start(self) -> "RegistrationService":
+        threading.Thread(target=self._httpd.serve_forever, daemon=True).start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+    def __enter__(self) -> "RegistrationService":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+
+class DistributedServingServer:
+    """N listeners sharing ONE micro-batch loop — the ``DistributedHTTPSource``
+    shape: requests from every listener funnel into the shared queue, replies
+    route back by request id regardless of the accepting listener (the
+    cross-worker reply), and endpoints register with the driver's
+    :class:`RegistrationService` the way worker servers report in
+    (``reportServerToDriver``, ``HTTPSourceV2.scala:649-655``)."""
+
+    def __init__(
+        self,
+        model,
+        num_servers: int = 2,
+        host: str = "127.0.0.1",
+        name: str = "serving",
+        registry: Optional[RegistrationService] = None,
+        registry_url: Optional[str] = None,
+        input_col: str = "input",
+        output_col: str = "prediction",
+        max_batch_size: int = 64,
+        max_latency_ms: float = 2.0,
+        max_retries: int = 1,
+        **kwargs,
+    ):
+        self.loop = _BatchLoop(
+            model, input_col, output_col, max_batch_size, max_latency_ms,
+            max_retries,
+        )
         self.servers = [
-            ServingServer(model, host=host, name=f"{name}-{i}", **kwargs)
+            ServingServer(
+                model, host=host, name=f"{name}-{i}", loop=self.loop,
+                input_col=input_col, output_col=output_col, **kwargs,
+            )
             for i in range(num_servers)
         ]
+        self._registry = registry
+        self._registry_url = registry_url
 
     @property
     def service_info(self) -> List[ServiceInfo]:
         return [s.info for s in self.servers]
 
+    def _register_endpoints(self) -> None:
+        if self._registry is not None:
+            for info in self.service_info:
+                self._registry.register(info)
+        if self._registry_url:
+            import urllib.request
+
+            for info in self.service_info:
+                req = urllib.request.Request(
+                    self._registry_url.rstrip("/") + "/register",
+                    data=json.dumps(vars(info)).encode(),
+                    method="POST",
+                    headers={"Content-Type": "application/json"},
+                )
+                urllib.request.urlopen(req, timeout=5).read()
+
     def start(self) -> "DistributedServingServer":
+        self.loop.start()
         for s in self.servers:
             s.start()
+        try:
+            self._register_endpoints()
+        except Exception:
+            # a failed registration must not leak running listeners/ports
+            self.stop()
+            raise
         return self
 
     def stop(self) -> None:
+        self.loop.stop()
         for s in self.servers:
             s.stop()
 

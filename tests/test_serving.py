@@ -11,7 +11,11 @@ import pytest
 
 from mmlspark_tpu.core.pipeline import Transformer
 from mmlspark_tpu.data.table import Table
-from mmlspark_tpu.serving import DistributedServingServer, ServingServer
+from mmlspark_tpu.serving import (
+    DistributedServingServer,
+    RegistrationService,
+    ServingServer,
+)
 
 
 class _Doubler(Transformer):
@@ -79,17 +83,22 @@ class TestServingServer:
             assert status == 400
 
     def test_latency_single_row(self):
-        # p50 well under the 5ms BASELINE target for a trivial model on CPU;
-        # the real-chip number is measured by bench configs.
+        # The BASELINE config-5 target: p50 < 5 ms end-to-end through the
+        # HTTP edge (measured ~1.8 ms for this model; the real-model device
+        # composition is benchmarks/serving_latency.py).
         with ServingServer(_Doubler(), max_latency_ms=0.5) as srv:
-            _post(srv.info.url, {"input": 1.0})  # warmup
+            for _ in range(5):
+                _post(srv.info.url, {"input": 1.0})  # warmup
             times = []
-            for i in range(30):
+            for i in range(50):
                 t0 = time.perf_counter()
                 _post(srv.info.url, {"input": float(i)})
                 times.append(time.perf_counter() - t0)
             p50 = sorted(times)[len(times) // 2]
-            assert p50 < 0.05, f"p50 {p50 * 1e3:.1f}ms"
+            # sanity bound only: wall-clock through a real socket flakes on
+            # loaded CI hosts; the 5 ms target claim is measured and recorded
+            # by benchmarks/serving_latency.py + docs/serving_latency.md
+            assert p50 < 0.015, f"p50 {p50 * 1e3:.1f}ms"
 
 
 class TestDistributedServing:
@@ -100,3 +109,125 @@ class TestDistributedServing:
             for info in infos:
                 status, out = _post(info.url, {"input": 2.0})
                 assert status == 200 and out["prediction"] == 4.0
+
+
+class TestFaultTolerance:
+    def test_task_retry_rehydration(self):
+        """A batch whose evaluation dies is re-enqueued and replayed — the
+        client still gets a 200 (``registerPartition`` re-hydration,
+        HTTPSourceV2.scala:470-487)."""
+
+        class FlakyOnce(Transformer):
+            def __init__(self, **kw):
+                super().__init__(**kw)
+                self.calls = 0
+
+            def transform(self, table):
+                self.calls += 1
+                if self.calls == 1:
+                    raise RuntimeError("transient task death")
+                x = np.asarray(table.column("input"), dtype=np.float64)
+                return table.with_column("prediction", x * 2)
+
+        model = FlakyOnce()
+        with ServingServer(model, max_retries=2) as srv:
+            status, out = _post(srv.info.url, {"input": 5.0})
+            assert status == 200 and out["prediction"] == 10.0
+            assert model.calls == 2  # first attempt died, replay answered
+
+    def test_retries_exhausted_500(self):
+        class AlwaysDies(Transformer):
+            def transform(self, table):
+                raise RuntimeError("permanent")
+
+        with ServingServer(AlwaysDies(), max_retries=1) as srv:
+            try:
+                status, _ = _post(srv.info.url, {"input": 1.0})
+            except urllib.error.HTTPError as e:
+                status = e.code
+            assert status == 500
+
+    def test_recover_replays_uncommitted_epoch(self):
+        """Kill the worker mid-batch; recover() re-hydrates the uncommitted
+        epoch and a restarted worker answers it."""
+        import threading
+
+        release = threading.Event()
+        died = threading.Event()
+
+        class BlocksThenDies(Transformer):
+            def __init__(self, **kw):
+                super().__init__(**kw)
+                self.calls = 0
+
+            def transform(self, table):
+                self.calls += 1
+                if self.calls == 1:
+                    died.set()
+                    release.wait(timeout=10)
+                    raise SystemExit  # hard worker death mid-epoch
+                x = np.asarray(table.column("input"), dtype=np.float64)
+                return table.with_column("prediction", x + 1)
+
+        model = BlocksThenDies()
+        srv = ServingServer(model, max_retries=0).start()
+        try:
+            from concurrent.futures import ThreadPoolExecutor
+
+            with ThreadPoolExecutor(max_workers=1) as pool:
+                fut = pool.submit(_post, srv.info.url, {"input": 41.0}, 15)
+                assert died.wait(timeout=5)  # worker is inside the doomed epoch
+                release.set()  # let it die
+                time.sleep(0.2)
+                assert srv.loop.uncommitted_epochs  # epoch never committed
+                replayed = srv.loop.recover()
+                assert replayed == 1
+                srv.loop.start()  # restarted worker
+                status, out = fut.result(timeout=10)
+                assert status == 200 and out["prediction"] == 42.0
+        finally:
+            srv.stop()
+
+
+class TestDistributedV2:
+    def test_cross_listener_reply_routing(self):
+        """Requests hitting DIFFERENT listeners are answered through the one
+        shared loop — reply routing is by request id, not by listener
+        (the cross-worker reply HTTPSourceV2.scala:509-533 left
+        unimplemented)."""
+        calls = []
+
+        class Recorder(Transformer):
+            def transform(self, table):
+                x = np.asarray(table.column("input"), dtype=np.float64)
+                calls.append(len(x))
+                return table.with_column("prediction", x * 3)
+
+        with DistributedServingServer(
+            Recorder(), num_servers=3, max_batch_size=8, max_latency_ms=50.0
+        ) as srv:
+            urls = [i.url for i in srv.service_info]
+            with ThreadPoolExecutor(max_workers=6) as pool:
+                results = list(pool.map(
+                    lambda i: _post(urls[i % 3], {"input": float(i)}), range(6)
+                ))
+            assert all(s == 200 for s, _ in results)
+            assert [o["prediction"] for _, o in results] == [3.0 * i for i in range(6)]
+        # the shared loop batched across listeners (fewer calls than requests)
+        assert sum(calls) == 6 and len(calls) < 6
+
+    def test_registration_service(self):
+        with RegistrationService() as reg:
+            with DistributedServingServer(
+                _Doubler(), num_servers=2, registry_url=reg.info.url
+            ) as srv:
+                # client-side discovery via the driver service
+                with urllib.request.urlopen(reg.info.url + "services", timeout=5) as r:
+                    services = json.loads(r.read())
+                assert len(services) == 2
+                ports = {s["port"] for s in services}
+                assert ports == {i.port for i in srv.service_info}
+                # discovered endpoints actually answer
+                s0 = services[0]
+                status, out = _post(f"http://{s0['host']}:{s0['port']}/", {"input": 7.0})
+                assert status == 200 and out["prediction"] == 14.0
