@@ -1,26 +1,39 @@
 """Cognitive services on Table (reference ``cognitive/``, SURVEY.md §2.17)."""
 
+from mmlspark_tpu.cognitive import schemas
 from mmlspark_tpu.cognitive.base import CognitiveServicesBase, ServiceParam
-from mmlspark_tpu.cognitive.search import AddDocuments
+from mmlspark_tpu.cognitive.search import AddDocuments, SearchIndexClient
 from mmlspark_tpu.cognitive.services import (
     NER,
     OCR,
     AnalyzeImage,
     BingImageSearch,
+    DescribeImage,
     DetectAnomalies,
     DetectFace,
     EntityDetector,
     FindSimilarFace,
     GenerateThumbnails,
+    GroupFaces,
+    IdentifyFaces,
     KeyPhraseExtractor,
     LanguageDetector,
     RecognizeText,
     SpeechToText,
+    TagImage,
     TextSentiment,
+    VerifyFaces,
 )
 
 __all__ = [
     "AddDocuments",
+    "DescribeImage",
+    "GroupFaces",
+    "IdentifyFaces",
+    "SearchIndexClient",
+    "TagImage",
+    "VerifyFaces",
+    "schemas",
     "AnalyzeImage",
     "BingImageSearch",
     "CognitiveServicesBase",

@@ -21,9 +21,33 @@ from mmlspark_tpu.data.table import Table
 from mmlspark_tpu.io.http.schema import EntityData, HeaderData, HTTPRequestData
 from mmlspark_tpu.io.http.transformers import (
     CustomInputParser,
-    JSONOutputParser,
+    CustomOutputParser,
     SimpleHTTPTransformer,
 )
+
+
+class _ParseError(str):
+    """Sentinel carrying a post-parse failure message to the error column."""
+
+
+class _ConcurrentOutputParser(CustomOutputParser):
+    """CustomOutputParser that maps the udf over rows with a bounded thread
+    pool — async-polling services would otherwise serialize their poll
+    loops row by row, defeating the concurrency param."""
+
+    workers = Param("Thread-pool width", default=4, converter=to_int)
+
+    def transform(self, table: Table) -> Table:
+        from concurrent.futures import ThreadPoolExecutor
+
+        col = table.column(self.getInputCol())
+        udf = self.getUdf()
+        with ThreadPoolExecutor(max_workers=max(1, self.getWorkers())) as pool:
+            out_list = list(pool.map(udf, col))
+        out = np.empty(len(col), dtype=object)
+        for i, v in enumerate(out_list):
+            out[i] = v
+        return table.with_column(self.getOutputCol(), out)
 
 
 class ServiceParam(Param):
@@ -59,12 +83,24 @@ class _HasServiceParams:
 class CognitiveServicesBase(_HasServiceParams, HasOutputCol, Transformer):
     """Base REST transformer. Subclasses define ``urlPath``, declare
     ServiceParams, and implement ``prepare_entity`` (row dict -> JSON body)
-    — the ``CognitiveServicesBase.prepareEntity`` hook."""
+    — the ``CognitiveServicesBase.prepareEntity`` hook.
+
+    ``typed=True`` parses payloads into the subclass's ``response_schema``
+    dataclass (the SparkBindings analogue); subclasses with
+    ``polling = True`` follow the async Operation-Location flow
+    (``ComputerVision.scala`` recognizeText: 202 → poll the returned
+    location until the operation reports a terminal status)."""
 
     subscriptionKey = ServiceParam("API key (value or column)")
     url = Param("Service base URL", default=None)
     errorCol = Param("Error column", default=None)
     concurrency = Param("Max in-flight requests", default=4, converter=to_int)
+    typed = Param("Parse responses into the typed schema", default=False)
+    pollingIntervalMs = Param("Async poll interval", default=50, converter=to_int)
+    maxPollingRetries = Param("Async poll attempts", default=40, converter=to_int)
+
+    response_schema = None  # ResponseSchema subclass, set per service
+    polling = False  # async Operation-Location flow
 
     _key_header = "Ocp-Apim-Subscription-Key"
 
@@ -125,6 +161,67 @@ class CognitiveServicesBase(_HasServiceParams, HasOutputCol, Transformer):
 
         return build
 
+    # -- async polling (ComputerVision.scala recognize-text flow) ------------
+
+    def _poll(self, resp, key: Optional[str]):
+        """Follow the Operation-Location header until a terminal status —
+        the reference's async flow where the initial 202 carries only the
+        polling URL and the result arrives from subsequent GETs."""
+        import time as _time
+
+        from mmlspark_tpu.io.http.clients import HTTPClient
+
+        # header names are case-insensitive on the wire (h2 hops lowercase)
+        headers_ci = {k.lower(): v for k, v in resp.header_map().items()}
+        loc = headers_ci.get("operation-location")
+        if not loc:
+            raise ValueError("202 response without Operation-Location header")
+        headers = [HeaderData(self._key_header, key)] if key else []
+        client = HTTPClient()
+        interval = self.getPollingIntervalMs() / 1000.0
+        payload = None
+        for _ in range(self.getMaxPollingRetries()):
+            _time.sleep(interval)
+            poll = client.send(HTTPRequestData(url=loc, method="GET", headers=headers))
+            payload = poll.json()
+            status = (payload or {}).get("status", "")
+            if str(status).lower() in ("succeeded", "failed"):
+                return payload
+        raise TimeoutError(
+            f"{type(self).__name__}: async operation at {loc} did not reach a "
+            f"terminal status in {self.getMaxPollingRetries()} polls "
+            f"(last: {payload!r})"
+        )
+
+    def _make_response_parser(self, table: Table):
+        schema = type(self).response_schema
+        needs_key = type(self).polling
+        key = None
+        if needs_key:
+            kv = self.getOrDefault("subscriptionKey")
+            if kv is not None and kv[0] == "col":
+                raise ValueError(
+                    "async polling services require a constant subscriptionKey "
+                    "(column-bound keys cannot be threaded into poll requests)"
+                )
+            key = kv[1] if kv is not None else None
+
+        def parse(resp):
+            if resp is None:
+                return None
+            try:
+                if type(self).polling and resp.status_code == 202:
+                    payload = self._poll(resp, key)
+                else:
+                    payload = resp.json()
+                if self.getTyped() and schema is not None:
+                    return schema.from_json(payload)
+                return payload
+            except Exception as e:  # polling timeout / malformed payload
+                return _ParseError(f"{type(e).__name__}: {e}")
+
+        return parse
+
     def transform(self, table: Table) -> Table:
         from mmlspark_tpu.data.table import find_unused_column_name
 
@@ -139,6 +236,30 @@ class CognitiveServicesBase(_HasServiceParams, HasOutputCol, Transformer):
             errorCol=self.getErrorCol(),
             concurrency=self.getConcurrency(),
             inputParser=CustomInputParser(udf=lambda row: build((table, int(row)))),
-            outputParser=JSONOutputParser(),
+            outputParser=_ConcurrentOutputParser(
+                udf=self._make_response_parser(table),
+                workers=self.getConcurrency(),
+            ),
         )
-        return inner.transform(indexed).drop(idx_col)
+        result = inner.transform(indexed).drop(idx_col)
+        # Post-parse failures (polling timeouts etc.) route to the error
+        # column like transport failures do; without an errorCol they raise.
+        out_col = result.column(self.getOutputCol())
+        if any(isinstance(v, _ParseError) for v in out_col):
+            err_name = self.getErrorCol()
+            if err_name is None:
+                first = next(v for v in out_col if isinstance(v, _ParseError))
+                raise RuntimeError(str(first))
+            errors = result.column(err_name)
+            new_out = np.empty(len(out_col), dtype=object)
+            new_err = np.empty(len(out_col), dtype=object)
+            for i, v in enumerate(out_col):
+                if isinstance(v, _ParseError):
+                    new_out[i] = None
+                    new_err[i] = str(v)
+                else:
+                    new_out[i] = v
+                    new_err[i] = errors[i]
+            result = result.with_column(self.getOutputCol(), new_out)
+            result = result.with_column(err_name, new_err)
+        return result

@@ -1,0 +1,263 @@
+"""Typed response schemas for cognitive services.
+
+The reference gives every service a ``SparkBindings`` case-class response
+schema (~3.8k LoC across ``cognitive/*.scala``) so downstream pipeline
+stages see typed columns rather than raw JSON. Python-native equivalent:
+light dataclasses with tolerant ``from_json`` constructors (unknown keys
+ignored, missing keys default) — services parse payloads into these when
+``typed=True``.
+"""
+
+# NOTE: no `from __future__ import annotations` — _build dispatches on the
+# REAL field types (get_origin/is_dataclass); stringified annotations would
+# silently disable nested parsing.
+import dataclasses
+from typing import (
+    Any,
+    Dict,
+    List,
+    Optional,
+    Type,
+    TypeVar,
+    Union,
+    get_args,
+    get_origin,
+)
+
+T = TypeVar("T")
+
+
+def _build(cls: Type[T], data: Any) -> Any:
+    """Tolerantly construct a dataclass tree from parsed JSON."""
+    if data is None or not dataclasses.is_dataclass(cls):
+        return data
+    if not isinstance(data, dict):
+        return data
+    kwargs = {}
+    for f in dataclasses.fields(cls):
+        if f.name not in data:
+            continue
+        v = data[f.name]
+        t = f.type
+        origin = get_origin(t)
+        if origin is list and v is not None:
+            (elem,) = get_args(t)
+            kwargs[f.name] = [_build(elem, x) for x in v]
+        elif origin is None and dataclasses.is_dataclass(t):
+            kwargs[f.name] = _build(t, v)
+        elif origin is Union:  # Optional[X] normalizes to Union[X, None]
+            inner = [a for a in get_args(t) if a is not type(None)]
+            if inner and dataclasses.is_dataclass(inner[0]) and isinstance(v, dict):
+                kwargs[f.name] = _build(inner[0], v)
+            else:
+                kwargs[f.name] = v
+        else:
+            kwargs[f.name] = v
+    return cls(**kwargs)
+
+
+class ResponseSchema:
+    """Mixin: ``from_json`` tolerant constructor."""
+
+    @classmethod
+    def from_json(cls, data: Optional[Dict[str, Any]]):
+        return _build(cls, data)
+
+
+# -- text analytics (TextAnalytics.scala bindings) ---------------------------
+
+
+@dataclasses.dataclass
+class TADocument(ResponseSchema):
+    id: Optional[str] = None
+    score: Optional[float] = None
+    sentiment: Optional[str] = None
+    keyPhrases: Optional[list] = None
+    entities: Optional[list] = None
+    detectedLanguages: Optional[list] = None
+
+
+@dataclasses.dataclass
+class TAError(ResponseSchema):
+    id: Optional[str] = None
+    message: Optional[str] = None
+
+
+@dataclasses.dataclass
+class TAResponse(ResponseSchema):
+    documents: List[TADocument] = dataclasses.field(default_factory=list)
+    errors: List[TAError] = dataclasses.field(default_factory=list)
+
+
+# -- computer vision (ComputerVision.scala bindings) -------------------------
+
+
+@dataclasses.dataclass
+class OCRWord(ResponseSchema):
+    boundingBox: Optional[str] = None
+    text: Optional[str] = None
+
+
+@dataclasses.dataclass
+class OCRLine(ResponseSchema):
+    boundingBox: Optional[str] = None
+    words: List[OCRWord] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class OCRRegion(ResponseSchema):
+    boundingBox: Optional[str] = None
+    lines: List[OCRLine] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class OCRResponse(ResponseSchema):
+    language: Optional[str] = None
+    orientation: Optional[str] = None
+    textAngle: Optional[float] = None
+    regions: List[OCRRegion] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class RTLine(ResponseSchema):
+    boundingBox: Optional[list] = None
+    text: Optional[str] = None
+
+
+@dataclasses.dataclass
+class RTResult(ResponseSchema):
+    lines: List[RTLine] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class RecognizeTextResponse(ResponseSchema):
+    status: Optional[str] = None
+    recognitionResult: Optional[RTResult] = None
+
+
+@dataclasses.dataclass
+class ImageTag(ResponseSchema):
+    name: Optional[str] = None
+    confidence: Optional[float] = None
+
+
+@dataclasses.dataclass
+class ImageCaption(ResponseSchema):
+    text: Optional[str] = None
+    confidence: Optional[float] = None
+
+
+@dataclasses.dataclass
+class ImageDescription(ResponseSchema):
+    tags: Optional[list] = None
+    captions: List[ImageCaption] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class AnalyzeImageResponse(ResponseSchema):
+    categories: Optional[list] = None
+    tags: List[ImageTag] = dataclasses.field(default_factory=list)
+    description: Optional[ImageDescription] = None
+    requestId: Optional[str] = None
+
+
+@dataclasses.dataclass
+class DescribeImageResponse(ResponseSchema):
+    description: Optional[ImageDescription] = None
+    requestId: Optional[str] = None
+
+
+@dataclasses.dataclass
+class TagImageResponse(ResponseSchema):
+    tags: List[ImageTag] = dataclasses.field(default_factory=list)
+    requestId: Optional[str] = None
+
+
+# -- face (Face.scala bindings) ----------------------------------------------
+
+
+@dataclasses.dataclass
+class FaceRectangle(ResponseSchema):
+    top: Optional[int] = None
+    left: Optional[int] = None
+    width: Optional[int] = None
+    height: Optional[int] = None
+
+
+@dataclasses.dataclass
+class DetectedFace(ResponseSchema):
+    faceId: Optional[str] = None
+    faceRectangle: Optional[FaceRectangle] = None
+    faceAttributes: Optional[dict] = None
+
+
+@dataclasses.dataclass
+class FaceListResponse(ResponseSchema):
+    faces: List[DetectedFace] = dataclasses.field(default_factory=list)
+
+    @classmethod
+    def from_json(cls, data):
+        # face detect returns a bare JSON array
+        if isinstance(data, list):
+            return cls(faces=[_build(DetectedFace, d) for d in data])
+        return _build(cls, data)
+
+
+@dataclasses.dataclass
+class IdentifyCandidate(ResponseSchema):
+    personId: Optional[str] = None
+    confidence: Optional[float] = None
+
+
+@dataclasses.dataclass
+class IdentifyResult(ResponseSchema):
+    faceId: Optional[str] = None
+    candidates: List[IdentifyCandidate] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class IdentifyResponse(ResponseSchema):
+    results: List[IdentifyResult] = dataclasses.field(default_factory=list)
+
+    @classmethod
+    def from_json(cls, data):
+        if isinstance(data, list):
+            return cls(results=[_build(IdentifyResult, d) for d in data])
+        return _build(cls, data)
+
+
+@dataclasses.dataclass
+class GroupResponse(ResponseSchema):
+    groups: List[list] = dataclasses.field(default_factory=list)
+    messyGroup: Optional[list] = None
+
+
+@dataclasses.dataclass
+class VerifyResponse(ResponseSchema):
+    isIdentical: Optional[bool] = None
+    confidence: Optional[float] = None
+
+
+# -- anomaly detection (AnamolyDetection.scala bindings) ---------------------
+
+
+@dataclasses.dataclass
+class AnomalyResponse(ResponseSchema):
+    expectedValues: Optional[list] = None
+    isAnomaly: Optional[list] = None
+    isPositiveAnomaly: Optional[list] = None
+    isNegativeAnomaly: Optional[list] = None
+    upperMargins: Optional[list] = None
+    lowerMargins: Optional[list] = None
+    period: Optional[int] = None
+
+
+# -- speech ------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class SpeechResponse(ResponseSchema):
+    RecognitionStatus: Optional[str] = None
+    DisplayText: Optional[str] = None
+    Offset: Optional[int] = None
+    Duration: Optional[int] = None

@@ -71,3 +71,82 @@ class AddDocuments(CognitiveServicesBase):
             resp = client.send(req)
             statuses.extend([resp.status_code] * len(docs))
         return table.with_column("indexStatus", np.asarray(statuses, dtype=np.int64))
+
+
+class SearchIndexClient:
+    """Index management against an Azure-Search-style REST surface —
+    existence check + creation with exponential backoff
+    (``cognitive/AzureSearchAPI.scala:16-42``)."""
+
+    def __init__(self, service_url: str, api_key: Optional[str] = None,
+                 retries=(0.2, 0.8, 3.2)):
+        self.service_url = service_url.rstrip("/")
+        self.api_key = api_key
+        self.client = HTTPClient(retries=retries)
+
+    def _headers(self) -> List[HeaderData]:
+        headers = [HeaderData("Content-Type", "application/json")]
+        if self.api_key:
+            headers.append(HeaderData("api-key", self.api_key))
+        return headers
+
+    def index_exists(self, name: str) -> bool:
+        resp = self.client.send(
+            HTTPRequestData(
+                url=f"{self.service_url}/indexes/{name}",
+                method="GET",
+                headers=self._headers(),
+            )
+        )
+        if resp.status_code == 200:
+            return True
+        if resp.status_code == 404:
+            return False
+        raise RuntimeError(
+            f"index existence check failed: HTTP {resp.status_code} {resp.text()[:200]}"
+        )
+
+    @staticmethod
+    def _validate(definition: Dict[str, Any]) -> str:
+        """The schema checks ``AzureSearchAPI.scala`` performs before any
+        request: a name, fields, and exactly one key field."""
+        name = definition.get("name")
+        fields = definition.get("fields")
+        if not name or not isinstance(fields, list) or not fields:
+            raise ValueError("index definition requires 'name' and 'fields'")
+        keys = [f for f in fields if f.get("key")]
+        if len(keys) != 1:
+            raise ValueError(
+                f"index definition must have exactly one key field (got {len(keys)})"
+            )
+        return name
+
+    def create_index(self, definition: Dict[str, Any]) -> Dict[str, Any]:
+        """PUT the index definition (idempotent create-or-update)."""
+        name = self._validate(definition)
+        resp = self.client.send(
+            HTTPRequestData(
+                url=f"{self.service_url}/indexes/{name}",
+                method="PUT",
+                headers=self._headers(),
+                entity=EntityData(
+                    content=json.dumps(definition).encode("utf-8"),
+                    contentType="application/json",
+                ),
+            )
+        )
+        if resp.status_code not in (200, 201, 204):
+            raise RuntimeError(
+                f"index creation failed: HTTP {resp.status_code} {resp.text()[:200]}"
+            )
+        return resp.json() or {}
+
+    def ensure_index(self, definition: Dict[str, Any]) -> bool:
+        """Create the index unless it already exists. Returns True when it
+        was created. Validates the definition up front so a malformed one
+        errors instead of silently reporting 'already exists'."""
+        name = self._validate(definition)
+        if self.index_exists(name):
+            return False
+        self.create_index(definition)
+        return True

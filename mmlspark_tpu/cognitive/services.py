@@ -12,6 +12,7 @@ from __future__ import annotations
 import json
 from typing import Any, Dict, Optional
 
+from mmlspark_tpu.cognitive import schemas
 from mmlspark_tpu.cognitive.base import CognitiveServicesBase, ServiceParam
 from mmlspark_tpu.core.params import Param, to_str
 from mmlspark_tpu.data.table import Table
@@ -36,9 +37,13 @@ class _TextAnalyticsBase(CognitiveServicesBase):
 class TextSentiment(_TextAnalyticsBase):
     """``cognitive/TextAnalytics.scala`` TextSentiment."""
 
+    response_schema = schemas.TAResponse
+
 
 class LanguageDetector(_TextAnalyticsBase):
     """``cognitive/TextAnalytics.scala`` LanguageDetector."""
+
+    response_schema = schemas.TAResponse
 
     def prepare_entity(self, table: Table, row: int) -> Dict[str, Any]:
         return {
@@ -51,13 +56,19 @@ class LanguageDetector(_TextAnalyticsBase):
 class EntityDetector(_TextAnalyticsBase):
     """``cognitive/TextAnalytics.scala`` EntityDetector."""
 
+    response_schema = schemas.TAResponse
+
 
 class KeyPhraseExtractor(_TextAnalyticsBase):
     """``cognitive/TextAnalytics.scala`` KeyPhraseExtractor."""
 
+    response_schema = schemas.TAResponse
+
 
 class NER(_TextAnalyticsBase):
     """``cognitive/TextAnalytics.scala`` NER."""
+
+    response_schema = schemas.TAResponse
 
 
 class _ImageServiceBase(CognitiveServicesBase):
@@ -72,19 +83,37 @@ class _ImageServiceBase(CognitiveServicesBase):
 class OCR(_ImageServiceBase):
     """``cognitive/ComputerVision.scala`` OCR."""
 
+    response_schema = schemas.OCRResponse
     detectOrientation = ServiceParam("Detect orientation", is_url_param=True)
 
 
 class AnalyzeImage(_ImageServiceBase):
     """``cognitive/ComputerVision.scala`` AnalyzeImage."""
 
+    response_schema = schemas.AnalyzeImageResponse
     visualFeatures = ServiceParam("Comma-joined feature list", is_url_param=True)
 
 
-class RecognizeText(_ImageServiceBase):
-    """``cognitive/ComputerVision.scala`` RecognizeText (async
-    polling-location flow collapses to one call against mocks)."""
+class DescribeImage(_ImageServiceBase):
+    """``cognitive/ComputerVision.scala`` DescribeImage."""
 
+    response_schema = schemas.DescribeImageResponse
+    maxCandidates = ServiceParam("Caption candidates", is_url_param=True)
+
+
+class TagImage(_ImageServiceBase):
+    """``cognitive/ComputerVision.scala`` TagImage."""
+
+    response_schema = schemas.TagImageResponse
+
+
+class RecognizeText(_ImageServiceBase):
+    """``cognitive/ComputerVision.scala`` RecognizeText: the REAL async
+    flow — the service answers 202 with an Operation-Location header and
+    the result arrives by polling that URL until a terminal status."""
+
+    response_schema = schemas.RecognizeTextResponse
+    polling = True
     mode = ServiceParam("Printed|Handwritten", is_url_param=True)
 
 
@@ -99,6 +128,7 @@ class GenerateThumbnails(_ImageServiceBase):
 class DetectFace(_ImageServiceBase):
     """``cognitive/Face.scala`` DetectFace."""
 
+    response_schema = schemas.FaceListResponse
     returnFaceAttributes = ServiceParam("Attribute list", is_url_param=True)
     returnFaceLandmarks = ServiceParam("Landmarks flag", is_url_param=True)
 
@@ -116,10 +146,64 @@ class FindSimilarFace(CognitiveServicesBase):
         }
 
 
+class IdentifyFaces(CognitiveServicesBase):
+    """``cognitive/Face.scala`` IdentifyFaces: match detected faces against
+    a person group."""
+
+    response_schema = schemas.IdentifyResponse
+    faceIdsCol = Param("Column of face-id lists", default="faceIds", converter=to_str)
+    personGroupId = ServiceParam("Person group to search")
+    maxNumOfCandidatesReturned = ServiceParam("Candidate cap")
+    confidenceThreshold = ServiceParam("Match confidence threshold")
+
+    def prepare_entity(self, table: Table, row: int) -> Dict[str, Any]:
+        ids = table.column(self.faceIdsCol)[row]
+        if hasattr(ids, "tolist"):
+            ids = ids.tolist()
+        body: Dict[str, Any] = {
+            "faceIds": list(ids),
+            "personGroupId": self._resolve_service_param("personGroupId", table, row),
+        }
+        for opt in ("maxNumOfCandidatesReturned", "confidenceThreshold"):
+            v = self._resolve_service_param(opt, table, row)
+            if v is not None:
+                body[opt] = v
+        return body
+
+
+class GroupFaces(CognitiveServicesBase):
+    """``cognitive/Face.scala`` GroupFaces: cluster face ids by similarity."""
+
+    response_schema = schemas.GroupResponse
+    faceIdsCol = Param("Column of face-id lists", default="faceIds", converter=to_str)
+
+    def prepare_entity(self, table: Table, row: int) -> Dict[str, Any]:
+        ids = table.column(self.faceIdsCol)[row]
+        if hasattr(ids, "tolist"):
+            ids = ids.tolist()
+        return {"faceIds": list(ids)}
+
+
+class VerifyFaces(CognitiveServicesBase):
+    """``cognitive/Face.scala`` VerifyFaces: same-person check for a pair of
+    face ids (or face id vs person)."""
+
+    response_schema = schemas.VerifyResponse
+    faceId1Col = Param("Column of first face ids", default="faceId1", converter=to_str)
+    faceId2Col = Param("Column of second face ids", default="faceId2", converter=to_str)
+
+    def prepare_entity(self, table: Table, row: int) -> Dict[str, Any]:
+        return {
+            "faceId1": str(table.column(self.faceId1Col)[row]),
+            "faceId2": str(table.column(self.faceId2Col)[row]),
+        }
+
+
 class DetectAnomalies(CognitiveServicesBase):
     """``cognitive/AnamolyDetection.scala:23-160`` DetectAnomalies: series of
     (timestamp, value) points + granularity."""
 
+    response_schema = schemas.AnomalyResponse
     seriesCol = Param("Column of point-dict lists", default="series", converter=to_str)
     granularity = ServiceParam("Series granularity", default=("value", "daily"))
 
@@ -138,6 +222,7 @@ class SpeechToText(CognitiveServicesBase):
     body (the native Speech SDK streaming variant is out of TPU scope —
     SURVEY.md §2.20 item 5 keeps it a host HTTP client)."""
 
+    response_schema = schemas.SpeechResponse
     audioDataCol = Param("Column of audio bytes", default="audio", converter=to_str)
     format = ServiceParam("simple|detailed", is_url_param=True)
     language = ServiceParam("Recognition language", is_url_param=True,

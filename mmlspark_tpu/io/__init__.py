@@ -2,5 +2,6 @@
 (reference ``io/`` — SURVEY.md §2.5, §2.15, §2.16)."""
 
 from mmlspark_tpu.io.files import read_binary_files, read_images
+from mmlspark_tpu.io.powerbi import PowerBIWriter, write_to_powerbi
 
-__all__ = ["read_binary_files", "read_images"]
+__all__ = ["PowerBIWriter", "read_binary_files", "read_images", "write_to_powerbi"]

@@ -19,8 +19,11 @@ from mmlspark_tpu.io.http.transformers import (
     StringOutputParser,
 )
 
+from mmlspark_tpu.io.http.forwarding import PortForwarder
+
 __all__ = [
     "AsyncHTTPClient",
+    "PortForwarder",
     "CustomInputParser",
     "CustomOutputParser",
     "EntityData",

@@ -1,0 +1,95 @@
+"""Local TCP port forwarding.
+
+Reference: ``io/http/PortForwarding.scala`` — jsch SSH tunnels so serving
+endpoints behind VNETs are reachable from the driver. The SSH transport is
+explicitly descoped here (no ssh client dependency, and TPU-VM meshes talk
+over plain ICI/DCN); what survives is the in-cluster use case: a plain
+socket relay that forwards a local port to a remote host:port, so a driver
+process can expose a worker's serving endpoint under its own address
+(the ``forwardToServer`` pattern minus the SSH hop).
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+from typing import Optional
+
+
+class PortForwarder:
+    """Forward connections on a local port to ``remote_host:remote_port``
+    with bidirectional byte relays (one daemon thread per direction)."""
+
+    def __init__(
+        self,
+        remote_host: str,
+        remote_port: int,
+        local_host: str = "127.0.0.1",
+        local_port: int = 0,
+        backlog: int = 32,
+    ):
+        self.remote = (remote_host, int(remote_port))
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((local_host, int(local_port)))
+        self._listener.listen(backlog)
+        self.local_host, self.local_port = self._listener.getsockname()
+        self._stopping = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.local_host}:{self.local_port}/"
+
+    @staticmethod
+    def _relay(src: socket.socket, dst: socket.socket) -> None:
+        try:
+            while True:
+                data = src.recv(65536)
+                if not data:
+                    break
+                dst.sendall(data)
+        except OSError:
+            pass
+        finally:
+            for s in (src, dst):
+                try:
+                    s.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+
+    def _accept_loop(self) -> None:
+        while not self._stopping.is_set():
+            try:
+                client, _ = self._listener.accept()
+            except OSError:
+                return  # listener closed
+            try:
+                upstream = socket.create_connection(self.remote, timeout=10)
+            except OSError:
+                client.close()
+                continue
+            threading.Thread(
+                target=self._relay, args=(client, upstream), daemon=True
+            ).start()
+            threading.Thread(
+                target=self._relay, args=(upstream, client), daemon=True
+            ).start()
+
+    def start(self) -> "PortForwarder":
+        self._thread = threading.Thread(target=self._accept_loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stopping.set()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+
+    def __enter__(self) -> "PortForwarder":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

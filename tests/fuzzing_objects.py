@@ -841,6 +841,17 @@ def _make_test_objects() -> Dict[str, Callable[[], TestObject]]:
 
     add("mmlspark_tpu.io.http.transformers.SimpleHTTPTransformer", simple_http)
 
+    def powerbi():
+        from mmlspark_tpu.io.powerbi import PowerBIWriter
+
+        return TestObject(
+            PowerBIWriter(url="http://localhost:1/push", batchSize=2),
+            Table({"a": np.arange(3, dtype=np.float64)}),
+            check_transform=False,  # pushes to a live endpoint
+        )
+
+    add("mmlspark_tpu.io.powerbi.PowerBIWriter", powerbi)
+
     def consolidator():
         from mmlspark_tpu.io.http import PartitionConsolidator
 

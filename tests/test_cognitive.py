@@ -91,3 +91,239 @@ class TestAddDocuments:
             assert first[0]["id"] == "a" and first[0]["score"] == 1.0
             headers = {k.lower(): v for k, v in svc.requests[0]["headers"].items()}
             assert headers["api-key"] == "key"  # header names are case-insensitive
+
+
+class TestAsyncPolling:
+    def test_recognize_text_polls_operation_location(self):
+        """202 + Operation-Location -> poll until succeeded (the real
+        ComputerVision.scala async flow)."""
+        from mmlspark_tpu.cognitive import RecognizeText
+
+        state = {"polls": 0}
+
+        def behavior(path, body):
+            if path.startswith("/op"):
+                state["polls"] += 1
+                if state["polls"] < 3:
+                    return 200, {"status": "Running"}, {}
+                return 200, {
+                    "status": "Succeeded",
+                    "recognitionResult": {"lines": [{"text": "hello tpu"}]},
+                }, {}
+            return 202, {}, {"Operation-Location": state["base"].rstrip("/") + "/op/1"}
+
+        with MockService(behavior) as svc:
+            state["base"] = svc.url
+            t = Table({"url": np.array(["http://img/1.png"], dtype=object)})
+            rt = RecognizeText(
+                url=svc.url, subscriptionKey="k", outputCol="text",
+                pollingIntervalMs=5,
+            )
+            out = rt.transform(t)
+            payload = out["text"][0]
+            assert payload["status"] == "Succeeded"
+            assert payload["recognitionResult"]["lines"][0]["text"] == "hello tpu"
+            assert state["polls"] == 3
+            # poll requests carry the key header
+            poll_reqs = [r for r in svc.requests if r["path"].startswith("/op")]
+            assert all(
+                r["headers"].get("Ocp-Apim-Subscription-Key") == "k"
+                for r in poll_reqs
+            )
+
+    def test_polling_timeout_raises(self):
+        from mmlspark_tpu.cognitive import RecognizeText
+
+        def behavior(path, body):
+            if path.startswith("/op"):
+                return 200, {"status": "Running"}, {}
+            return 202, {}, {"Operation-Location": behavior.base.rstrip("/") + "/op/1"}
+
+        with MockService(behavior) as svc:
+            behavior.base = svc.url
+            t = Table({"url": np.array(["x"], dtype=object)})
+            rt = RecognizeText(
+                url=svc.url, outputCol="o", pollingIntervalMs=1, maxPollingRetries=3,
+                errorCol="err",
+            )
+            out = rt.transform(t)
+            # polling timeout surfaces via the error column, not a crash
+            assert out["o"][0] is None
+            assert "terminal status" in str(out["err"][0])
+
+    def test_column_bound_key_rejected_for_polling(self):
+        from mmlspark_tpu.cognitive import RecognizeText
+
+        t = Table({
+            "url": np.array(["x"], dtype=object),
+            "k": np.array(["key1"], dtype=object),
+        })
+        rt = RecognizeText(url="http://localhost:1/", outputCol="o")
+        rt.set_vector("subscriptionKey", "k")
+        with pytest.raises(ValueError, match="constant subscriptionKey"):
+            rt.transform(t)
+
+
+class TestTypedResponses:
+    def test_sentiment_typed(self):
+        from mmlspark_tpu.cognitive import TextSentiment, schemas
+
+        def behavior(path, body):
+            return 200, {"documents": [{"id": "0", "score": 0.83}], "errors": []}, {}
+
+        with MockService(behavior) as svc:
+            t = Table({"text": np.array(["nice"], dtype=object)})
+            out = TextSentiment(
+                url=svc.url, outputCol="s", typed=True
+            ).transform(t)
+            resp = out["s"][0]
+            assert isinstance(resp, schemas.TAResponse)
+            assert resp.documents[0].score == 0.83
+
+    def test_face_detect_typed_bare_array(self):
+        from mmlspark_tpu.cognitive import DetectFace, schemas
+
+        def behavior(path, body):
+            return 200, [
+                {"faceId": "f1", "faceRectangle": {"top": 1, "left": 2, "width": 3, "height": 4}}
+            ], {}
+
+        with MockService(behavior) as svc:
+            t = Table({"url": np.array(["http://img"], dtype=object)})
+            out = DetectFace(url=svc.url, outputCol="faces", typed=True).transform(t)
+            resp = out["faces"][0]
+            assert isinstance(resp, schemas.FaceListResponse)
+            assert resp.faces[0].faceId == "f1"
+            assert resp.faces[0].faceRectangle.width == 3
+
+
+class TestFaceServices:
+    def test_identify_group_verify_bodies(self):
+        from mmlspark_tpu.cognitive import GroupFaces, IdentifyFaces, VerifyFaces
+
+        with MockService(lambda p, b: (200, {"echo": b}, {})) as svc:
+            ids = np.empty(1, dtype=object)
+            ids[0] = ["f1", "f2"]
+            t = Table({"faceIds": ids})
+            IdentifyFaces(
+                url=svc.url, outputCol="o", personGroupId="grp",
+                maxNumOfCandidatesReturned=2,
+            ).transform(t)
+            body = svc.requests[-1]["body"]
+            assert body["faceIds"] == ["f1", "f2"]
+            assert body["personGroupId"] == "grp"
+            assert body["maxNumOfCandidatesReturned"] == 2
+
+            GroupFaces(url=svc.url, outputCol="o").transform(t)
+            assert svc.requests[-1]["body"] == {"faceIds": ["f1", "f2"]}
+
+            t2 = Table({
+                "faceId1": np.array(["a"], dtype=object),
+                "faceId2": np.array(["b"], dtype=object),
+            })
+            VerifyFaces(url=svc.url, outputCol="o").transform(t2)
+            assert svc.requests[-1]["body"] == {"faceId1": "a", "faceId2": "b"}
+
+    def test_describe_and_tag_image(self):
+        from mmlspark_tpu.cognitive import DescribeImage, TagImage, schemas
+
+        def behavior(path, body):
+            return 200, {
+                "description": {"captions": [{"text": "a cat", "confidence": 0.9}]},
+                "tags": [{"name": "cat", "confidence": 0.95}],
+            }, {}
+
+        with MockService(behavior) as svc:
+            t = Table({"url": np.array(["http://img"], dtype=object)})
+            d = DescribeImage(url=svc.url, outputCol="d", typed=True).transform(t)
+            assert d["d"][0].description.captions[0].text == "a cat"
+            g = TagImage(url=svc.url, outputCol="g", typed=True).transform(t)
+            assert g["g"][0].tags[0].name == "cat"
+
+
+class TestSearchIndex:
+    def test_ensure_index_creates_when_missing(self):
+        from mmlspark_tpu.cognitive import SearchIndexClient
+
+        def behavior(path, body):
+            if body is None:  # GET existence check
+                return 404, {"error": "not found"}, {}
+            return 201, {"name": body["name"]}, {}
+
+        with MockService(behavior) as svc:
+            client = SearchIndexClient(svc.url, api_key="sk")
+            created = client.ensure_index({
+                "name": "idx1",
+                "fields": [
+                    {"name": "id", "type": "Edm.String", "key": True},
+                    {"name": "text", "type": "Edm.String"},
+                ],
+            })
+            assert created
+            put = svc.requests[-1]
+            assert put["method"] == "PUT"
+            assert put["path"].endswith("/indexes/idx1")
+            headers = {k.lower(): v for k, v in put["headers"].items()}
+            assert headers["api-key"] == "sk"
+
+    def test_ensure_index_skips_existing(self):
+        from mmlspark_tpu.cognitive import SearchIndexClient
+
+        with MockService(lambda p, b: (200, {"name": "idx1"}, {})) as svc:
+            client = SearchIndexClient(svc.url)
+            created = client.ensure_index({
+                "name": "idx1",
+                "fields": [{"name": "id", "key": True}],
+            })
+            assert not created
+            assert all(r["method"] == "GET" for r in svc.requests)
+
+    def test_key_field_validation(self):
+        from mmlspark_tpu.cognitive import SearchIndexClient
+
+        client = SearchIndexClient("http://localhost:1")
+        with pytest.raises(ValueError, match="key field"):
+            client.create_index({"name": "x", "fields": [{"name": "a"}]})
+
+
+class TestPowerBI:
+    def test_batched_writes(self):
+        from mmlspark_tpu.io import PowerBIWriter
+
+        with MockService(lambda p, b: (200, {}, {})) as svc:
+            t = Table({
+                "a": np.arange(5, dtype=np.float64),
+                "b": np.array(list("vwxyz"), dtype=object),
+            })
+            out = PowerBIWriter(url=svc.url, batchSize=2).transform(t)
+            assert out is t  # pass-through
+            bodies = [r["body"] for r in svc.requests]
+            assert [len(b) for b in bodies] == [2, 2, 1]
+            assert bodies[0][0] == {"a": 0.0, "b": "v"}
+
+    def test_failure_raises(self):
+        from mmlspark_tpu.io import write_to_powerbi
+        from mmlspark_tpu.io.http.clients import HTTPClient
+
+        with MockService(lambda p, b: (403, {"error": "denied"}, {})) as svc:
+            t = Table({"a": np.arange(2, dtype=np.float64)})
+            with pytest.raises(RuntimeError, match="403"):
+                write_to_powerbi(t, svc.url, client=HTTPClient(retries=()))
+
+
+class TestPortForwarding:
+    def test_relay_round_trip(self):
+        import json as _json
+        import urllib.request
+
+        from mmlspark_tpu.io.http import PortForwarder
+
+        with MockService(lambda p, b: (200, {"via": "forwarder"}, {})) as svc:
+            host, port = svc.url.replace("http://", "").rstrip("/").split(":")
+            with PortForwarder(host, int(port)) as fwd:
+                req = urllib.request.Request(
+                    fwd.url, data=b"{}", method="POST",
+                    headers={"Content-Type": "application/json"},
+                )
+                with urllib.request.urlopen(req, timeout=5) as r:
+                    assert _json.loads(r.read()) == {"via": "forwarder"}
