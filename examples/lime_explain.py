@@ -1,0 +1,64 @@
+"""Example: explain model predictions with tabular LIME.
+
+    python examples/lime_explain.py
+
+Covers: training a learner, wrapping it as the LIME inner model, fitting
+TabularLIME, and reading per-row local explanations.
+"""
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from mmlspark_tpu.core.pipeline import Transformer
+from mmlspark_tpu.data.table import Table
+from mmlspark_tpu.lightgbm import LightGBMRegressor
+from mmlspark_tpu.lime import TabularLIME
+
+
+class MarginModel(Transformer):
+    """LIME inner model: features column -> prediction column."""
+
+    def __init__(self, model, **kw):
+        super().__init__(**kw)
+        self._model = model
+
+    def transform(self, table):
+        out = self._model.transform(table)
+        return out.rename("prediction", "prediction")
+
+
+def main():
+    rng = np.random.default_rng(0)
+    n, f = 3000, 6
+    X = rng.normal(size=(n, f))
+    # ground truth uses features 0 and 2 only
+    y = 3.0 * X[:, 0] - 2.0 * X[:, 2] + 0.1 * rng.normal(size=n)
+
+    model = LightGBMRegressor(numIterations=60, numLeaves=31).fit(
+        Table({"features": X, "label": y})
+    )
+
+    lime = TabularLIME(
+        model=MarginModel(model),
+        inputCol="features",
+        outputCol="weights",
+        nSamples=500,
+        seed=0,
+    )
+    explain_t = Table({"features": X[:5]})
+    weights = lime.fit(explain_t).transform(explain_t).column("weights")
+
+    print("per-row local linear explanations (one weight per feature):")
+    for i, w in enumerate(np.asarray(weights, dtype=np.float64)):
+        print(f"  row {i}: " + "  ".join(f"f{j}={w[j]:+.2f}" for j in range(f)))
+    mean_abs = np.abs(np.asarray(weights, dtype=np.float64)).mean(axis=0)
+    print("mean |weight| per feature:", np.round(mean_abs, 2))
+    print("=> features 0 and 2 dominate, matching the generating function")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,80 @@
+"""Example: serve a trained model over HTTP with micro-batching.
+
+    python examples/serve_model.py
+
+Covers: training, wrapping into a ServingServer, concurrent clients,
+endpoint discovery through a RegistrationService.
+"""
+
+import json
+import os
+import sys
+import urllib.request
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from mmlspark_tpu.core.pipeline import Transformer
+from mmlspark_tpu.data.table import Table
+from mmlspark_tpu.lightgbm import LightGBMClassifier
+from mmlspark_tpu.serving import (
+    DistributedServingServer,
+    RegistrationService,
+)
+
+
+def post(url, payload):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(), method="POST",
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=30) as r:
+        return json.loads(r.read())
+
+
+class ServedModel(Transformer):
+    """Adapts the fitted classifier to the serving input/output contract."""
+
+    def __init__(self, model, **kw):
+        super().__init__(**kw)
+        self._model = model
+
+    def transform(self, table):
+        feats = np.stack([np.asarray(v, dtype=np.float64) for v in table.column("input")])
+        scored = self._model.transform(Table({"features": feats}))
+        return table.with_column("prediction", scored.column("probability")[:, 1])
+
+
+def main():
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(2000, 8))
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float64)
+    model = LightGBMClassifier(numIterations=30, numLeaves=15).fit(
+        Table({"features": X, "label": y})
+    )
+
+    with RegistrationService() as registry:
+        with DistributedServingServer(
+            ServedModel(model), num_servers=2, registry_url=registry.info.url,
+            max_batch_size=32, max_latency_ms=2.0,
+        ):
+            # clients discover endpoints through the registry
+            with urllib.request.urlopen(registry.info.url + "services") as r:
+                services = json.loads(r.read())
+            urls = [f"http://{s['host']}:{s['port']}/" for s in services]
+            print(f"discovered {len(urls)} endpoints")
+
+            with ThreadPoolExecutor(max_workers=8) as pool:
+                rows = [X[i].tolist() for i in range(16)]
+                results = list(
+                    pool.map(lambda args: post(urls[args[0] % 2], {"input": args[1]}),
+                             enumerate(rows))
+                )
+            preds = [round(r["prediction"], 3) for r in results]
+            print("predictions:", preds[:8], "...")
+
+
+if __name__ == "__main__":
+    main()

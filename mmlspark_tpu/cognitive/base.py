@@ -193,7 +193,7 @@ class CognitiveServicesBase(_HasServiceParams, HasOutputCol, Transformer):
             f"(last: {payload!r})"
         )
 
-    def _make_response_parser(self, table: Table):
+    def _make_response_parser(self):
         schema = type(self).response_schema
         needs_key = type(self).polling
         key = None
@@ -237,7 +237,7 @@ class CognitiveServicesBase(_HasServiceParams, HasOutputCol, Transformer):
             concurrency=self.getConcurrency(),
             inputParser=CustomInputParser(udf=lambda row: build((table, int(row)))),
             outputParser=_ConcurrentOutputParser(
-                udf=self._make_response_parser(table),
+                udf=self._make_response_parser(),
                 workers=self.getConcurrency(),
             ),
         )

@@ -16,7 +16,7 @@ import numpy as np
 from mmlspark_tpu.cognitive.base import CognitiveServicesBase, ServiceParam
 from mmlspark_tpu.core.params import Param, gt, to_int, to_str
 from mmlspark_tpu.core.pipeline import Transformer
-from mmlspark_tpu.data.table import Table
+from mmlspark_tpu.data.table import Table, row_as_json_dict
 from mmlspark_tpu.io.http.clients import HTTPClient
 from mmlspark_tpu.io.http.schema import EntityData, HeaderData, HTTPRequestData
 
@@ -51,15 +51,11 @@ class AddDocuments(CognitiveServicesBase):
                         str(table.column(action_col)[row]) if action_col else "upload"
                     )
                 }
-                for name in table.columns:
-                    if name == action_col:
-                        continue
-                    v = table.column(name)[row]
-                    if isinstance(v, np.ndarray):
-                        v = v.tolist()
-                    elif isinstance(v, np.generic):
-                        v = v.item()
-                    doc[name] = v
+                doc.update(
+                    row_as_json_dict(
+                        table, row, exclude=(action_col,) if action_col else ()
+                    )
+                )
                 docs.append(doc)
             req = HTTPRequestData(
                 url=self.getUrl(),
@@ -139,6 +135,9 @@ class SearchIndexClient:
             raise RuntimeError(
                 f"index creation failed: HTTP {resp.status_code} {resp.text()[:200]}"
             )
+        # 204 No Content (the standard update response) has an empty body
+        if resp.entity is None or not resp.entity.content:
+            return {}
         return resp.json() or {}
 
     def ensure_index(self, definition: Dict[str, Any]) -> bool:

@@ -1,0 +1,216 @@
+"""Sharded/out-of-core dataset ingest — the scale-out data path.
+
+BASELINE config 3 (Higgs-1B on v5e-16) cannot hold the raw float matrix in
+one host's RAM: 1B x 28 float64 is ~224 GB. The design point that makes it
+addressable is that GBDT training consumes *binned uint8* features (8x
+smaller; 28 GB for Higgs-1B — 1.75 GB/chip HBM over 16 chips), and binning
+is a streaming operation:
+
+1. pass 1 streams a bounded per-shard sample to fit the quantile
+   :class:`BinMapper` (the ``bin_construct_sample_cnt`` pass);
+2. pass 2 streams each shard through ``apply_bins`` into an on-disk uint8
+   memmap (the float data never co-resides);
+3. training device_puts the memmap directly — uint8 arrays skip the copy
+   in ``train()`` and stream from disk to HBM.
+
+Shard files are ``.npz`` (keys ``X``/``y``/optional ``w``) or ``.npy``
+(features only); parquet loads through pandas when an engine is installed.
+The per-shard layout maps onto mesh data slices via
+``parallel.mesh.partition_assignment`` — each executor host binning its own
+shards is the multi-host version of this module (SURVEY.md §7 step 3's
+host-side ingest role).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import tempfile
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from mmlspark_tpu.lightgbm.binning import BinMapper, apply_bins, fit_bin_mapper
+
+
+@dataclasses.dataclass
+class ShardInfo:
+    path: str
+    num_rows: int
+
+
+class ShardedDataset:
+    """Lazy view over shard files; at most one shard's float data is
+    resident at a time."""
+
+    def __init__(self, shards: Sequence[str]):
+        if not shards:
+            raise ValueError("no shard files given")
+        self.paths = list(shards)
+        self._infos: Optional[List[ShardInfo]] = None
+        self._num_features: Optional[int] = None
+
+    # -- construction --------------------------------------------------------
+
+    @staticmethod
+    def write_shards(
+        out_dir: str,
+        X: np.ndarray,
+        y: Optional[np.ndarray] = None,
+        w: Optional[np.ndarray] = None,
+        rows_per_shard: int = 100_000,
+    ) -> "ShardedDataset":
+        """Test/demo helper: split an in-memory matrix into .npz shards."""
+        os.makedirs(out_dir, exist_ok=True)
+        paths = []
+        n = len(X)
+        for si, lo in enumerate(range(0, n, rows_per_shard)):
+            hi = min(lo + rows_per_shard, n)
+            path = os.path.join(out_dir, f"shard_{si:05d}.npz")
+            payload = {"X": np.asarray(X[lo:hi])}
+            if y is not None:
+                payload["y"] = np.asarray(y[lo:hi])
+            if w is not None:
+                payload["w"] = np.asarray(w[lo:hi])
+            np.savez(path, **payload)
+            paths.append(path)
+        return ShardedDataset(paths)
+
+    # -- shard access --------------------------------------------------------
+
+    @staticmethod
+    def _load(path: str) -> Tuple[np.ndarray, Optional[np.ndarray], Optional[np.ndarray]]:
+        if path.endswith(".npz"):
+            with np.load(path, allow_pickle=False) as z:
+                X = np.asarray(z["X"], dtype=np.float64)
+                y = np.asarray(z["y"], dtype=np.float64) if "y" in z else None
+                w = np.asarray(z["w"], dtype=np.float64) if "w" in z else None
+            return X, y, w
+        if path.endswith(".npy"):
+            return np.asarray(np.load(path), dtype=np.float64), None, None
+        if path.endswith(".parquet"):
+            import pandas as pd
+
+            df = pd.read_parquet(path)
+            y = df.pop("label").to_numpy(np.float64) if "label" in df else None
+            w = df.pop("weight").to_numpy(np.float64) if "weight" in df else None
+            return df.to_numpy(np.float64), y, w
+        raise ValueError(f"unsupported shard format: {path}")
+
+    def iter_shards(self) -> Iterator[Tuple[np.ndarray, Optional[np.ndarray], Optional[np.ndarray]]]:
+        for p in self.paths:
+            yield self._load(p)
+
+    def _scan(self) -> None:
+        if self._infos is not None:
+            return
+        infos = []
+        f = None
+        for p in self.paths:
+            X, _, _ = self._load(p)
+            if f is None:
+                f = X.shape[1]
+            elif X.shape[1] != f:
+                raise ValueError(
+                    f"shard {p} has {X.shape[1]} features, expected {f}"
+                )
+            infos.append(ShardInfo(p, len(X)))
+        self._infos = infos
+        self._num_features = int(f)
+
+    @property
+    def num_rows(self) -> int:
+        self._scan()
+        return sum(i.num_rows for i in self._infos)
+
+    @property
+    def num_features(self) -> int:
+        self._scan()
+        return self._num_features
+
+    # -- streaming binning ---------------------------------------------------
+
+    def sample_rows(self, per_shard: int, seed: int = 0) -> np.ndarray:
+        """Bounded per-shard row sample for quantile fitting."""
+        rng = np.random.default_rng(seed)
+        chunks = []
+        for X, _, _ in self.iter_shards():
+            if len(X) > per_shard:
+                idx = rng.choice(len(X), size=per_shard, replace=False)
+                chunks.append(X[idx])
+            else:
+                chunks.append(X)
+        return np.concatenate(chunks, axis=0)
+
+    def fit_mapper(
+        self, max_bin: int = 255, sample_per_shard: int = 50_000, seed: int = 0
+    ) -> BinMapper:
+        return fit_bin_mapper(
+            self.sample_rows(sample_per_shard, seed), max_bin=max_bin
+        )
+
+    def bin_to_memmap(
+        self,
+        mapper: BinMapper,
+        out_path: Optional[str] = None,
+    ) -> Tuple[np.memmap, np.ndarray, Optional[np.ndarray]]:
+        """Stream every shard through ``apply_bins`` into an on-disk uint8
+        matrix. Returns (bins memmap (N, F) uint8, y (N,), w or None) —
+        labels/weights are small (8 bytes/row) and stay in RAM."""
+        self._scan()
+        n, f = self.num_rows, self.num_features
+        if out_path is None:
+            fd, out_path = tempfile.mkstemp(suffix=".bins.u8")
+            os.close(fd)
+        bins = np.memmap(out_path, dtype=np.uint8, mode="w+", shape=(n, f))
+        y_all = np.empty(n, dtype=np.float64)
+        w_all = np.empty(n, dtype=np.float64)
+        have_y = have_w = True
+        lo = 0
+        for X, y, w in self.iter_shards():
+            hi = lo + len(X)
+            bins[lo:hi] = apply_bins(X, mapper)
+            if y is None:
+                have_y = False
+            else:
+                y_all[lo:hi] = y
+            if w is None:
+                have_w = False
+            else:
+                w_all[lo:hi] = w
+            lo = hi
+        bins.flush()
+        if not have_y:
+            raise ValueError("shards carry no labels ('y'); cannot train")
+        return bins, y_all, (w_all if have_w else None)
+
+
+def fit_gbdt_sharded(
+    estimator,
+    dataset: ShardedDataset,
+    mesh=None,
+    sample_per_shard: int = 50_000,
+    bins_path: Optional[str] = None,
+):
+    """Out-of-core GBDT fit: stream-bin the dataset, then run the normal
+    mesh training loop over the uint8 memmap (device upload streams from
+    disk; the float matrix never materializes). ``estimator`` is any
+    LightGBM-style learner; returns its fitted model."""
+    from mmlspark_tpu.lightgbm.train import train
+
+    opts = estimator._make_options(num_class=1)
+    mapper = dataset.fit_mapper(
+        max_bin=opts.max_bin, sample_per_shard=sample_per_shard,
+        seed=estimator.getSeed(),
+    )
+    bins, y, w = dataset.bin_to_memmap(mapper, out_path=bins_path)
+    num_class = estimator._num_classes(y)
+    if num_class != 1:
+        opts = estimator._make_options(num_class=num_class)
+    result = train(
+        bins, y, opts, w=w, mapper=mapper, mesh=mesh,
+        feature_names=[f"f{i}" for i in range(dataset.num_features)],
+    )
+    model = estimator._make_model(result)
+    model.parent = estimator
+    return model

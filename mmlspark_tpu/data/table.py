@@ -332,6 +332,25 @@ class Table:
         return f"Table[{self._num_rows} rows, {self.num_partitions} partitions]({parts})"
 
 
+def row_as_json_dict(
+    table: Table, row: int, exclude: Sequence[str] = ()
+) -> Dict[str, Any]:
+    """One row as a JSON-serializable dict (ndarray -> list, numpy scalar ->
+    Python scalar) — the shared converter for REST writers (AddDocuments,
+    PowerBIWriter)."""
+    out: Dict[str, Any] = {}
+    for name in table.columns:
+        if name in exclude:
+            continue
+        v = table.column(name)[row]
+        if isinstance(v, np.ndarray):
+            v = v.tolist()
+        elif isinstance(v, np.generic):
+            v = v.item()
+        out[name] = v
+    return out
+
+
 def find_unused_column_name(prefix: str, table: Table) -> str:
     """Analogue of ``DatasetExtensions.findUnusedColumnName``
     (``core/schema/DatasetExtensions.scala:71``)."""

@@ -52,11 +52,13 @@ class PortForwarder:
         except OSError:
             pass
         finally:
-            for s in (src, dst):
-                try:
-                    s.shutdown(socket.SHUT_RDWR)
-                except OSError:
-                    pass
+            # Half-close only: EOF on this direction must not tear down the
+            # opposite relay (a client finishing its request still awaits
+            # the response on the other leg).
+            try:
+                dst.shutdown(socket.SHUT_WR)
+            except OSError:
+                pass
 
     def _accept_loop(self) -> None:
         while not self._stopping.is_set():

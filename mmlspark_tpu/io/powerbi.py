@@ -21,16 +21,7 @@ from mmlspark_tpu.io.http.clients import HTTPClient
 from mmlspark_tpu.io.http.schema import EntityData, HeaderData, HTTPRequestData
 
 
-def _row_dict(table: Table, row: int) -> dict:
-    out = {}
-    for name in table.columns:
-        v = table.column(name)[row]
-        if isinstance(v, np.ndarray):
-            v = v.tolist()
-        elif isinstance(v, np.generic):
-            v = v.item()
-        out[name] = v
-    return out
+from mmlspark_tpu.data.table import row_as_json_dict as _row_dict  # noqa: E402
 
 
 def write_to_powerbi(

@@ -802,7 +802,10 @@ def train(
     # upcast per-tile.
     put_bins = (lambda a: jax.device_put(a, sh_bins)) if sh_bins is not None else put_rows
     if num_bins <= 256:
-        bins_dev = put_bins(np.ascontiguousarray(bins.astype(np.uint8)))
+        # uint8 inputs (incl. out-of-core memmaps) upload as-is — no host
+        # copy; device_put streams straight from the mapping
+        b8 = bins if bins.dtype == np.uint8 else bins.astype(np.uint8)
+        bins_dev = put_bins(np.ascontiguousarray(b8))
     else:
         bins_dev = put_bins(np.asarray(bins, dtype=np.int32))
     y_dev = put_rows(y_np)

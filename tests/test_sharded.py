@@ -1,0 +1,89 @@
+"""Out-of-core sharded ingest (SURVEY.md §7 hard parts: ingest at
+Higgs-1B scale) — shard streaming, streaming binning into a uint8 memmap,
+and an out-of-core GBDT fit on the CPU mesh matching the in-memory fit."""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.data.sharded import ShardedDataset, fit_gbdt_sharded
+from mmlspark_tpu.lightgbm import LightGBMClassifier
+from mmlspark_tpu.lightgbm.binning import bin_dataset
+from mmlspark_tpu.lightgbm.objectives import auc as auc_metric
+
+
+@pytest.fixture(scope="module")
+def shard_data(tmp_path_factory):
+    rng = np.random.default_rng(0)
+    n, f = 20_000, 12
+    X = rng.normal(size=(n, f))
+    y = (X[:, 0] + 0.5 * X[:, 1] + 0.2 * rng.normal(size=n) > 0).astype(np.float64)
+    out = tmp_path_factory.mktemp("shards")
+    ds = ShardedDataset.write_shards(str(out), X, y, rows_per_shard=3_000)
+    return ds, X, y
+
+
+class TestShardedDataset:
+    def test_scan_and_iter(self, shard_data):
+        ds, X, y = shard_data
+        assert ds.num_rows == len(X)
+        assert ds.num_features == X.shape[1]
+        assert len(ds.paths) == 7  # ceil(20k / 3k)
+        total = 0
+        for Xs, ys, ws in ds.iter_shards():
+            assert Xs.shape[1] == X.shape[1]
+            assert ws is None
+            total += len(Xs)
+        assert total == len(X)
+
+    def test_streaming_binning_matches_in_memory(self, shard_data, tmp_path):
+        ds, X, y = shard_data
+        # full-sample mapper == in-memory mapper (same rows, same rng path
+        # not guaranteed across layouts — compare the BINS they induce)
+        mapper = ds.fit_mapper(max_bin=63, sample_per_shard=10**9)
+        bins_mem, _ = bin_dataset(X, max_bin=63, mapper=mapper)
+        bins_stream, y_out, w_out = ds.bin_to_memmap(
+            mapper, out_path=str(tmp_path / "bins.u8")
+        )
+        assert bins_stream.dtype == np.uint8
+        np.testing.assert_array_equal(np.asarray(bins_stream), bins_mem)
+        np.testing.assert_array_equal(y_out, y)
+        assert w_out is None
+
+    def test_out_of_core_fit_matches_quality(self, shard_data, mesh8):
+        ds, X, y = shard_data
+        clf = LightGBMClassifier(numIterations=15, numLeaves=15, maxBin=63)
+        model = fit_gbdt_sharded(clf, ds, mesh=mesh8, sample_per_shard=5_000)
+        margins = model.booster.raw_margin(X)[:, 0]
+        score = auc_metric(y, margins, np.ones(len(y)))
+        # in-memory reference at identical settings
+        from mmlspark_tpu.data.table import Table
+
+        ref = LightGBMClassifier(
+            numIterations=15, numLeaves=15, maxBin=63, parallelism="serial"
+        ).fit(Table({"features": X, "label": y}))
+        ref_score = auc_metric(y, ref.booster.raw_margin(X)[:, 0], np.ones(len(y)))
+        assert score > ref_score - 0.01, (score, ref_score)
+
+    def test_missing_labels_raise(self, tmp_path):
+        rng = np.random.default_rng(1)
+        ds = ShardedDataset.write_shards(
+            str(tmp_path / "nolabel"), rng.normal(size=(100, 3)), y=None,
+            rows_per_shard=50,
+        )
+        mapper = ds.fit_mapper(max_bin=15)
+        with pytest.raises(ValueError, match="no labels"):
+            ds.bin_to_memmap(mapper)
+
+    def test_mismatched_shards_raise(self, tmp_path):
+        rng = np.random.default_rng(2)
+        d = tmp_path / "bad"
+        d.mkdir()
+        np.savez(d / "a.npz", X=rng.normal(size=(10, 3)), y=np.zeros(10))
+        np.savez(d / "b.npz", X=rng.normal(size=(10, 4)), y=np.zeros(10))
+        ds = ShardedDataset([str(d / "a.npz"), str(d / "b.npz")])
+        with pytest.raises(ValueError, match="features"):
+            ds.num_rows
+
+    def test_empty_raises(self):
+        with pytest.raises(ValueError, match="no shard"):
+            ShardedDataset([])
