@@ -13,22 +13,9 @@ import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from mmlspark_tpu.core.pipeline import Transformer
 from mmlspark_tpu.data.table import Table
 from mmlspark_tpu.lightgbm import LightGBMRegressor
 from mmlspark_tpu.lime import TabularLIME
-
-
-class MarginModel(Transformer):
-    """LIME inner model: features column -> prediction column."""
-
-    def __init__(self, model, **kw):
-        super().__init__(**kw)
-        self._model = model
-
-    def transform(self, table):
-        out = self._model.transform(table)
-        return out.rename("prediction", "prediction")
 
 
 def main():
@@ -42,8 +29,10 @@ def main():
         Table({"features": X, "label": y})
     )
 
+    # the fitted regressor already maps a features column to 'prediction',
+    # which is exactly the inner-model contract LIME expects
     lime = TabularLIME(
-        model=MarginModel(model),
+        model=model,
         inputCol="features",
         outputCol="weights",
         nSamples=500,

@@ -69,7 +69,7 @@ def main():
             with ThreadPoolExecutor(max_workers=8) as pool:
                 rows = [X[i].tolist() for i in range(16)]
                 results = list(
-                    pool.map(lambda args: post(urls[args[0] % 2], {"input": args[1]}),
+                    pool.map(lambda args: post(urls[args[0] % len(urls)], {"input": args[1]}),
                              enumerate(rows))
                 )
             preds = [round(r["prediction"], 3) for r in results]

@@ -37,6 +37,17 @@ from mmlspark_tpu.lightgbm.binning import BinMapper, apply_bins, fit_bin_mapper
 class ShardInfo:
     path: str
     num_rows: int
+    has_y: bool = False
+    has_w: bool = False
+
+
+def _npy_header_shape(fh) -> Tuple[int, ...]:
+    version = np.lib.format.read_magic(fh)
+    if version == (1, 0):
+        shape, _, _ = np.lib.format.read_array_header_1_0(fh)
+    else:
+        shape, _, _ = np.lib.format.read_array_header_2_0(fh)
+    return shape
 
 
 class ShardedDataset:
@@ -101,20 +112,60 @@ class ShardedDataset:
         for p in self.paths:
             yield self._load(p)
 
+    @staticmethod
+    def _shard_info(path: str) -> ShardInfo:
+        """Shape/key metadata WITHOUT decoding the float data — .npy/.npz
+        headers are read directly so the scan pass is O(shards), not
+        O(bytes) (at the 1B-row design point a decode pass costs hours)."""
+        if path.endswith(".npy"):
+            with open(path, "rb") as fh:
+                shape = _npy_header_shape(fh)
+            return ShardInfo(path, shape[0])
+        if path.endswith(".npz"):
+            import zipfile
+
+            with zipfile.ZipFile(path) as z:
+                names = set(z.namelist())
+                with z.open("X.npy") as fh:
+                    shape = _npy_header_shape(fh)
+            return ShardInfo(
+                path, shape[0], has_y="y.npy" in names, has_w="w.npy" in names
+            )
+        X, y, w = ShardedDataset._load(path)  # parquet etc: full decode
+        return ShardInfo(path, len(X), has_y=y is not None, has_w=w is not None)
+
+    @staticmethod
+    def _shard_features(path: str) -> int:
+        if path.endswith(".npy"):
+            with open(path, "rb") as fh:
+                return _npy_header_shape(fh)[1]
+        if path.endswith(".npz"):
+            import zipfile
+
+            with zipfile.ZipFile(path) as z:
+                with z.open("X.npy") as fh:
+                    return _npy_header_shape(fh)[1]
+        return ShardedDataset._load(path)[0].shape[1]
+
     def _scan(self) -> None:
         if self._infos is not None:
             return
         infos = []
         f = None
         for p in self.paths:
-            X, _, _ = self._load(p)
+            fp = self._shard_features(p)
             if f is None:
-                f = X.shape[1]
-            elif X.shape[1] != f:
-                raise ValueError(
-                    f"shard {p} has {X.shape[1]} features, expected {f}"
-                )
-            infos.append(ShardInfo(p, len(X)))
+                f = fp
+            elif fp != f:
+                raise ValueError(f"shard {p} has {fp} features, expected {f}")
+            infos.append(self._shard_info(p))
+        # weights must be all-or-none: a missing 'w' in one shard silently
+        # training unweighted would be a data-loss bug, not a default
+        ws = {i.has_w for i in infos}
+        if len(ws) > 1:
+            raise ValueError(
+                "inconsistent shards: some carry weights ('w') and some do not"
+            )
         self._infos = infos
         self._num_features = int(f)
 
@@ -159,45 +210,46 @@ class ShardedDataset:
         labels/weights are small (8 bytes/row) and stay in RAM."""
         self._scan()
         n, f = self.num_rows, self.num_features
+        # fail fast on unlabeled data — BEFORE the (potentially hours-long)
+        # streaming-bin pass; _scan read the keys from the shard headers
+        if not all(i.has_y for i in self._infos):
+            raise ValueError("shards carry no labels ('y'); cannot train")
+        have_w = all(i.has_w for i in self._infos)
         if out_path is None:
             fd, out_path = tempfile.mkstemp(suffix=".bins.u8")
             os.close(fd)
         bins = np.memmap(out_path, dtype=np.uint8, mode="w+", shape=(n, f))
         y_all = np.empty(n, dtype=np.float64)
-        w_all = np.empty(n, dtype=np.float64)
-        have_y = have_w = True
+        w_all = np.empty(n, dtype=np.float64) if have_w else None
         lo = 0
         for X, y, w in self.iter_shards():
             hi = lo + len(X)
             bins[lo:hi] = apply_bins(X, mapper)
-            if y is None:
-                have_y = False
-            else:
-                y_all[lo:hi] = y
-            if w is None:
-                have_w = False
-            else:
+            y_all[lo:hi] = y
+            if have_w:
                 w_all[lo:hi] = w
             lo = hi
         bins.flush()
-        if not have_y:
-            raise ValueError("shards carry no labels ('y'); cannot train")
-        return bins, y_all, (w_all if have_w else None)
+        return bins, y_all, w_all
 
 
 def fit_gbdt_sharded(
     estimator,
     dataset: ShardedDataset,
-    mesh=None,
+    mesh="auto",
     sample_per_shard: int = 50_000,
     bins_path: Optional[str] = None,
 ):
     """Out-of-core GBDT fit: stream-bin the dataset, then run the normal
     mesh training loop over the uint8 memmap (device upload streams from
     disk; the float matrix never materializes). ``estimator`` is any
-    LightGBM-style learner; returns its fitted model."""
+    LightGBM-style learner; returns its fitted model. ``mesh="auto"``
+    honors the estimator's parallelism/numTasks params the way ``fit``
+    does; pass an explicit mesh or None to override."""
     from mmlspark_tpu.lightgbm.train import train
 
+    if mesh == "auto":
+        mesh = estimator._select_mesh()
     opts = estimator._make_options(num_class=1)
     mapper = dataset.fit_mapper(
         max_bin=opts.max_bin, sample_per_shard=sample_per_shard,

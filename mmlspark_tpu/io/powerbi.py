@@ -12,8 +12,6 @@ from __future__ import annotations
 import json
 from typing import List, Optional, Sequence
 
-import numpy as np
-
 from mmlspark_tpu.core.params import Param, gt, to_int, to_str
 from mmlspark_tpu.core.pipeline import Transformer
 from mmlspark_tpu.data.table import Table

@@ -97,7 +97,14 @@ def _snap_edges(edges: np.ndarray, num_bins: np.ndarray, max_bin: int) -> BinMap
 
 
 def apply_bins(X: np.ndarray, mapper: BinMapper) -> np.ndarray:
-    """Map raw features to uint8 bin indices (row-major (N, F) uint8)."""
+    """Map raw features to uint8 bin indices (row-major (N, F) uint8).
+    Uses the host C++ library when built (bit-identical contract,
+    ``native/mmlspark_native.cpp``); numpy otherwise."""
+    from mmlspark_tpu.native import apply_bins_native
+
+    native = apply_bins_native(np.asarray(X, dtype=np.float64), mapper.edges, mapper.max_bin)
+    if native is not None:
+        return native
     n, f = X.shape
     out = np.zeros((n, f), dtype=np.uint8)
     for j in range(f):

@@ -1,0 +1,137 @@
+"""ctypes bindings for the host-side C++ library (``native/``).
+
+The reference loads its native hot loops from jar-shipped shared objects
+(``core/env/NativeLoader.java``); here the ``.so`` is built by
+``make -C native`` and discovered next to the repo (or via
+``MMLSPARK_TPU_NATIVE`` for installed layouts). Every entry point has a
+numpy fallback, so the library is an acceleration, not a dependency:
+
+- :func:`apply_bins_native` — float64 features -> uint8 bins
+  (bit-identical to ``lightgbm.binning.apply_bins``);
+- :func:`murmur3_bytes_native` / :func:`murmur3_ints_native` — MurmurHash3
+  matching ``ops.hashing``.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional
+
+import numpy as np
+
+_LIB: Optional[ctypes.CDLL] = None
+_LOAD_ATTEMPTED = False
+
+
+def _candidate_paths():
+    env = os.environ.get("MMLSPARK_TPU_NATIVE")
+    if env:
+        yield env
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    yield os.path.join(root, "native", "libmmlspark_native.so")
+
+
+def load_library(path: Optional[str] = None) -> Optional[ctypes.CDLL]:
+    """Load (and memoize) the native library; None when unavailable."""
+    global _LIB, _LOAD_ATTEMPTED
+    if _LIB is not None:
+        return _LIB
+    if _LOAD_ATTEMPTED and path is None:
+        return None
+    _LOAD_ATTEMPTED = True
+    paths = [path] if path else list(_candidate_paths())
+    for p in paths:
+        if p and os.path.exists(p):
+            lib = ctypes.CDLL(p)
+            lib.apply_bins_u8.argtypes = [
+                ctypes.POINTER(ctypes.c_double), ctypes.c_int64, ctypes.c_int64,
+                ctypes.POINTER(ctypes.c_double), ctypes.c_int64,
+                ctypes.POINTER(ctypes.c_uint8), ctypes.c_int32,
+            ]
+            lib.apply_bins_u8.restype = None
+            lib.murmur3_x86_32.argtypes = [
+                ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64, ctypes.c_uint32,
+            ]
+            lib.murmur3_x86_32.restype = ctypes.c_uint32
+            lib.murmur3_ints_u32.argtypes = [
+                ctypes.POINTER(ctypes.c_uint32), ctypes.c_int64,
+                ctypes.c_uint32, ctypes.POINTER(ctypes.c_uint32),
+            ]
+            lib.murmur3_ints_u32.restype = None
+            _LIB = lib
+            return lib
+    return None
+
+
+def native_available() -> bool:
+    return load_library() is not None
+
+
+def build(repo_root: Optional[str] = None) -> str:
+    """Compile the library with the in-tree Makefile (g++ required)."""
+    root = repo_root or os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    native_dir = os.path.join(root, "native")
+    subprocess.run(["make", "-C", native_dir], check=True, capture_output=True)
+    global _LOAD_ATTEMPTED
+    _LOAD_ATTEMPTED = False
+    path = os.path.join(native_dir, "libmmlspark_native.so")
+    if load_library(path) is None:
+        raise RuntimeError(f"built {path} but could not load it")
+    return path
+
+
+# -- entry points (native with numpy fallback) -------------------------------
+
+
+def apply_bins_native(X: np.ndarray, edges: np.ndarray, max_bin: int) -> Optional[np.ndarray]:
+    """uint8 bins via C++; None when the library is unavailable or shapes
+    exceed its contract (edges per feature must fit the 256-slot buffer)."""
+    lib = load_library()
+    if lib is None or edges.shape[1] > 256:
+        return None
+    Xc = np.ascontiguousarray(X, dtype=np.float64)
+    ec = np.ascontiguousarray(edges, dtype=np.float64)
+    n, f = Xc.shape
+    if ec.shape[0] != f:
+        raise ValueError(f"edges rows {ec.shape[0]} != features {f}")
+    out = np.empty((n, f), dtype=np.uint8)
+    lib.apply_bins_u8(
+        Xc.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        ctypes.c_int64(n), ctypes.c_int64(f),
+        ec.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        ctypes.c_int64(ec.shape[1]),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        ctypes.c_int32(max_bin),
+    )
+    return out
+
+
+def murmur3_bytes_native(data: bytes, seed: int = 0) -> Optional[int]:
+    lib = load_library()
+    if lib is None:
+        return None
+    buf = (ctypes.c_uint8 * len(data)).from_buffer_copy(data) if data else (ctypes.c_uint8 * 1)()
+    return int(
+        lib.murmur3_x86_32(
+            ctypes.cast(buf, ctypes.POINTER(ctypes.c_uint8)),
+            ctypes.c_int64(len(data)),
+            ctypes.c_uint32(seed & 0xFFFFFFFF),
+        )
+    )
+
+
+def murmur3_ints_native(values: np.ndarray, seed: int = 0) -> Optional[np.ndarray]:
+    lib = load_library()
+    if lib is None:
+        return None
+    vc = np.ascontiguousarray(values, dtype=np.uint32)
+    out = np.empty(vc.shape, dtype=np.uint32)
+    lib.murmur3_ints_u32(
+        vc.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+        ctypes.c_int64(vc.size),
+        ctypes.c_uint32(seed & 0xFFFFFFFF),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+    )
+    return out.reshape(values.shape)

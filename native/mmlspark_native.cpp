@@ -1,0 +1,145 @@
+// Host-side native library: quantile-bin assignment + MurmurHash3.
+//
+// SURVEY.md §2.20: the reference ships its host hot loops native (LightGBM
+// dataset build via lib_lightgbm.so, VW hashing via vw-jni). The TPU build's
+// on-chip compute is JAX/Pallas; THIS library is the host-side ingest
+// counterpart — the operations that run on the CPU between storage and
+// device upload:
+//
+// - apply_bins_u8: raw float64 features -> uint8 bin ids against per-feature
+//   float32-snapped quantile edges. Bit-identical contract with the numpy
+//   reference in mmlspark_tpu/lightgbm/binning.py::apply_bins (values and
+//   edges compared as float32, searchsorted-left semantics, NaN -> bin 0,
+//   clip to max_bin). OpenMP-style threading is deliberately absent: the
+//   Python layer parallelizes over shards.
+// - murmur3_x86_32: byte-string hashing matching ops/hashing.py::
+//   murmur32_bytes (VW's feature-name hashing).
+// - murmur3_ints_u32: vectorized 4-byte-block hashing matching
+//   ops/hashing.py::murmur32_ints.
+//
+// Built by native/Makefile into libmmlspark_native.so; loaded via ctypes in
+// mmlspark_tpu/native.py with a numpy fallback when absent.
+
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+
+// -- binning -----------------------------------------------------------------
+
+// X: row-major (n, f) float64; edges: row-major (f, e) float64 (padded with
+// +inf); out: row-major (n, f) uint8.
+void apply_bins_u8(const double* X, int64_t n, int64_t f,
+                   const double* edges, int64_t e,
+                   uint8_t* out, int32_t max_bin) {
+  // Snap every feature's edges to the float32 comparison grid once
+  // (f x 256 floats; <=256 KB for 256 features — L2-resident), then walk X
+  // row-major so both X and out stream contiguously.
+  const int64_t ne = e < 256 ? e : 256;
+  float* fe = new float[f * ne];
+  for (int64_t j = 0; j < f; ++j) {
+    for (int64_t k = 0; k < ne; ++k) {
+      fe[j * ne + k] = static_cast<float>(edges[j * e + k]);
+    }
+  }
+  for (int64_t i = 0; i < n; ++i) {
+    const double* xrow = X + i * f;
+    uint8_t* orow = out + i * f;
+    for (int64_t j = 0; j < f; ++j) {
+      const float v = static_cast<float>(xrow[j]);
+      if (std::isnan(v)) {
+        orow[j] = 0;  // missing bin
+        continue;
+      }
+      // searchsorted(fe_j, v, side='left'): first index with fe[idx] >= v
+      const float* fj = fe + j * ne;
+      int64_t lo = 0, hi = ne;
+      while (lo < hi) {
+        const int64_t mid = (lo + hi) / 2;
+        if (fj[mid] < v) {
+          lo = mid + 1;
+        } else {
+          hi = mid;
+        }
+      }
+      int64_t bin = 1 + lo;
+      if (bin > max_bin) bin = max_bin;
+      orow[j] = static_cast<uint8_t>(bin);
+    }
+  }
+  delete[] fe;
+}
+
+// -- murmur3 -----------------------------------------------------------------
+
+static inline uint32_t rotl32(uint32_t x, int8_t r) {
+  return (x << r) | (x >> (32 - r));
+}
+
+static inline uint32_t fmix32(uint32_t h) {
+  h ^= h >> 16;
+  h *= 0x85ebca6bu;
+  h ^= h >> 13;
+  h *= 0xc2b2ae35u;
+  h ^= h >> 16;
+  return h;
+}
+
+uint32_t murmur3_x86_32(const uint8_t* data, int64_t len, uint32_t seed) {
+  const int64_t nblocks = len / 4;
+  uint32_t h1 = seed;
+  const uint32_t c1 = 0xcc9e2d51u;
+  const uint32_t c2 = 0x1b873593u;
+
+  for (int64_t i = 0; i < nblocks; ++i) {
+    uint32_t k1;
+    std::memcpy(&k1, data + i * 4, 4);  // little-endian hosts
+    k1 *= c1;
+    k1 = rotl32(k1, 15);
+    k1 *= c2;
+    h1 ^= k1;
+    h1 = rotl32(h1, 13);
+    h1 = h1 * 5 + 0xe6546b64u;
+  }
+
+  const uint8_t* tail = data + nblocks * 4;
+  uint32_t k1 = 0;
+  switch (len & 3) {
+    case 3:
+      k1 ^= static_cast<uint32_t>(tail[2]) << 16;
+      [[fallthrough]];
+    case 2:
+      k1 ^= static_cast<uint32_t>(tail[1]) << 8;
+      [[fallthrough]];
+    case 1:
+      k1 ^= tail[0];
+      k1 *= c1;
+      k1 = rotl32(k1, 15);
+      k1 *= c2;
+      h1 ^= k1;
+  }
+
+  h1 ^= static_cast<uint32_t>(len);
+  return fmix32(h1);
+}
+
+// Hash each uint32 as one 4-byte block (VW integer-feature hashing);
+// vectorized over `count` values.
+void murmur3_ints_u32(const uint32_t* values, int64_t count, uint32_t seed,
+                      uint32_t* out) {
+  const uint32_t c1 = 0xcc9e2d51u;
+  const uint32_t c2 = 0x1b873593u;
+  for (int64_t i = 0; i < count; ++i) {
+    uint32_t k1 = values[i] * c1;
+    k1 = rotl32(k1, 15);
+    k1 *= c2;
+    uint32_t h1 = seed ^ k1;
+    h1 = rotl32(h1, 13);
+    h1 = h1 * 5 + 0xe6546b64u;
+    h1 ^= 4u;  // length
+    out[i] = fmix32(h1);
+  }
+}
+
+}  // extern "C"
