@@ -37,6 +37,7 @@ from mmlspark_tpu.lightgbm.binning import BinMapper, apply_bins, fit_bin_mapper
 class ShardInfo:
     path: str
     num_rows: int
+    num_features: int
     has_y: bool = False
     has_w: bool = False
 
@@ -120,7 +121,7 @@ class ShardedDataset:
         if path.endswith(".npy"):
             with open(path, "rb") as fh:
                 shape = _npy_header_shape(fh)
-            return ShardInfo(path, shape[0])
+            return ShardInfo(path, shape[0], shape[1])
         if path.endswith(".npz"):
             import zipfile
 
@@ -129,23 +130,13 @@ class ShardedDataset:
                 with z.open("X.npy") as fh:
                     shape = _npy_header_shape(fh)
             return ShardInfo(
-                path, shape[0], has_y="y.npy" in names, has_w="w.npy" in names
+                path, shape[0], shape[1],
+                has_y="y.npy" in names, has_w="w.npy" in names,
             )
-        X, y, w = ShardedDataset._load(path)  # parquet etc: full decode
-        return ShardInfo(path, len(X), has_y=y is not None, has_w=w is not None)
-
-    @staticmethod
-    def _shard_features(path: str) -> int:
-        if path.endswith(".npy"):
-            with open(path, "rb") as fh:
-                return _npy_header_shape(fh)[1]
-        if path.endswith(".npz"):
-            import zipfile
-
-            with zipfile.ZipFile(path) as z:
-                with z.open("X.npy") as fh:
-                    return _npy_header_shape(fh)[1]
-        return ShardedDataset._load(path)[0].shape[1]
+        X, y, w = ShardedDataset._load(path)  # parquet etc: full decode (once)
+        return ShardInfo(
+            path, len(X), X.shape[1], has_y=y is not None, has_w=w is not None
+        )
 
     def _scan(self) -> None:
         if self._infos is not None:
@@ -153,12 +144,14 @@ class ShardedDataset:
         infos = []
         f = None
         for p in self.paths:
-            fp = self._shard_features(p)
+            info = self._shard_info(p)
             if f is None:
-                f = fp
-            elif fp != f:
-                raise ValueError(f"shard {p} has {fp} features, expected {f}")
-            infos.append(self._shard_info(p))
+                f = info.num_features
+            elif info.num_features != f:
+                raise ValueError(
+                    f"shard {p} has {info.num_features} features, expected {f}"
+                )
+            infos.append(info)
         # weights must be all-or-none: a missing 'w' in one shard silently
         # training unweighted would be a data-loss bug, not a default
         ws = {i.has_w for i in infos}

@@ -990,6 +990,20 @@ def train(
                 if stale >= opts.early_stopping_round:
                     break
 
+    if opts.verbosity >= 1:
+        from mmlspark_tpu.core.profiling import get_logger
+
+        logger = get_logger("mmlspark_tpu.lightgbm")
+        for name, metrics in evals.items():
+            for mname, scores in metrics.items():
+                if scores:
+                    arr = np.asarray(scores, dtype=np.float64)
+                    best_i = int(np.nanargmax(arr) if higher_better else np.nanargmin(arr))
+                    logger.info(
+                        "valid %s %s: last=%.6f best=%.6f@%d",
+                        name, mname, scores[-1], arr[best_i], best_i + 1,
+                    )
+
     t = opts.num_iterations if stacked_trees is not None else len(trees)
     m = opts.num_nodes
 

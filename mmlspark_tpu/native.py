@@ -34,14 +34,19 @@ def _candidate_paths():
 
 
 def load_library(path: Optional[str] = None) -> Optional[ctypes.CDLL]:
-    """Load (and memoize) the native library; None when unavailable."""
+    """Load the native library; None when unavailable. Auto-discovery is
+    memoized; an explicit ``path`` always loads fresh (so ``build`` can
+    swap in a rebuilt .so) and never poisons later auto-discovery."""
     global _LIB, _LOAD_ATTEMPTED
-    if _LIB is not None:
-        return _LIB
-    if _LOAD_ATTEMPTED and path is None:
-        return None
-    _LOAD_ATTEMPTED = True
-    paths = [path] if path else list(_candidate_paths())
+    if path is None:
+        if _LIB is not None:
+            return _LIB
+        if _LOAD_ATTEMPTED:
+            return None
+        _LOAD_ATTEMPTED = True
+        paths = list(_candidate_paths())
+    else:
+        paths = [path]
     for p in paths:
         if p and os.path.exists(p):
             lib = ctypes.CDLL(p)
@@ -74,7 +79,8 @@ def build(repo_root: Optional[str] = None) -> str:
     root = repo_root or os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     native_dir = os.path.join(root, "native")
     subprocess.run(["make", "-C", native_dir], check=True, capture_output=True)
-    global _LOAD_ATTEMPTED
+    global _LIB, _LOAD_ATTEMPTED
+    _LIB = None  # drop any stale handle so the rebuilt .so takes over
     _LOAD_ATTEMPTED = False
     path = os.path.join(native_dir, "libmmlspark_native.so")
     if load_library(path) is None:

@@ -49,7 +49,13 @@ def _fmix(h: np.ndarray) -> np.ndarray:
 
 def murmur32_ints(values: np.ndarray, seed: int = 0) -> np.ndarray:
     """Hash each int32/uint32 value as a 4-byte murmur3 block (VW's
-    ``hash_uniform`` over integer feature ids). Vectorized."""
+    ``hash_uniform`` over integer feature ids). Dispatches to the host C++
+    library when built; vectorized numpy otherwise."""
+    from mmlspark_tpu.native import murmur3_ints_native
+
+    native = murmur3_ints_native(np.asarray(values), seed)
+    if native is not None:
+        return native
     with np.errstate(over="ignore"):
         k = np.asarray(values, dtype=np.uint32)
         h = np.full(k.shape, np.uint32(seed & 0xFFFFFFFF), dtype=np.uint32)
@@ -59,7 +65,13 @@ def murmur32_ints(values: np.ndarray, seed: int = 0) -> np.ndarray:
 
 
 def murmur32_bytes(data: bytes, seed: int = 0) -> int:
-    """Scalar murmur3_x86_32 over a byte string (feature-name hashing)."""
+    """Scalar murmur3_x86_32 over a byte string (feature-name hashing).
+    Dispatches to the host C++ library when built."""
+    from mmlspark_tpu.native import murmur3_bytes_native
+
+    native = murmur3_bytes_native(data, seed)
+    if native is not None:
+        return native
     with np.errstate(over="ignore"):
         h = np.uint32(seed & 0xFFFFFFFF)
         n = len(data)
