@@ -77,7 +77,52 @@ def _fit_tpu(X, y, Xt):
     result = train(bins, y, opts, mapper=mapper)
     dt = time.perf_counter() - t0
     margins = result.booster.raw_margin(Xt)[:, 0]
-    return dt, margins
+    return dt, margins, result.booster
+
+
+def _predict_throughput_tpu(booster, X, reps=10):
+    """Warm on-device predict loop (path-matrix formulation): rows/sec with
+    the input device-resident — remote-attach transfer excluded, the same
+    measurement discipline as the training number (compile excluded)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from mmlspark_tpu.lightgbm.booster import (
+        _paths_cache,
+        _predict_margin_paths_jit,
+    )
+
+    t = booster._used_trees(None)
+    feats, thrs, P, plen, lvals, _ = _paths_cache(booster, t)
+    Xd = jnp.asarray(X, jnp.float32)
+    cargs = [jnp.asarray(a) for a in (feats, thrs, P, plen, lvals)]
+    isc = jnp.asarray(booster.init_score)
+
+    @jax.jit
+    def loop(Xd, f, th, Pm, pl, lv, isc):
+        def body(i, acc):
+            m = _predict_margin_paths_jit(
+                Xd * (1 + i.astype(jnp.float32) * 1e-9), f, th, Pm, pl, lv, isc, 1
+            )
+            return acc + m[0, 0]
+
+        import jax.lax as _lax
+
+        return _lax.fori_loop(0, reps, body, jnp.float32(0.0))
+
+    float(loop(Xd, *cargs, isc))  # compile
+    t0 = time.perf_counter()
+    float(loop(Xd, *cargs, isc))
+    return len(X) * reps / (time.perf_counter() - t0)
+
+
+def _predict_throughput_cpu(clf, X, reps=3):
+    clf.predict_proba(X[:1000])  # warm
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        clf.predict_proba(X)
+    return len(X) * reps / (time.perf_counter() - t0)
 
 
 def _fit_cpu(X, y, Xt):
@@ -99,7 +144,7 @@ def _fit_cpu(X, y, Xt):
         clf.fit(X, y)
         times.append(time.perf_counter() - t0)
         margins = clf.decision_function(Xt)
-    return float(np.median(times)), margins
+    return float(np.median(times)), margins, clf
 
 
 def main():
@@ -110,18 +155,20 @@ def main():
     import jax
 
     backend = jax.default_backend()
-    tpu_secs, tpu_margins = _fit_tpu(Xtr, ytr, Xte)
+    tpu_secs, tpu_margins, booster = _fit_tpu(Xtr, ytr, Xte)
     tpu_tput = N_ROWS * N_ITERS / tpu_secs
     auc_tpu = _auc(yte, tpu_margins)
+    pred_tpu = _predict_throughput_tpu(booster, Xtr)
 
     try:
-        cpu_secs, cpu_margins = _fit_cpu(Xtr, ytr, Xte)
+        cpu_secs, cpu_margins, clf = _fit_cpu(Xtr, ytr, Xte)
         cpu_tput = N_ROWS * N_ITERS / cpu_secs
         auc_cpu = _auc(yte, cpu_margins)
         vs = tpu_tput / cpu_tput
+        pred_cpu = _predict_throughput_cpu(clf, Xtr)
     except Exception as e:  # pragma: no cover
         print(f"cpu baseline failed: {e}", file=sys.stderr)
-        cpu_secs, auc_cpu, vs = 0.0, 0.0, 0.0
+        cpu_secs, auc_cpu, vs, pred_cpu = 0.0, 0.0, 0.0, 0.0
 
     print(
         json.dumps(
@@ -134,6 +181,9 @@ def main():
                 "cpu_fit_secs": round(cpu_secs, 3),
                 "auc_tpu": round(float(auc_tpu), 5),
                 "auc_cpu": round(float(auc_cpu), 5),
+                "predict_rows_per_sec_tpu": round(pred_tpu, 0),
+                "predict_rows_per_sec_cpu": round(pred_cpu, 0),
+                "predict_vs_cpu": round(pred_tpu / pred_cpu, 2) if pred_cpu else 0.0,
                 "cpu_engine": "sklearn.HistGradientBoostingClassifier(median of 3)",
             }
         )

@@ -109,6 +109,14 @@ def ring_attention(
 
         def step(i, carry):
             k_blk, v_blk, acc, row_max, denom = carry
+            # rotate BEFORE attending for i > 0 — p-1 rotations total, no
+            # discarded final permute
+            k_blk, v_blk = lax.cond(
+                i > 0,
+                lambda kv: tuple(lax.ppermute(x, AXIS_SEQ, perm) for x in kv),
+                lambda kv: kv,
+                (k_blk, v_blk),
+            )
             # the block we hold at ring step i originated at (idx - i) mod p
             src = (idx - i) % p
             k_pos = src * s_local + jnp.arange(s_local)
@@ -116,8 +124,6 @@ def ring_attention(
                 q_l, k_blk, v_blk, q_pos, k_pos, acc, row_max, denom,
                 causal, scale_val,
             )
-            k_blk = lax.ppermute(k_blk, AXIS_SEQ, perm)
-            v_blk = lax.ppermute(v_blk, AXIS_SEQ, perm)
             return k_blk, v_blk, acc, row_max, denom
 
         _, _, acc, row_max, denom = lax.fori_loop(
