@@ -40,7 +40,9 @@ def get_logger(name: str = "mmlspark_tpu") -> logging.Logger:
         )
         root.addHandler(handler)
         root.setLevel(os.environ.get("MMLSPARK_TPU_LOGLEVEL", "WARNING").upper())
-        root.propagate = False
+        # propagate stays True: log-capture tooling (pytest caplog) hooks the
+        # python root; an app that also configures root logging may see the
+        # line twice, which is the lesser evil
     return logger
 
 

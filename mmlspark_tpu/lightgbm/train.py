@@ -997,22 +997,29 @@ def train(
 
         logger = get_logger("mmlspark_tpu.lightgbm")
         # verbosity is an explicit request for output — lift the level floor
+        # for THIS summary only, restoring the configured level after
         root_logger = _logging.getLogger("mmlspark_tpu")
+        prev_level = root_logger.level
         if root_logger.getEffectiveLevel() > _logging.INFO:
             root_logger.setLevel(_logging.INFO)
-        for name, metrics in evals.items():
-            for mname, scores in metrics.items():
-                if not scores:
-                    continue
-                arr = np.asarray(scores, dtype=np.float64)
-                if np.isnan(arr).all():
-                    logger.info("valid %s %s: all evals NaN", name, mname)
-                    continue
-                best_i = int(np.nanargmax(arr) if higher_better else np.nanargmin(arr))
-                logger.info(
-                    "valid %s %s: last=%.6f best=%.6f@%d",
-                    name, mname, scores[-1], arr[best_i], best_i + 1,
-                )
+        try:
+            for name, metrics in evals.items():
+                for mname, scores in metrics.items():
+                    if not scores:
+                        continue
+                    arr = np.asarray(scores, dtype=np.float64)
+                    if np.isnan(arr).all():
+                        logger.info("valid %s %s: all evals NaN", name, mname)
+                        continue
+                    best_i = int(
+                        np.nanargmax(arr) if higher_better else np.nanargmin(arr)
+                    )
+                    logger.info(
+                        "valid %s %s: last=%.6f best=%.6f@%d",
+                        name, mname, scores[-1], arr[best_i], best_i + 1,
+                    )
+        finally:
+            root_logger.setLevel(prev_level)
 
     t = opts.num_iterations if stacked_trees is not None else len(trees)
     m = opts.num_nodes

@@ -299,3 +299,53 @@ class TestBoostingTypes:
         ).fit(t)
         out = m.transform(t)
         assert "prediction" in out.columns
+
+
+class TestPathMatrixPredict:
+    """Pin the path-matrix predict to the pointer-routing kernels: leaf
+    assignments bit-identical, margins within fp32 summation order."""
+
+    @pytest.mark.parametrize("growth", ["leafwise", "depthwise"])
+    @pytest.mark.parametrize("classes,obj", [(1, "binary"), (3, "multiclass")])
+    def test_matches_routing_kernels(self, growth, classes, obj):
+        import jax.numpy as jnp
+
+        from mmlspark_tpu.lightgbm.booster import (
+            _predict_leaf_jit,
+            _predict_margin_jit,
+        )
+
+        rng = np.random.default_rng(13)
+        X = rng.normal(size=(2000, 8))
+        X[::9, 2] = np.nan
+        y = (
+            (np.abs(np.nan_to_num(X[:, 0])).astype(int) % 3).astype(np.float64)
+            if classes > 1
+            else (np.nan_to_num(X[:, 0]) + X[:, 1] > 0).astype(np.float64)
+        )
+        bins, mapper = bin_dataset(X, max_bin=63)
+        r = train(
+            bins, y,
+            TrainOptions(
+                objective=obj, num_class=classes, num_iterations=6,
+                num_leaves=7, max_bin=63, growth=growth,
+            ),
+            mapper=mapper,
+        )
+        b = r.booster
+        t = b._used_trees(None)
+        old_m = np.asarray(_predict_margin_jit(
+            jnp.asarray(X, jnp.float32), jnp.asarray(b.split_feature[:t]),
+            jnp.asarray(b.split_threshold[:t]), jnp.asarray(b.left_child[:t]),
+            jnp.asarray(b.right_child[:t]), jnp.asarray(b.is_leaf[:t]),
+            jnp.asarray(b.leaf_values[:t]), jnp.asarray(b.init_score),
+            b.num_classes, b.max_depth,
+        ))
+        np.testing.assert_allclose(b.raw_margin(X), old_m, rtol=1e-5, atol=1e-6)
+        old_l = np.asarray(_predict_leaf_jit(
+            jnp.asarray(X, jnp.float32), jnp.asarray(b.split_feature[:t]),
+            jnp.asarray(b.split_threshold[:t]), jnp.asarray(b.left_child[:t]),
+            jnp.asarray(b.right_child[:t]), jnp.asarray(b.is_leaf[:t]),
+            b.max_depth,
+        ))
+        np.testing.assert_array_equal(b.predict_leaf(X), old_l)
