@@ -86,6 +86,12 @@ class LightGBMParams(
         "(balanced levels — fewer, larger MXU passes)",
         default="leafwise", converter=to_str, validator=one_of("leafwise", "depthwise"),
     )
+    leafBatch = Param(
+        "Frontier leaves split per histogram pass under leafwise growth "
+        "(1 = exact sequential best-first; >1 approximates it at ~pass cost "
+        "of 1 via the panel histogram kernel)",
+        default=8, converter=to_int, validator=gt(0),
+    )
     numBatches = Param("Split training into sequential batches (0=off)", default=0, converter=to_int, validator=ge(0))
     modelString = Param("Warm-start booster string", default="", converter=to_str)
     verbosity = Param("Verbosity", default=-1, converter=to_int)
@@ -125,6 +131,7 @@ class LightGBMParams(
             improvement_tolerance=self.getImprovementTolerance(),
             seed=self.getSeed(),
             growth=self.getGrowthPolicy(),
+            leaf_batch=self.getLeafBatch(),
             tree_learner=(
                 "voting_parallel"
                 if self.getParallelism() == "voting_parallel"

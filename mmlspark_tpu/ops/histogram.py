@@ -70,9 +70,18 @@ def build_histograms(
     if method == "pallas":
         from mmlspark_tpu.ops.pallas_histogram import (
             build_histograms_pallas,
+            build_histograms_panel_pallas,
+            panel_fits,
             pick_bw,
         )
 
+        # Multi-node passes route to the panel kernel: its one-hot build —
+        # the VPU-bound resource — is independent of the node count (the
+        # node key rides in MXU lane padding), so k nodes cost ~one.
+        if num_nodes > 1 and panel_fits(num_nodes, num_bins):
+            return build_histograms_panel_pallas(
+                bins, grad, hess, count, node, num_nodes, num_bins
+            )
         k = num_nodes * num_bins
         # Below one lane group the XLA one-hot wins (measured 6x at K=64,
         # docs/perf_histogram.md); above the VMEM budget pallas refuses.
@@ -81,6 +90,23 @@ def build_histograms(
                 bins, grad, hess, count, node, num_nodes, num_bins
             )
         method = "onehot"
+
+    if method == "panel" or (method == "onehot" and num_nodes > 1 and 3 * num_nodes <= 128):
+        # XLA panel formulation (mesh-compatible — plain jnp, so GSPMD can
+        # row-shard it and insert the allreduce): bin-only one-hot against a
+        # node-keyed (N, 3k) data panel. Rows with node outside [0, k) get a
+        # zero panel row, which callers use as the in-leaf mask.
+        from mmlspark_tpu.ops.pallas_histogram import build_node_panel
+
+        k = num_nodes
+        panel = build_node_panel(grad, hess, count, node, k)
+
+        def per_feature_panel(_, feat_col):
+            oh = jax.nn.one_hot(feat_col, num_bins, dtype=panel.dtype)  # (N, B)
+            return None, oh.T @ panel  # (B, 3k)
+
+        _, hists = lax.scan(per_feature_panel, None, bins.T)  # (F, B, 3k)
+        return hists.reshape(f, num_bins, 3, k).transpose(3, 0, 1, 2)
 
     if method == "onehot":
         k = num_nodes * num_bins

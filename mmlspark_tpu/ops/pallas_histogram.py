@@ -58,6 +58,97 @@ def pick_bw(k: int) -> int:
     return bw if bw >= _MIN_BW else 0
 
 
+def panel_fits(num_nodes: int, num_bins: int) -> bool:
+    """Whether the panel kernel applies: the node panel must fit one MXU
+    lane group and the bin one-hot must fill at least one."""
+    return 3 * num_nodes <= 128 and num_bins >= 128 and pick_bw(num_bins) > 0
+
+
+def build_node_panel(grad, hess, count, node, num_nodes: int):
+    """(N, 3*num_nodes) stat-major data panel [g·nodes | h·nodes | c·nodes]:
+    row i carries its (g, h, c) in the node[i]-keyed columns and zeros
+    elsewhere; out-of-range node keys zero the whole row (the in-leaf mask
+    convention). The ONE definition of the panel layout — the pallas and XLA
+    histogram paths both decode it as reshape(F, B, 3, k).transpose(3,0,1,2),
+    so they must share the encoder."""
+    node = node.astype(jnp.int32)
+    nodeoh = (
+        node[:, None] == jnp.arange(num_nodes, dtype=jnp.int32)[None, :]
+    ).astype(jnp.float32)  # (N, k)
+    data = jnp.stack(
+        [grad.astype(jnp.float32), hess.astype(jnp.float32), count.astype(jnp.float32)],
+        axis=-1,
+    )  # (N, 3)
+    return (data[:, :, None] * nodeoh[:, None, :]).reshape(node.shape[0], 3 * num_nodes)
+
+
+def build_histograms_panel_pallas(
+    bins: jax.Array,  # (N, F) integer bin indices
+    grad: jax.Array,  # (N,)
+    hess: jax.Array,  # (N,)
+    count: jax.Array,  # (N,)
+    node: jax.Array,  # (N,) int32 node key; out-of-range ⇒ row contributes 0
+    num_nodes: int,
+    num_bins: int,
+    *,
+    bw: Optional[int] = None,
+    interpret: Optional[bool] = None,
+    precision: str = "default",
+) -> jax.Array:
+    """(num_nodes, F, num_bins, 3) float32 via the panel formulation: the
+    node key moves from the one-hot ids (where each node adds B VPU-built
+    one-hot columns) into a precomputed (N, 3*num_nodes) data panel whose
+    lane dimension the MXU pads to 128 anyway — so up to ``floor(128/3) =
+    42`` nodes cost the same pass as one. The panel is built by ONE fused
+    XLA pass over the rows (node one-hot × [g,h,c]); the kernel itself is
+    the same VMEM-fused bin one-hot as the combined-id kernel, just with a
+    wide data operand. This is what makes multi-leaf-per-pass leafwise
+    growth ~free (train.py).
+
+    Unlike the combined-id kernel, rows whose node key is outside
+    [0, num_nodes) contribute nothing (zero panel row) — callers exploit
+    this as the in-leaf mask, so no grad/hess pre-masking pass is needed."""
+    n, f = bins.shape
+    if 3 * num_nodes > 128:
+        raise ValueError(f"panel width 3*{num_nodes} exceeds one lane group")
+    if bw is None:
+        bw = pick_bw(num_bins)
+    if not bw:
+        raise ValueError(f"num_bins={num_bins} too large for the VMEM budget")
+    if interpret is None:
+        interpret = jax.default_backend() not in ("tpu", "axon")
+
+    block_n = _SUBLANES * bw
+    panel = build_node_panel(grad, hess, count, node, num_nodes)
+    ids = bins.astype(jnp.int32)
+
+    pad = (-n) % block_n
+    if pad:
+        ids = jnp.pad(ids, ((0, pad), (0, 0)))
+        panel = jnp.pad(panel, ((0, pad), (0, 0)))
+    n_pad = n + pad
+    tiles = n_pad // block_n
+    d = 3 * num_nodes
+
+    ids3 = ids.T.reshape(f, tiles * _SUBLANES, bw)
+    panel3 = panel.reshape(tiles * _SUBLANES, bw, d)
+
+    prec = lax.Precision.HIGHEST if precision == "highest" else None
+    out = pl.pallas_call(
+        functools.partial(_hist_kernel, bw=bw, k=num_bins, precision=prec),
+        grid=(f, tiles),
+        in_specs=[
+            pl.BlockSpec((1, _SUBLANES, bw), lambda j, t: (j, t, 0)),
+            pl.BlockSpec((_SUBLANES, bw, d), lambda j, t: (t, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, num_bins, d), lambda j, t: (j, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((f, num_bins, d), jnp.float32),
+        interpret=interpret,
+    )(ids3, panel3)
+    # (F, B, 3*nodes) stat-major → (nodes, F, B, 3)
+    return out.reshape(f, num_bins, 3, num_nodes).transpose(3, 0, 1, 2)
+
+
 def _hist_kernel(ids_ref, data_ref, out_ref, *, bw: int, k: int, precision):
     t = pl.program_id(1)
     ids = ids_ref[0]  # (8, bw) int32 combined node*B + bin

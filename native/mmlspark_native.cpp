@@ -28,19 +28,97 @@ extern "C" {
 
 // -- binning -----------------------------------------------------------------
 
+// Order-preserving transform of a float32's bit pattern: negative floats map
+// below positives and the mapping is monotone in the real-number order, so
+// integer comparisons on keys agree with float comparisons on values
+// (classic radix-sort float trick). NaNs are filtered before keying.
+static inline uint32_t f32_order_key(float v) {
+  if (v == 0.0f) return 0x80000000u;  // unify -0.0 with +0.0 (floats compare equal)
+  uint32_t s;
+  std::memcpy(&s, &v, 4);
+  return (s & 0x80000000u) ? ~s : (s | 0x80000000u);
+}
+
 // X: row-major (n, f) float64; edges: row-major (f, e) float64 (padded with
 // +inf); out: row-major (n, f) uint8.
+//
+// Per-element work is a 16-bit-prefix lookup table instead of a binary
+// search: all float32 values sharing the top 16 bits of their order key form
+// a value interval, so a 65536-entry table per feature stores that
+// interval's [lo_bin, hi_bin]; most intervals land inside one bin (~8
+// branchy search steps -> ~2 ops per element), the rest finish with a
+// search over the narrowed edge range. Table build is f x 65536 walks of a
+// shared pointer — O(f * (65536 + e)) — amortized over n rows.
+static inline uint8_t bin_search_f32(const float* fj, int64_t lo, int64_t hi,
+                                     float v, int32_t max_bin) {
+  // searchsorted(fj, v, 'left') over [lo, hi): first index with fj[idx] >= v.
+  while (lo < hi) {
+    const int64_t mid = (lo + hi) / 2;
+    if (fj[mid] < v) {
+      lo = mid + 1;
+    } else {
+      hi = mid;
+    }
+  }
+  int64_t bin = 1 + lo;
+  if (bin > max_bin) bin = max_bin;
+  return static_cast<uint8_t>(bin);
+}
+
 void apply_bins_u8(const double* X, int64_t n, int64_t f,
                    const double* edges, int64_t e,
                    uint8_t* out, int32_t max_bin) {
-  // Snap every feature's edges to the float32 comparison grid once
-  // (f x 256 floats; <=256 KB for 256 features — L2-resident), then walk X
-  // row-major so both X and out stream contiguously.
+  // Snap every feature's edges to the float32 comparison grid once.
   const int64_t ne = e < 256 ? e : 256;
   float* fe = new float[f * ne];
   for (int64_t j = 0; j < f; ++j) {
     for (int64_t k = 0; k < ne; ++k) {
       fe[j * ne + k] = static_cast<float>(edges[j * e + k]);
+    }
+  }
+  if (n < 16384) {
+    // Small batches (per-partition predict/validation transforms): the
+    // prefix tables cost O(f * 65536) to build — more than the direct
+    // per-element binary search saves below ~16k rows.
+    for (int64_t i = 0; i < n; ++i) {
+      const double* xrow = X + i * f;
+      uint8_t* orow = out + i * f;
+      for (int64_t j = 0; j < f; ++j) {
+        const float v = static_cast<float>(xrow[j]);
+        orow[j] = std::isnan(v) ? 0 : bin_search_f32(fe + j * ne, 0, ne, v, max_bin);
+      }
+    }
+    delete[] fe;
+    return;
+  }
+  uint32_t* fk = new uint32_t[f * ne];  // order keys of the edges
+  for (int64_t j = 0; j < f; ++j) {
+    for (int64_t k = 0; k < ne; ++k) {
+      const float ev = fe[j * ne + k];
+      fk[j * ne + k] = std::isnan(ev) ? 0xFFFFFFFFu : f32_order_key(ev);
+    }
+  }
+  // lo/hi bin index per 16-bit key prefix, per feature.
+  const size_t tab_size = static_cast<size_t>(f) * 65536u;
+  uint8_t* lo_tab = new uint8_t[tab_size];
+  uint8_t* hi_tab = new uint8_t[tab_size];
+  for (int64_t j = 0; j < f; ++j) {
+    const uint32_t* kj = fk + j * ne;
+    uint8_t* lj = lo_tab + j * 65536;
+    uint8_t* hj = hi_tab + j * 65536;
+    int64_t pos_lo = 0;  // first edge with key >= prefix<<16 (lowest value of class)
+    for (int64_t p = 0; p < 65536; ++p) {
+      while (pos_lo < ne && kj[pos_lo] < (static_cast<uint32_t>(p) << 16)) ++pos_lo;
+      // highest value of the class is (p<<16)|0xFFFF
+      int64_t pos_hi = pos_lo;
+      const uint32_t top = (static_cast<uint32_t>(p) << 16) | 0xFFFFu;
+      while (pos_hi < ne && kj[pos_hi] <= top) ++pos_hi;
+      int64_t lo_bin = 1 + pos_lo;
+      int64_t hi_bin = 1 + pos_hi;
+      if (lo_bin > max_bin) lo_bin = max_bin;
+      if (hi_bin > max_bin) hi_bin = max_bin;
+      lj[p] = static_cast<uint8_t>(lo_bin);
+      hj[p] = static_cast<uint8_t>(hi_bin);
     }
   }
   for (int64_t i = 0; i < n; ++i) {
@@ -52,23 +130,24 @@ void apply_bins_u8(const double* X, int64_t n, int64_t f,
         orow[j] = 0;  // missing bin
         continue;
       }
-      // searchsorted(fe_j, v, side='left'): first index with fe[idx] >= v
-      const float* fj = fe + j * ne;
-      int64_t lo = 0, hi = ne;
-      while (lo < hi) {
-        const int64_t mid = (lo + hi) / 2;
-        if (fj[mid] < v) {
-          lo = mid + 1;
-        } else {
-          hi = mid;
-        }
+      const uint32_t key = f32_order_key(v);
+      const uint32_t p = key >> 16;
+      const uint8_t lo_b = lo_tab[j * 65536 + p];
+      const uint8_t hi_b = hi_tab[j * 65536 + p];
+      if (lo_b == hi_b) {
+        orow[j] = lo_b;
+        continue;
       }
-      int64_t bin = 1 + lo;
-      if (bin > max_bin) bin = max_bin;
-      orow[j] = static_cast<uint8_t>(bin);
+      // Narrowed searchsorted over the prefix class's edge range.
+      int64_t hi = hi_b - 1;
+      if (hi > ne) hi = ne;
+      orow[j] = bin_search_f32(fe + j * ne, lo_b - 1, hi, v, max_bin);
     }
   }
+  delete[] lo_tab;
+  delete[] hi_tab;
   delete[] fe;
+  delete[] fk;
 }
 
 // -- murmur3 -----------------------------------------------------------------
