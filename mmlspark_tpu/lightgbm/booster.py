@@ -68,6 +68,10 @@ class Booster:
     best_iteration: int = -1  # -1 = use all
     feature_names: Optional[list] = None
     bin_edges: Optional[np.ndarray] = None  # (F, max_bin-1) for re-binning
+    # (T, M) bool: where a NaN feature value routes at each internal node.
+    # None = all True (trees trained here always send missing left); imported
+    # LightGBM models carry per-node directions from their decision_type.
+    nan_left: Optional[np.ndarray] = None
 
     @property
     def num_trees(self) -> int:
@@ -112,7 +116,7 @@ class Booster:
             return np.broadcast_to(
                 self.init_score[None, :], (X.shape[0], self.num_classes)
             ).copy()
-        feats, thrs, P, plen, lvals, _ = _paths_cache(self, t)
+        feats, thrs, P, plen, lvals, _, nanl = _paths_cache(self, t)
         X32 = np.asarray(X, dtype=np.float32)
         chunk = _predict_chunk_rows(*feats.shape)
         outs = []
@@ -121,8 +125,8 @@ class Booster:
                 np.asarray(
                     _predict_margin_paths_jit(
                         jnp.asarray(X32[lo : lo + chunk]),
-                        jnp.asarray(feats), jnp.asarray(thrs), jnp.asarray(P),
-                        jnp.asarray(plen), jnp.asarray(lvals),
+                        jnp.asarray(feats), jnp.asarray(thrs), jnp.asarray(nanl),
+                        jnp.asarray(P), jnp.asarray(plen), jnp.asarray(lvals),
                         jnp.asarray(self.init_score), self.num_classes,
                     )
                 )
@@ -141,7 +145,7 @@ class Booster:
         t = self._used_trees(num_iteration)
         if t == 0:
             return np.zeros((np.shape(X)[0], 0), np.int32)
-        feats, thrs, P, plen, _, lslots = _paths_cache(self, t)
+        feats, thrs, P, plen, _, lslots, nanl = _paths_cache(self, t)
         X32 = np.asarray(X, dtype=np.float32)
         chunk = _predict_chunk_rows(*feats.shape)
         outs = []
@@ -150,8 +154,8 @@ class Booster:
                 np.asarray(
                     _predict_leaf_paths_jit(
                         jnp.asarray(X32[lo : lo + chunk]),
-                        jnp.asarray(feats), jnp.asarray(thrs), jnp.asarray(P),
-                        jnp.asarray(plen), jnp.asarray(lslots),
+                        jnp.asarray(feats), jnp.asarray(thrs), jnp.asarray(nanl),
+                        jnp.asarray(P), jnp.asarray(plen), jnp.asarray(lslots),
                     )
                 )
             )
@@ -189,13 +193,26 @@ class Booster:
         for k in ("cover", "split_gain"):
             if d.get(k) is not None:
                 d[k] = np.asarray(d[k], dtype=np.float32)
+        if d.get("nan_left") is not None:
+            d["nan_left"] = np.asarray(d["nan_left"], dtype=bool)
         if d.get("bin_edges") is not None:
             d["bin_edges"] = np.asarray(d["bin_edges"], dtype=np.float64)
         return Booster(**d)
 
     def model_to_string(self) -> str:
-        """Textual model dump (``saveNativeModel`` analogue; our own JSON
-        format — LightGBM text-format interop is tracked as a gap)."""
+        """``saveNativeModel`` string — the REAL LightGBM model-text format
+        (``LightGBMBooster.scala:277-310``): loadable by any LightGBM
+        runtime, ONNX converters, and SHAP tooling. See
+        :mod:`mmlspark_tpu.lightgbm.model_text` for encoding notes (the init
+        score is folded into iteration-0 leaf values, as LightGBM's own
+        boost_from_average does, so margins survive the round-trip)."""
+        from mmlspark_tpu.lightgbm.model_text import to_lightgbm_text
+
+        return to_lightgbm_text(self)
+
+    def to_json_string(self) -> str:
+        """Lossless internal JSON dump (keeps split_bin / bin_edges /
+        init_score exactly — the stage-serialization payload)."""
         d = self.to_dict()
         for k, v in d.items():
             if isinstance(v, np.ndarray):
@@ -204,6 +221,13 @@ class Booster:
 
     @staticmethod
     def from_string(s: str) -> "Booster":
+        """Parse either format: LightGBM model text (starts with ``tree``)
+        or the internal JSON dump."""
+        head = s.lstrip()[:16]
+        if head.startswith("tree"):
+            from mmlspark_tpu.lightgbm.model_text import from_lightgbm_text
+
+            return from_lightgbm_text(s)
         d = json.loads(s)
         for k, v in list(d.items()):
             if isinstance(v, dict) and "__nd__" in v:
@@ -266,8 +290,8 @@ def _csr_chunks(X, target_bytes: int = 256 << 20):
 def _leaf_paths(b: "Booster", t: int):
     """Host precompute for trees[:t]: per-tree padded constants
     (FEATS (T,I), THRS (T,I), P (T,I,L), PLEN (T,L), LVALS (T,L),
-    LSLOTS (T,L))."""
-    feats_l, thrs_l, P_l, plen_l, lvals_l, lslots_l = [], [], [], [], [], []
+    LSLOTS (T,L), NANL (T,I))."""
+    feats_l, thrs_l, P_l, plen_l, lvals_l, lslots_l, nanl_l = [], [], [], [], [], [], []
     max_i = max_l = 1
     per_tree = []
     for ti in range(t):
@@ -293,8 +317,11 @@ def _leaf_paths(b: "Booster", t: int):
         pos = {s: k for k, s in enumerate(internal)}
         fe = np.zeros(max_i, np.int32)
         th = np.full(max_i, np.inf, np.float32)  # padding: always-left, off-path
+        nl = np.ones(max_i, bool)  # padding: NaN goes left (off-path anyway)
         fe[: len(internal)] = b.split_feature[ti][internal]
         th[: len(internal)] = b.split_threshold[ti][internal]
+        if b.nan_left is not None:
+            nl[: len(internal)] = b.nan_left[ti][internal]
         P = np.zeros((max_i, max_l), np.float32)
         plen = np.full(max_l, np.float32(max_i + 1))  # unmatched sentinel
         lv = np.zeros(max_l, np.float32)
@@ -307,6 +334,7 @@ def _leaf_paths(b: "Booster", t: int):
             ls[li] = slot
         feats_l.append(fe)
         thrs_l.append(th)
+        nanl_l.append(nl)
         P_l.append(P)
         plen_l.append(plen)
         lvals_l.append(lv)
@@ -318,16 +346,18 @@ def _leaf_paths(b: "Booster", t: int):
         np.stack(plen_l),
         np.stack(lvals_l),
         np.stack(lslots_l),
+        np.stack(nanl_l),
     )
 
 
-def _path_match(X, feats, thrs, P, plen):
+def _path_match(X, feats, thrs, nanl, P, plen):
     """(N, T, L) one-hot leaf membership per tree."""
     x = jnp.take(X, feats.reshape(-1), axis=1)
     n = X.shape[0]
     t, i = feats.shape
     x = x.reshape(n, t, i)
-    d = jnp.isnan(x) | (x <= thrs[None])  # missing/pad go left
+    # missing routes per the node's nan_left flag; pads are always-left
+    d = (jnp.isnan(x) & nanl[None]) | (x <= thrs[None])
     D = 2.0 * d.astype(jnp.float32) - 1.0  # (N, T, I)
     score = jnp.einsum(
         "nti,til->ntl", D, P, preferred_element_type=jnp.float32,
@@ -338,8 +368,8 @@ def _path_match(X, feats, thrs, P, plen):
 
 
 @partial(jax.jit, static_argnames=("num_classes",))
-def _predict_margin_paths_jit(X, feats, thrs, P, plen, lvals, init_score, num_classes):
-    match = _path_match(X, feats, thrs, P, plen)
+def _predict_margin_paths_jit(X, feats, thrs, nanl, P, plen, lvals, init_score, num_classes):
+    match = _path_match(X, feats, thrs, nanl, P, plen)
     # match is one-hot over leaves: the contribution IS a matmul, no gather
     contrib = jnp.einsum(
         "ntl,tl->nt", match.astype(jnp.float32), lvals,
@@ -352,8 +382,8 @@ def _predict_margin_paths_jit(X, feats, thrs, P, plen, lvals, init_score, num_cl
 
 
 @jax.jit
-def _predict_leaf_paths_jit(X, feats, thrs, P, plen, lslots):
-    match = _path_match(X, feats, thrs, P, plen)
+def _predict_leaf_paths_jit(X, feats, thrs, nanl, P, plen, lslots):
+    match = _path_match(X, feats, thrs, nanl, P, plen)
     # one-hot contraction again: slot id = sum_l match * slot_l
     return jnp.einsum(
         "ntl,tl->nt", match.astype(jnp.float32), lslots.astype(jnp.float32),

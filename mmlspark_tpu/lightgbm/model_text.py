@@ -1,0 +1,416 @@
+"""Native LightGBM model-text serde.
+
+The reference's booster string IS LightGBM's text format — loadable by any
+LightGBM runtime, ONNX converters, and SHAP tooling
+(``lightgbm/LightGBMBooster.scala:277-310``; save/load API
+``LightGBMClassifier.scala:172-194``). This module emits and parses that
+format (model file ``version=v3``, the LightGBM 3.x layout) so boosters
+trained here interoperate with the LightGBM ecosystem and models trained by
+LightGBM score here.
+
+Encoding notes (mirroring LightGBM's ``src/io/tree.cpp`` / ``gbdt_model_text.cpp``):
+
+- A tree with L leaves has L-1 internal nodes. ``left_child``/``right_child``
+  entries >= 0 index internal nodes; negative entries encode leaves as
+  ``~leaf_index`` (i.e. leaf j is stored as -(j+1)).
+- ``decision_type`` is a bit field: bit 0 = categorical (unsupported here),
+  bit 1 = default_left, bits 2-3 = missing type (0 none, 1 zero, 2 NaN).
+  Trees trained here always route NaN left: ``decision_type = 10``.
+- ``boost_from_average``: LightGBM has no init-score field — the init score
+  lives inside the first iteration's leaf values. Export therefore folds
+  ``init_score[c]`` into iteration-0 class-c leaf values; import leaves
+  ``init_score = 0`` (the margins come out identical).
+- Floats print with ``%.17g`` (round-trip exact for float64).
+
+Out of scope (explicit errors): categorical splits (``num_cat > 0``),
+linear trees (``is_linear=1``), and ``missing_type=Zero``
+(``zero_as_missing=true`` models). ``missing_type=None`` imports with the
+LightGBM predictor's convention that a NaN at such a node behaves like 0.0,
+which resolves to a static per-node direction ``nan_left = (0.0 <= threshold)``.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+_G = "%.17g"
+
+
+def _fmt(values) -> str:
+    return " ".join(_G % float(v) for v in values)
+
+
+def _fmt_int(values) -> str:
+    return " ".join(str(int(v)) for v in values)
+
+
+# Our objective names -> LightGBM model-file objective strings.
+def _objective_str(objective: str, num_classes: int) -> str:
+    if objective == "binary":
+        return "binary sigmoid:1"
+    if objective == "multiclass":
+        return f"multiclass num_class:{num_classes}"
+    return objective  # regression / regression_l1 / huber / quantile / poisson / tweedie
+
+
+def _parse_objective(s: str) -> str:
+    tok = s.split()
+    return tok[0] if tok else "regression"
+
+
+def to_lightgbm_text(booster, shrinkage: float = 1.0) -> str:
+    """Serialize a :class:`~mmlspark_tpu.lightgbm.booster.Booster` to
+    LightGBM's model text. ``shrinkage`` is recorded per tree (informational:
+    leaf values in the file are final, as LightGBM itself writes them)."""
+    t = booster.num_trees
+    c = booster.num_classes
+    f = booster.num_features
+    nan_left = getattr(booster, "nan_left", None)
+    init = np.asarray(booster.init_score, dtype=np.float64)
+    if t == 0 and np.any(init != 0):
+        raise ValueError(
+            "cannot export a zero-tree booster with nonzero init_score: "
+            "LightGBM's format stores the init score inside the first "
+            "iteration's leaf values"
+        )
+
+    tree_strs: List[str] = []
+    for ti in range(t):
+        is_leaf = np.asarray(booster.is_leaf[ti], dtype=bool)
+        left = np.asarray(booster.left_child[ti])
+        right = np.asarray(booster.right_child[ti])
+        feat = np.asarray(booster.split_feature[ti])
+        thr = np.asarray(booster.split_threshold[ti], dtype=np.float64)
+        lval = np.asarray(booster.leaf_values[ti], dtype=np.float64)
+        gain = (
+            np.asarray(booster.split_gain[ti], dtype=np.float64)
+            if booster.split_gain is not None
+            else np.zeros(len(feat))
+        )
+        cover = (
+            np.asarray(booster.cover[ti], dtype=np.float64)
+            if booster.cover is not None
+            else np.zeros(len(feat))
+        )
+        nl = (
+            np.asarray(nan_left[ti], dtype=bool)
+            if nan_left is not None
+            else np.ones(len(feat), dtype=bool)
+        )
+
+        # init-score folding: iteration 0, class ti % c
+        bias = float(init[ti % c]) if ti < c else 0.0
+
+        # Walk reachable slots from the root, assigning LightGBM indices:
+        # internal nodes and leaves each in pre-order discovery order.
+        internal_ids = {}
+        leaf_ids = {}
+        order: List[int] = []
+        stack = [0]
+        while stack:
+            slot = stack.pop()
+            order.append(slot)
+            if is_leaf[slot]:
+                leaf_ids[slot] = len(leaf_ids)
+                continue
+            internal_ids[slot] = len(internal_ids)
+            stack.append(int(right[slot]))
+            stack.append(int(left[slot]))
+        num_leaves = len(leaf_ids)
+        ni = len(internal_ids)
+
+        sf = np.zeros(ni, np.int64)
+        sg = np.zeros(ni, np.float64)
+        th = np.zeros(ni, np.float64)
+        dt = np.zeros(ni, np.int64)
+        lc = np.zeros(ni, np.int64)
+        rc = np.zeros(ni, np.int64)
+        ivalue = np.zeros(ni, np.float64)
+        iw = np.zeros(ni, np.float64)  # float cover (weighted row mass)
+        lv = np.zeros(max(num_leaves, 1), np.float64)
+        lw = np.zeros(max(num_leaves, 1), np.float64)
+
+        def child_ref(slot: int) -> int:
+            return internal_ids[slot] if not is_leaf[slot] else ~leaf_ids[slot]
+
+        for slot in order:
+            if is_leaf[slot]:
+                li = leaf_ids[slot]
+                lv[li] = lval[slot] + bias
+                lw[li] = cover[slot]
+                continue
+            ii = internal_ids[slot]
+            sf[ii] = int(feat[slot])
+            sg[ii] = max(gain[slot], 0.0)
+            th[ii] = thr[slot]
+            # bit1 default_left per the node's NaN routing; bits2-3 = NaN(2)
+            dt[ii] = (2 if nl[slot] else 0) | (2 << 2)
+            lc[ii] = child_ref(int(left[slot]))
+            rc[ii] = child_ref(int(right[slot]))
+            iw[ii] = cover[slot]
+
+        if num_leaves == 0:  # degenerate: root itself missing (cannot happen)
+            num_leaves = 1
+
+        fields = [
+            f"num_leaves={num_leaves}",
+            "num_cat=0",
+            f"split_feature={_fmt_int(sf)}",
+            f"split_gain={_fmt(sg)}",
+            f"threshold={_fmt(th)}",
+            f"decision_type={_fmt_int(dt)}",
+            f"left_child={_fmt_int(lc)}",
+            f"right_child={_fmt_int(rc)}",
+            f"leaf_value={_fmt(lv)}",
+            f"leaf_weight={_fmt(lw)}",
+            f"leaf_count={_fmt_int(np.round(lw))}",
+            f"internal_value={_fmt(ivalue)}",
+            f"internal_weight={_fmt(iw)}",
+            f"internal_count={_fmt_int(np.round(iw))}",
+            "is_linear=0",
+            f"shrinkage={_G % shrinkage}",
+        ]
+        if ni == 0:
+            # single-leaf tree: LightGBM omits the internal-node arrays
+            fields = [
+                f"num_leaves={num_leaves}",
+                "num_cat=0",
+                f"leaf_value={_fmt(lv)}",
+                "is_linear=0",
+                f"shrinkage={_G % shrinkage}",
+            ]
+        tree_strs.append(f"Tree={ti}\n" + "\n".join(fields) + "\n\n\n")
+
+    names = booster.feature_names or [f"Column_{j}" for j in range(f)]
+    edges = booster.bin_edges
+    infos = []
+    for j in range(f):
+        if edges is not None and np.isfinite(edges[j]).any():
+            fin = edges[j][np.isfinite(edges[j])]
+            infos.append(f"[{_G % fin.min()}:{_G % fin.max()}]")
+        else:
+            infos.append("none")
+
+    header = "\n".join(
+        [
+            "tree",
+            "version=v3",
+            f"num_class={c}",
+            f"num_tree_per_iteration={c}",
+            "label_index=0",
+            f"max_feature_idx={max(f - 1, 0)}",
+            f"objective={_objective_str(booster.objective, c)}",
+            "feature_names=" + " ".join(names),
+            "feature_infos=" + " ".join(infos),
+            "tree_sizes=" + " ".join(str(len(s.encode())) for s in tree_strs),
+        ]
+    )
+    imp = booster.feature_importances("split") if t else np.zeros(f)
+    imp_lines = "\n".join(
+        f"{names[j]}={int(imp[j])}"
+        for j in np.argsort(-imp, kind="stable")
+        if imp[j] > 0
+    )
+    return (
+        header
+        + "\n\n"
+        + "".join(tree_strs)
+        + "end of trees\n\n"
+        + "feature_importances:\n"
+        + imp_lines
+        + ("\n" if imp_lines else "")
+        + "\nparameters:\n"
+        + f"[objective: {_parse_objective(_objective_str(booster.objective, c))}]\n"
+        + "end of parameters\n\n"
+        + "pandas_categorical:null\n"
+    )
+
+
+def _block_value(block: dict, key: str, default=None):
+    if key not in block:
+        if default is not None:
+            return default
+        raise ValueError(f"LightGBM model text: tree block missing {key!r}")
+    return block[key]
+
+
+def from_lightgbm_text(s: str):
+    """Parse LightGBM model text into a Booster. Raises ``ValueError`` for
+    capabilities outside this runtime (categorical splits, linear trees,
+    ``zero_as_missing`` models)."""
+    from mmlspark_tpu.lightgbm.booster import Booster
+
+    lines = s.splitlines()
+    header = {}
+    i = 0
+    while i < len(lines):
+        line = lines[i].strip()
+        if line.startswith("Tree="):
+            break
+        if "=" in line:
+            k, _, v = line.partition("=")
+            header[k] = v
+        i += 1
+
+    num_classes = int(header.get("num_class", 1))
+    per_iter = int(header.get("num_tree_per_iteration", num_classes))
+    if per_iter != num_classes:
+        raise ValueError(
+            f"num_tree_per_iteration={per_iter} != num_class={num_classes} "
+            "(boosted random forests of multiple trees per round are not supported)"
+        )
+    objective = _parse_objective(header.get("objective", "regression"))
+    if objective not in (
+        "binary", "multiclass", "regression", "regression_l1", "huber",
+        "quantile", "poisson", "tweedie",
+    ):
+        raise ValueError(f"unsupported objective in model text: {objective!r}")
+    max_feature_idx = int(header.get("max_feature_idx", 0))
+    feature_names = header.get("feature_names", "").split() or None
+
+    # Tree blocks: key=value lines between "Tree=i" and the next blank run.
+    blocks = []
+    cur: Optional[dict] = None
+    for line in lines[i:]:
+        line = line.strip()
+        if line.startswith("Tree="):
+            cur = {}
+            blocks.append(cur)
+            continue
+        if line == "end of trees":
+            break
+        if not line or cur is None:
+            continue
+        k, _, v = line.partition("=")
+        cur[k] = v
+
+    trees = []
+    for bi, blk in enumerate(blocks):
+        num_leaves = int(_block_value(blk, "num_leaves"))
+        if int(blk.get("num_cat", "0")) > 0:
+            raise ValueError(f"tree {bi}: categorical splits are not supported")
+        if blk.get("is_linear", "0").strip() not in ("0", ""):
+            raise ValueError(f"tree {bi}: linear trees are not supported")
+        lv = np.fromstring(_block_value(blk, "leaf_value"), sep=" ")
+        if num_leaves == 1:
+            trees.append(
+                dict(feat=[0], thr=[np.inf], left=[0], right=[0],
+                     is_leaf=[True], lval=[lv[0]], nanl=[True],
+                     cover=[0.0], gain=[0.0])
+            )
+            continue
+        sf = np.fromstring(_block_value(blk, "split_feature"), sep=" ").astype(np.int64)
+        th = np.fromstring(_block_value(blk, "threshold"), sep=" ")
+        dt = np.fromstring(_block_value(blk, "decision_type"), sep=" ").astype(np.int64)
+        lc = np.fromstring(_block_value(blk, "left_child"), sep=" ").astype(np.int64)
+        rc = np.fromstring(_block_value(blk, "right_child"), sep=" ").astype(np.int64)
+        gain = np.fromstring(blk.get("split_gain", ""), sep=" ")
+        # Covers: prefer the *_weight fields (we export float row mass there;
+        # real LightGBM stores hessian sums — both are the TreeSHAP node
+        # measure), falling back to the integer *_count fields.
+        icnt = np.fromstring(
+            blk.get("internal_weight", "") or blk.get("internal_count", ""), sep=" "
+        )
+        lcnt = np.fromstring(
+            blk.get("leaf_weight", "") or blk.get("leaf_count", ""), sep=" "
+        )
+        ni = num_leaves - 1
+        if any(len(a) != ni for a in (sf, th, dt, lc, rc)):
+            raise ValueError(f"tree {bi}: inconsistent internal-node array lengths")
+
+        if np.any(dt & 1):
+            raise ValueError(f"tree {bi}: categorical decision_type")
+        missing = (dt >> 2) & 3
+        if np.any(missing == 1):
+            raise ValueError(
+                f"tree {bi}: zero_as_missing models are not supported"
+            )
+        default_left = (dt & 2) != 0
+        # missing_type None: LightGBM's predictor treats NaN like 0.0 there.
+        nan_left_i = np.where(missing == 0, 0.0 <= th, default_left)
+
+        # LightGBM indices -> slot layout: internal i -> slot i,
+        # leaf j -> slot ni + j (any consistent layout works for routing).
+        m = 2 * num_leaves - 1
+
+        def slot_of(ref: int) -> int:
+            return int(ref) if ref >= 0 else ni + (~int(ref))
+
+        feat = np.zeros(m, np.int64)
+        thr_s = np.full(m, np.inf)
+        left_s = np.zeros(m, np.int64)
+        right_s = np.zeros(m, np.int64)
+        isl = np.zeros(m, bool)
+        lval_s = np.zeros(m)
+        nanl_s = np.ones(m, bool)
+        cover_s = np.zeros(m)
+        gain_s = np.zeros(m)
+        isl[ni:] = True
+        lval_s[ni:] = lv[:num_leaves]
+        if len(lcnt) == num_leaves:
+            cover_s[ni:] = lcnt
+        for ii in range(ni):
+            feat[ii] = sf[ii]
+            thr_s[ii] = th[ii]
+            left_s[ii] = slot_of(lc[ii])
+            right_s[ii] = slot_of(rc[ii])
+            nanl_s[ii] = bool(nan_left_i[ii])
+            if len(gain) == ni:
+                gain_s[ii] = gain[ii]
+            if len(icnt) == ni:
+                cover_s[ii] = icnt[ii]
+        trees.append(
+            dict(feat=feat, thr=thr_s, left=left_s, right=right_s,
+                 is_leaf=isl, lval=lval_s, nanl=nanl_s, cover=cover_s,
+                 gain=gain_s)
+        )
+
+    t = len(trees)
+    m = max((len(tr["feat"]) for tr in trees), default=1)
+
+    def pad(key, fill, dtype):
+        out = np.full((t, m), fill, dtype=dtype)
+        for ti, tr in enumerate(trees):
+            out[ti, : len(tr[key])] = tr[key]
+        return out
+
+    booster = Booster(
+        split_feature=pad("feat", 0, np.int32),
+        split_threshold=pad("thr", np.inf, np.float32),
+        split_bin=np.zeros((t, m), np.int32),
+        left_child=pad("left", 0, np.int32),
+        right_child=pad("right", 0, np.int32),
+        is_leaf=pad("is_leaf", False, bool),
+        leaf_values=pad("lval", 0.0, np.float32),
+        cover=pad("cover", 0.0, np.float32),
+        split_gain=pad("gain", 0.0, np.float32),
+        init_score=np.zeros(num_classes, np.float32),
+        num_classes=num_classes,
+        objective=objective,
+        max_depth=_pointer_depth(trees),
+        feature_names=feature_names
+        or [f"Column_{j}" for j in range(max_feature_idx + 1)],
+        nan_left=pad("nanl", True, bool),
+    )
+    return booster
+
+
+def _pointer_depth(trees) -> int:
+    depth = 1
+    for tr in trees:
+        left, right, isl = tr["left"], tr["right"], tr["is_leaf"]
+        d = {0: 0}
+        best = 0
+        stack = [0]
+        while stack:
+            s = stack.pop()
+            if isl[s]:
+                best = max(best, d[s])
+                continue
+            for ch in (int(left[s]), int(right[s])):
+                d[ch] = d[s] + 1
+                stack.append(ch)
+        depth = max(depth, best)
+    return max(1, depth)

@@ -41,6 +41,7 @@ def tree_shap(booster, X: np.ndarray, num_iteration: Optional[int] = None) -> np
             booster.leaf_values[t],
             booster.cover[t],
             X,
+            nan_left=None if booster.nan_left is None else booster.nan_left[t],
         )
         cls = t % c
         phi[:, cls, :f] += contrib
@@ -48,7 +49,7 @@ def tree_shap(booster, X: np.ndarray, num_iteration: Optional[int] = None) -> np
     return phi
 
 
-def _shap_one_tree(feat, thr, left, right, is_leaf, leaf_val, cover, X):
+def _shap_one_tree(feat, thr, left, right, is_leaf, leaf_val, cover, X, nan_left=None):
     n, num_features = X.shape
     phi = np.zeros((n, num_features), dtype=np.float64)
 
@@ -56,7 +57,8 @@ def _shap_one_tree(feat, thr, left, right, is_leaf, leaf_val, cover, X):
     # float32 — the same comparison grid as the jitted predict path, so
     # boundary values route identically and additivity holds exactly.
     xv = X[:, feat].astype(np.float32)  # (N, M)
-    goes_left = np.isnan(xv) | (xv <= thr[None, :].astype(np.float32))  # (N, M)
+    nl = np.ones(len(feat), bool) if nan_left is None else np.asarray(nan_left, bool)
+    goes_left = (np.isnan(xv) & nl[None, :]) | (xv <= thr[None, :].astype(np.float32))
 
     root_cover = max(float(cover[0]), 1e-12)
 

@@ -1,0 +1,236 @@
+"""LightGBM model-text interop (reference ``LightGBMBooster.scala:277-310``,
+save/load API ``LightGBMClassifier.scala:172-194``).
+
+The round-trip against the real ``lightgbm`` package runs when it is
+installed (skipped otherwise); the hand-written model strings below pin the
+format semantics — node encoding, leaf references, decision_type missing
+bits, init-score folding — independently of it.
+"""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.lightgbm.binning import bin_dataset
+from mmlspark_tpu.lightgbm.booster import Booster
+from mmlspark_tpu.lightgbm.model_text import from_lightgbm_text, to_lightgbm_text
+from mmlspark_tpu.lightgbm.train import TrainOptions, train
+
+
+def _fit(objective="binary", num_class=1, n=600, f=6, iters=4, leaves=7, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f))
+    if objective == "multiclass":
+        y = (X[:, 0] + X[:, 1] > 0).astype(np.float64) + (X[:, 2] > 0.5)
+    elif objective == "binary":
+        y = ((X[:, 0] + X[:, 1] * X[:, 2]) > 0).astype(np.float64)
+    else:
+        y = X[:, 0] * 2.0 + np.sin(X[:, 1]) + 0.1 * rng.normal(size=n)
+    bins, mapper = bin_dataset(X, max_bin=31)
+    opts = TrainOptions(
+        objective=objective, num_iterations=iters, num_leaves=leaves,
+        max_bin=31, num_class=num_class,
+    )
+    return train(bins, y, opts, mapper=mapper).booster, X
+
+
+class TestExportImportRoundTrip:
+    @pytest.mark.parametrize("objective,num_class", [
+        ("binary", 1), ("regression", 1), ("multiclass", 3),
+    ])
+    def test_margins_survive(self, objective, num_class):
+        b, X = _fit(objective, num_class)
+        s = to_lightgbm_text(b)
+        b2 = from_lightgbm_text(s)
+        np.testing.assert_allclose(
+            b2.raw_margin(X[:100]), b.raw_margin(X[:100]), rtol=1e-5, atol=1e-6
+        )
+
+    def test_init_score_folded_into_first_iteration(self):
+        b, X = _fit("binary")
+        assert np.any(np.asarray(b.init_score) != 0)
+        b2 = from_lightgbm_text(to_lightgbm_text(b))
+        assert np.all(np.asarray(b2.init_score) == 0)
+        np.testing.assert_allclose(
+            b2.raw_margin(X[:50]), b.raw_margin(X[:50]), rtol=1e-5, atol=1e-6
+        )
+
+    def test_nan_routing_survives(self):
+        b, X = _fit("binary")
+        Xn = X[:200].copy()
+        Xn[::3, 0] = np.nan
+        Xn[::5, 2] = np.nan
+        b2 = from_lightgbm_text(to_lightgbm_text(b))
+        np.testing.assert_allclose(
+            b2.raw_margin(Xn), b.raw_margin(Xn), rtol=1e-5, atol=1e-6
+        )
+
+    def test_shap_survives(self):
+        b, X = _fit("binary")
+        b2 = from_lightgbm_text(to_lightgbm_text(b))
+        np.testing.assert_allclose(
+            b2.features_shap(X[:20]), b.features_shap(X[:20]), rtol=1e-4, atol=1e-5
+        )
+
+    def test_booster_from_string_autodetects(self):
+        b, X = _fit("regression")
+        for s in (b.model_to_string(), b.to_json_string()):
+            b2 = Booster.from_string(s)
+            np.testing.assert_allclose(
+                b2.raw_margin(X[:20]), b.raw_margin(X[:20]), rtol=1e-5, atol=1e-6
+            )
+
+
+class TestFormatStructure:
+    def test_header_and_tree_sizes_are_byte_accurate(self):
+        b, _ = _fit("binary", iters=3)
+        s = to_lightgbm_text(b)
+        assert s.startswith("tree\nversion=v3\n")
+        header, _, rest = s.partition("\n\n")
+        fields = dict(
+            line.partition("=")[::2] for line in header.splitlines() if "=" in line
+        )
+        assert fields["num_class"] == "1"
+        assert fields["objective"].startswith("binary")
+        sizes = [int(x) for x in fields["tree_sizes"].split()]
+        assert len(sizes) == b.num_trees
+        # each recorded size must cover exactly one "Tree=i\n...\n\n\n" block
+        pos = 0
+        for i, size in enumerate(sizes):
+            block = rest[pos : pos + size]
+            assert block.startswith(f"Tree={i}\n")
+            assert block.endswith("\n\n\n")
+            pos += size
+        assert rest[pos:].startswith("end of trees")
+
+    def test_leaf_references_are_ones_complement(self):
+        b, _ = _fit("binary", iters=1, leaves=3)
+        s = to_lightgbm_text(b)
+        block = s.split("Tree=0\n", 1)[1]
+        get = lambda k: block.split(f"{k}=", 1)[1].splitlines()[0].split()
+        left = [int(v) for v in get("left_child")]
+        right = [int(v) for v in get("right_child")]
+        leaves = [v for v in left + right if v < 0]
+        assert sorted(~np.array(leaves)) == list(range(len(get("leaf_value"))))
+        assert all(int(v) == 10 for v in get("decision_type"))
+
+
+class TestImportedSemantics:
+    # A hand-written 1-tree model: root splits feature 0 at 0.5 (NaN left),
+    # left child splits feature 1 at -1 with decision_type=8 (missing NaN,
+    # default RIGHT). Leaves: L0=10, L1=20, L2=30.
+    MODEL = """tree
+version=v3
+num_class=1
+num_tree_per_iteration=1
+label_index=0
+max_feature_idx=1
+objective=regression
+feature_names=f0 f1
+feature_infos=[-10:10] [-10:10]
+tree_sizes=300
+
+Tree=0
+num_leaves=3
+num_cat=0
+split_feature=0 1
+split_gain=5 3
+threshold=0.5 -1
+decision_type=10 8
+left_child=1 -1
+right_child=-3 -2
+leaf_value=10 20 30
+leaf_weight=4 3 3
+leaf_count=4 3 3
+internal_value=0 0
+internal_weight=10 7
+internal_count=10 7
+is_linear=0
+shrinkage=1
+
+
+end of trees
+
+feature_importances:
+f0=1
+f1=1
+
+parameters:
+end of parameters
+
+pandas_categorical:null
+"""
+
+    def test_hand_model_routing(self):
+        b = from_lightgbm_text(self.MODEL)
+        X = np.array([
+            [0.0, -2.0],   # left at root, then f1 <= -1 -> leaf0 = 10
+            [0.0, 0.0],    # left, f1 > -1 -> leaf1 = 20
+            [1.0, 0.0],    # right at root -> leaf2 = 30
+            [np.nan, 0.0], # NaN at root: default LEFT -> then f1>-1 -> 20
+            [0.0, np.nan], # NaN at inner node: default RIGHT -> 20
+        ])
+        np.testing.assert_allclose(
+            b.raw_margin(X)[:, 0], [10.0, 20.0, 30.0, 20.0, 20.0]
+        )
+
+    def test_missing_none_treats_nan_as_zero(self):
+        # decision_type=2: default_left, missing None -> NaN behaves like 0.0
+        model = self.MODEL.replace("decision_type=10 8", "decision_type=2 2")
+        b = from_lightgbm_text(model)
+        X = np.array([
+            [np.nan, 0.0],  # 0.0 <= 0.5 -> left, f1: 0 > -1 -> leaf1 = 20
+            [0.0, np.nan],  # left; NaN~0 > -1 -> right -> leaf1 = 20
+        ])
+        np.testing.assert_allclose(b.raw_margin(X)[:, 0], [20.0, 20.0])
+
+    def test_single_leaf_tree(self):
+        model = self.MODEL
+        block = """Tree=0
+num_leaves=1
+num_cat=0
+leaf_value=7.5
+is_linear=0
+shrinkage=1
+"""
+        start = model.index("Tree=0")
+        end = model.index("end of trees")
+        model = model[:start] + block + "\n\n" + model[end:]
+        b = from_lightgbm_text(model)
+        np.testing.assert_allclose(
+            b.raw_margin(np.zeros((3, 2)))[:, 0], [7.5, 7.5, 7.5]
+        )
+
+    @pytest.mark.parametrize("mutation,err", [
+        (("num_cat=0", "num_cat=1"), "categorical"),
+        (("decision_type=10 8", "decision_type=10 5"), "categorical"),
+        (("decision_type=10 8", "decision_type=10 6"), "zero_as_missing"),
+        (("is_linear=0", "is_linear=1"), "linear"),
+    ])
+    def test_unsupported_features_raise(self, mutation, err):
+        with pytest.raises(ValueError, match=err):
+            from_lightgbm_text(self.MODEL.replace(*mutation))
+
+
+class TestAgainstRealLightGBM:
+    """Bidirectional interop with the actual LightGBM runtime (skipped when
+    the package is absent — the driver image has no pip lightgbm)."""
+
+    def test_their_model_scores_identically_here(self):
+        lgb = pytest.importorskip("lightgbm")
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(2000, 8))
+        y = ((X[:, 0] + X[:, 1] * X[:, 2]) > 0).astype(int)
+        m = lgb.LGBMClassifier(n_estimators=10, num_leaves=15).fit(X, y)
+        s = m.booster_.model_to_string()
+        b = from_lightgbm_text(s)
+        theirs = m.booster_.predict(X[:200], raw_score=True)
+        ours = b.raw_margin(X[:200])[:, 0]
+        np.testing.assert_allclose(ours, theirs, rtol=1e-5, atol=1e-6)
+
+    def test_our_model_scores_identically_there(self):
+        lgb = pytest.importorskip("lightgbm")
+        b, X = _fit("binary")
+        their_booster = lgb.Booster(model_str=to_lightgbm_text(b))
+        theirs = their_booster.predict(X[:200], raw_score=True)
+        ours = b.raw_margin(X[:200])[:, 0]
+        np.testing.assert_allclose(theirs, ours, rtol=1e-5, atol=1e-6)
