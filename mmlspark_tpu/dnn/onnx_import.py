@@ -250,8 +250,13 @@ def from_onnx(path_or_bytes) -> Tuple[Callable, Dict[str, Any]]:
                 axes = attrs.get("axes")
                 if axes is None and len(ins) > 1:
                     axes = [int(v) for v in np.asarray(ins[1]).tolist()]
+                # ONNX axes refer to the OUTPUT rank: normalize negatives
+                # against it before applying in ascending order (a raw sort
+                # would apply negatives against the not-yet-expanded rank and
+                # misplace dims for mixed lists like [-3, 1] on 1-D input).
+                out_rank = jnp.ndim(ins[0]) + len(axes)
                 out = ins[0]
-                for ax in sorted(axes):
+                for ax in sorted(a % out_rank for a in axes):
                     out = jnp.expand_dims(out, ax)
             elif op == "Clip":
                 lo = ins[1] if len(ins) > 1 and ins[1] is not None else attrs.get("min")

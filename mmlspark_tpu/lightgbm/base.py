@@ -197,6 +197,19 @@ class LightGBMBase(LightGBMParams, Estimator):
             init = np.asarray(table.column(self.getInitScoreCol()), dtype=np.float64)
         return X, y, w, init
 
+    def set_delegate(self, *callbacks) -> "LightGBMBase":
+        """Attach training delegates
+        (:class:`~mmlspark_tpu.lightgbm.callbacks.TrainingCallback`) — the
+        ``LightGBMDelegate.scala`` hook surface. Delegates are live objects,
+        not Params: they do not serialize with the stage (matching the
+        reference, whose delegate is a transient field)."""
+        self._callbacks = list(callbacks)
+        return self
+
+    @property
+    def callbacks(self):
+        return list(getattr(self, "_callbacks", []))
+
     def _fit(self, table: Table) -> "LightGBMModelBase":
         # Validation split by indicator column (LightGBMBase.scala:196-197).
         valid_table = None
@@ -241,7 +254,7 @@ class LightGBMBase(LightGBMParams, Estimator):
             result = train(
                 bins, y, opts, w=w, init_margins=init_margins,
                 valid_sets=valid_sets, mapper=mapper, mesh=mesh,
-                feature_names=feature_names,
+                feature_names=feature_names, callbacks=self.callbacks,
             )
         model = self._make_model(result)
         model.parent = self

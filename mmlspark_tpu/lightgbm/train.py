@@ -168,11 +168,14 @@ def _split_search(
     edges: jax.Array,  # (F, E)
     feature_mask: jax.Array,  # (F,)
     opts: TrainOptions,
+    lr=None,  # traced per-iteration learning rate (dynamic-LR callbacks)
 ) -> SplitSearch:
     """Best split per node from its histogram — the split-finding core the
     native library runs per leaf (``TrainUtils.scala:220-315`` inner loop)."""
     k, f, b, _ = hist.shape
-    l1, l2, lr = opts.lambda_l1, opts.lambda_l2, opts.learning_rate
+    l1, l2 = opts.lambda_l1, opts.lambda_l2
+    if lr is None:
+        lr = opts.learning_rate
 
     g_tot, h_tot, c_tot = totals[:, 0], totals[:, 1], totals[:, 2]
 
@@ -280,6 +283,7 @@ def _build_tree_depthwise(
     num_bins: int,
     opts: TrainOptions,
     histf,
+    lr=None,
 ) -> TreeArrays:
     n, f = bins.shape
     b = num_bins
@@ -298,7 +302,7 @@ def _build_tree_depthwise(
         local = node - offset
         hist, totals = histf(bins, grad, hess, count, local, k, b, feature_mask=feature_mask)
         # (k, F, B, 3) — row-sum: XLA all-reduces across data shards here.
-        s = _split_search(hist, totals, edges, feature_mask, opts)
+        s = _split_search(hist, totals, edges, feature_mask, opts, lr=lr)
 
         can_split = alive & jnp.isfinite(s.gain) & (s.gain > opts.min_gain_to_split)
         value_cur = jnp.where(alive, s.value, inherited)
@@ -373,6 +377,7 @@ def _build_tree_leafwise(
     num_bins: int,
     opts: TrainOptions,
     histf,
+    lr=None,
 ) -> TreeArrays:
     """Best-first growth, ``leaf_batch`` frontier leaves per histogram pass.
 
@@ -416,7 +421,7 @@ def _build_tree_leafwise(
         NaN gains (0/0 under zero-regularization params) are sanitized to
         -inf at write time so one poisoned candidate can neither halt the
         whole build through cond's max nor win an argmax."""
-        s = _split_search(histk, totalsk, edges, feature_mask, opts)
+        s = _split_search(histk, totalsk, edges, feature_mask, opts, lr=lr)
         capped = jnp.where(depthk >= max_depth, -jnp.inf, s.gain)
         capped = jnp.where(jnp.isnan(capped), -jnp.inf, capped)
         return s._replace(gain=capped)
@@ -425,7 +430,7 @@ def _build_tree_leafwise(
     root_hist, root_tot = histf(
         bins, grad, hess, count, jnp.zeros(n, jnp.int32), 1, b, feature_mask=feature_mask
     )
-    root = _split_search(root_hist, root_tot, edges, feature_mask, opts)
+    root = _split_search(root_hist, root_tot, edges, feature_mask, opts, lr=lr)
 
     def at0(template, s_):
         return template.at[0].set(s_[0])
@@ -618,7 +623,10 @@ def _route_binned(
     return node
 
 
-def _make_step(opts: TrainOptions, objective: Objective, num_bins: int, mesh=None):
+def _make_step(
+    opts: TrainOptions, objective: Objective, num_bins: int, mesh=None,
+    n_real: Optional[int] = None,
+):
     build = (
         _build_tree_leafwise if opts.growth == "leafwise" else _build_tree_depthwise
     )
@@ -629,7 +637,7 @@ def _make_step(opts: TrainOptions, objective: Objective, num_bins: int, mesh=Non
         "tweedie_variance_power": opts.tweedie_variance_power,
     }
 
-    def step(bins, y, w, margins, edges, bag_mask, feature_mask, it):
+    def step(bins, y, w, margins, edges, bag_mask, feature_mask, it, lr=None):
         grad, hess = objective.grad_hess(margins, y, w, **obj_kwargs)  # (N, C)
 
         if opts.boosting_type == "goss":
@@ -637,11 +645,15 @@ def _make_step(opts: TrainOptions, objective: Objective, num_bins: int, mesh=Non
             # rows by |gradient|, sample other_rate of the rest, and amplify
             # the sampled small-gradient rows by (1-a)/b so histogram sums
             # stay unbiased (the GOSS estimator from the LightGBM paper).
+            # Exactly n_top rows are kept (top_k index selection, ties broken
+            # by lower row index — LightGBM's own sort-based top-N), and
+            # n_top is computed from the UNPADDED row count so mesh padding
+            # never inflates the kept fraction.
             n_rows = grad.shape[0]
             gabs = jnp.abs(grad).sum(axis=1) * bag_mask
-            n_top = max(1, int(round(n_rows * opts.top_rate)))
-            thresh = lax.top_k(gabs, n_top)[0][-1]
-            top = gabs >= thresh
+            n_top = max(1, int(round((n_real or n_rows) * opts.top_rate)))
+            _, top_idx = lax.top_k(gabs, n_top)
+            top = jnp.zeros(n_rows, bool).at[top_idx].set(True)
             key = jax.random.fold_in(jax.random.PRNGKey(opts.seed), it)
             p = opts.other_rate / max(1e-12, 1.0 - opts.top_rate)
             sampled = (~top) & (jax.random.uniform(key, (n_rows,)) < p)
@@ -656,7 +668,7 @@ def _make_step(opts: TrainOptions, objective: Objective, num_bins: int, mesh=Non
         def per_class(g, h):
             return build(
                 bins, g, h, count, edges, feature_mask,
-                num_bins=num_bins, opts=opts, histf=histf,
+                num_bins=num_bins, opts=opts, histf=histf, lr=lr,
             )
 
         tree = jax.vmap(per_class, in_axes=(1, 1))(grad, hess)  # (C, ...) arrays
@@ -700,7 +712,7 @@ def _opts_key(opts: "TrainOptions"):
     return dataclasses.astuple(opts)
 
 
-def _make_scan_steps(step, per_iter_bag: bool):
+def _make_scan_steps(step, per_iter_bag: bool, per_iter_lr: bool = False):
     """All boosting iterations in ONE device program: ``lax.scan`` over the
     per-tree step, per-iteration bagging/feature masks as scanned inputs,
     stacked tree arrays as the scan output. One dispatch and one bulk fetch
@@ -709,23 +721,30 @@ def _make_scan_steps(step, per_iter_bag: bool):
 
     When bagging never resamples (``per_iter_bag=False``) the single (N,)
     mask is closed over inside the program rather than scanned, so no
-    (iterations, N) buffer is ever materialized."""
+    (iterations, N) buffer is ever materialized. A dynamic learning-rate
+    schedule (``per_iter_lr``) rides as one more scanned (iterations,)
+    input — schedule callbacks keep the one-dispatch fast path."""
 
-    def run(bins, y, w, margins, edges, bag, fm_all):
+    def run(bins, y, w, margins, edges, bag, fm_all, lr_all):
         iters = fm_all.shape[0]
 
         def body(m, per_iter):
-            if per_iter_bag:
-                it, bag_i, fmv = per_iter
-            else:
-                it, fmv = per_iter
-                bag_i = bag
-            tree, m2 = step(bins, y, w, m, edges, bag_i.astype(jnp.float32), fmv, it)
+            it, fmv = per_iter[0], per_iter[-1 if not per_iter_lr else -2]
+            bag_i = per_iter[1] if per_iter_bag else bag
+            lr_i = per_iter[-1] if per_iter_lr else None
+            tree, m2 = step(
+                bins, y, w, m, edges, bag_i.astype(jnp.float32), fmv, it, lr_i
+            )
             return m2, tree._replace(row_leaf=jnp.zeros((), jnp.int32))
 
         idx = jnp.arange(iters, dtype=jnp.int32)
-        xs = (idx, bag, fm_all) if per_iter_bag else (idx, fm_all)
-        margins_out, trees = lax.scan(body, margins, xs)
+        xs = [idx]
+        if per_iter_bag:
+            xs.append(bag)
+        xs.append(fm_all)
+        if per_iter_lr:
+            xs.append(lr_all)
+        margins_out, trees = lax.scan(body, margins, tuple(xs))
         return margins_out, trees
 
     return jax.jit(run, donate_argnums=(3,))
@@ -809,8 +828,13 @@ def train(
     mapper: Optional[BinMapper] = None,
     mesh: Optional[Any] = None,
     feature_names: Optional[List[str]] = None,
+    callbacks: Optional[Sequence[Any]] = None,
 ) -> TrainResult:
-    """Run boosting. ``valid_sets`` entries are (name, bins_v, y_v, w_v)."""
+    """Run boosting. ``valid_sets`` entries are (name, bins_v, y_v, w_v).
+
+    ``callbacks`` are :class:`~mmlspark_tpu.lightgbm.callbacks.TrainingCallback`
+    delegates (``LightGBMDelegate.scala`` analogue): LR schedules ride the
+    scan fast path; per-iteration hooks run on the loop path."""
     # Boosting-type contracts (matching native LightGBM's own errors):
     if opts.boosting_type == "rf":
         if not (opts.bagging_fraction < 1.0 and opts.bagging_freq > 0):
@@ -934,8 +958,11 @@ def train(
         margins = put_rows(margins0.astype(np.float32))
 
     okey = (_opts_key(opts), num_bins, mesh)
+    if opts.boosting_type == "goss":
+        okey = okey + (n,)  # GOSS bakes the unpadded row count into the program
     step_raw = _cached_program(
-        ("step_raw", okey), lambda: _make_step(opts, objective, num_bins, mesh)
+        ("step_raw", okey),
+        lambda: _make_step(opts, objective, num_bins, mesh, n_real=n),
     )
     step = _cached_program(
         ("step_jit", okey), lambda: jax.jit(step_raw, donate_argnums=(3,))
@@ -970,6 +997,27 @@ def train(
     num_bag = max(1, int(round(n * opts.bagging_fraction)))
     num_feat = max(1, int(round(f * opts.feature_fraction)))
 
+    from mmlspark_tpu.lightgbm.callbacks import (
+        CallbackEnv,
+        _has_iteration_hooks,
+        _lr_schedule,
+    )
+
+    callbacks = list(callbacks or [])
+    lr_all = _lr_schedule(callbacks, opts.learning_rate, opts.num_iterations)
+    iteration_hooks = _has_iteration_hooks(callbacks)
+
+    def _cb_env(it: int) -> "CallbackEnv":
+        lr_it = float(lr_all[it]) if (lr_all is not None and it < len(lr_all)) \
+            else opts.learning_rate
+        return CallbackEnv(
+            iteration=it, num_iterations=opts.num_iterations,
+            learning_rate=lr_it, evals=evals,
+        )
+
+    for cb in callbacks:
+        cb.before_training(_cb_env(0))
+
     trees: List[TreeArrays] = []
     best_score = -np.inf if higher_better else np.inf
     best_iter = 0
@@ -995,13 +1043,22 @@ def train(
     # feature sampling, rng stream order) are identical.
     stacked_trees = None
     schedule = _mask_schedule(opts, rng, n, pad, num_bag, num_feat, f, presence)
+    bag_resampling = opts.bagging_fraction < 1.0 and opts.bagging_freq > 0
+    # The scan path materializes an (iterations, N) uint8 bagging-mask array
+    # on device when bagging resamples; gate it so a huge fit (e.g. 10M rows
+    # x 1000 iters = 10 GB) falls back to the loop path, which re-uploads
+    # only on resample.
+    bag_stack_ok = (
+        not bag_resampling or opts.num_iterations * (n + pad) <= (512 << 20)
+    )
     if (
         mesh is None
         and not valid_state
+        and not iteration_hooks  # per-iteration delegates need the loop path
+        and bag_stack_ok
         and opts.num_iterations > 0
         and opts.boosting_type != "dart"  # dart drops trees per host decision
     ):
-        bag_resampling = opts.bagging_fraction < 1.0 and opts.bagging_freq > 0
         bag_list, fm_list = [], []
         for bag_np, _, fm_np in schedule:
             bag_list.append(bag_np)
@@ -1013,12 +1070,16 @@ def train(
         else:
             bag_arg = bag_dev  # (N,) closed over inside the program
         fm_all = jnp.asarray(np.stack(fm_list))
+        per_iter_lr = lr_all is not None
+        lr_arg = jnp.asarray(lr_all) if per_iter_lr else fm_all  # unused placeholder
         runner = _cached_program(
-            ("scan", okey, bag_resampling),
-            lambda: _make_scan_steps(step_raw, per_iter_bag=bag_resampling),
+            ("scan", okey, bag_resampling, per_iter_lr),
+            lambda: _make_scan_steps(
+                step_raw, per_iter_bag=bag_resampling, per_iter_lr=per_iter_lr
+            ),
         )
         margins, stacked_trees = runner(
-            bins_dev, y_dev, w_dev, margins, edges_dev, bag_arg, fm_all
+            bins_dev, y_dev, w_dev, margins, edges_dev, bag_arg, fm_all, lr_arg
         )
     else:
         dart_rng = np.random.default_rng(opts.seed + 7919)
@@ -1036,6 +1097,13 @@ def train(
             if bag_changed:
                 bag_dev = put_rows(bag_np)
             fm_dev = put_rep(fm_np) if fm_np is not None else fm_ones_dev
+            for cb in callbacks:
+                cb.before_iteration(_cb_env(it))
+            # traced scalar (not a baked constant) so per-iteration LR values
+            # don't each recompile the step program
+            lr_it = jnp.float32(
+                lr_all[it] if lr_all is not None else opts.learning_rate
+            )
 
             # dart: drop a random subset of existing trees from the margins
             # the new tree fits against (each with prob drop_rate), then
@@ -1056,7 +1124,7 @@ def train(
 
             tree, new_margins = step(
                 bins_dev, y_dev, w_dev, margins_in, edges_dev, bag_dev, fm_dev,
-                jnp.int32(it),
+                jnp.int32(it), lr_it,
             )
 
             if dropped:
@@ -1110,10 +1178,19 @@ def train(
                 delta = (score - best_score) if higher_better else (best_score - score)
                 if delta > opts.improvement_tolerance:
                     best_score, best_iter, improved_any = score, it + 1, True
+            stop_requested = False
+            for cb in callbacks:
+                if cb.after_iteration(_cb_env(it)):
+                    stop_requested = True
+            if stop_requested:
+                break
             if valid_state and opts.early_stopping_round > 0:
                 stale = 0 if improved_any else stale + 1
                 if stale >= opts.early_stopping_round:
                     break
+
+    for cb in callbacks:
+        cb.after_training(_cb_env(max(0, len(trees) - 1)))
 
     if opts.verbosity >= 1:
         import logging as _logging

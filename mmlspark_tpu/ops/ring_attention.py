@@ -107,16 +107,20 @@ def ring_attention(
 
         perm = [(i, (i + 1) % p) for i in range(p)]  # ring: pass K/V right
 
+        # Iteration 0 (the local block) is peeled out of the loop so the
+        # ppermute inside the loop body is UNCONDITIONAL: a collective under
+        # value-dependent control flow is fragile across XLA backends even
+        # with a replicated predicate (deadlock if the pattern is ever
+        # disturbed). p-1 rotations total, no discarded final permute.
+        acc, row_max, denom = _block_attend(
+            q_l, k_l, v_l, q_pos, idx * s_local + jnp.arange(s_local),
+            acc, row_max, denom, causal, scale_val,
+        )
+
         def step(i, carry):
             k_blk, v_blk, acc, row_max, denom = carry
-            # rotate BEFORE attending for i > 0 — p-1 rotations total, no
-            # discarded final permute
-            k_blk, v_blk = lax.cond(
-                i > 0,
-                lambda kv: tuple(lax.ppermute(x, AXIS_SEQ, perm) for x in kv),
-                lambda kv: kv,
-                (k_blk, v_blk),
-            )
+            k_blk = lax.ppermute(k_blk, AXIS_SEQ, perm)
+            v_blk = lax.ppermute(v_blk, AXIS_SEQ, perm)
             # the block we hold at ring step i originated at (idx - i) mod p
             src = (idx - i) % p
             k_pos = src * s_local + jnp.arange(s_local)
@@ -127,7 +131,7 @@ def ring_attention(
             return k_blk, v_blk, acc, row_max, denom
 
         _, _, acc, row_max, denom = lax.fori_loop(
-            0, p, step, (k_l, v_l, acc, row_max, denom)
+            1, p, step, (k_l, v_l, acc, row_max, denom)
         )
         # rows with zero visible keys (can't happen causally: self is visible)
         return acc / jnp.maximum(denom, 1e-30)[..., None]

@@ -1,0 +1,191 @@
+"""Training delegate/callback hooks (``LightGBMDelegate.scala``; dynamic LR
+per ``TrainUtils.scala:211-218`` and ``VerifyLightGBMClassifier.scala:394``)."""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.data.table import Table
+from mmlspark_tpu.lightgbm.binning import bin_dataset
+from mmlspark_tpu.lightgbm.callbacks import (
+    CallbackEnv,
+    LearningRateSchedule,
+    TrainingCallback,
+)
+from mmlspark_tpu.lightgbm.classifier import LightGBMClassifier
+from mmlspark_tpu.lightgbm.train import TrainOptions, train
+
+
+def _data(n=800, f=6, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f))
+    y = ((X[:, 0] + X[:, 1] * X[:, 2]) > 0).astype(np.float64)
+    return X, y
+
+
+def _opts(**kw):
+    base = dict(objective="binary", num_iterations=6, num_leaves=7, max_bin=31)
+    base.update(kw)
+    return TrainOptions(**base)
+
+
+class TestLearningRateSchedule:
+    def test_decayed_lr_equals_retrained_constant_lr_per_tree(self):
+        """A constant schedule must reproduce the plain fit exactly (the
+        schedule rides the scan fast path as data, not as a new program)."""
+        X, y = _data()
+        bins, mapper = bin_dataset(X, max_bin=31)
+        r_plain = train(bins, y, _opts(), mapper=mapper)
+        r_sched = train(
+            bins, y, _opts(), mapper=mapper,
+            callbacks=[LearningRateSchedule(lambda it: 0.1)],
+        )
+        np.testing.assert_allclose(
+            r_sched.booster.leaf_values, r_plain.booster.leaf_values, rtol=1e-6
+        )
+
+    def test_decay_changes_later_trees_only(self):
+        """Iteration 0 trains identically under lr(0)=0.1; the decayed rates
+        reshape subsequent trees."""
+        X, y = _data()
+        bins, mapper = bin_dataset(X, max_bin=31)
+        r_plain = train(bins, y, _opts(), mapper=mapper)
+        r_decay = train(
+            bins, y, _opts(), mapper=mapper,
+            callbacks=[LearningRateSchedule(lambda it: 0.1 * (0.5 ** it))],
+        )
+        np.testing.assert_allclose(
+            r_decay.booster.leaf_values[0], r_plain.booster.leaf_values[0], rtol=1e-6
+        )
+        assert not np.allclose(
+            r_decay.booster.leaf_values[1], r_plain.booster.leaf_values[1]
+        )
+
+    def test_list_schedule_and_scaling(self):
+        """lr=0.2 throughout == leaf values exactly 2x the lr=0.1 first tree
+        (leaf value is linear in lr)."""
+        X, y = _data()
+        bins, mapper = bin_dataset(X, max_bin=31)
+        r1 = train(bins, y, _opts(num_iterations=1), mapper=mapper)
+        r2 = train(
+            bins, y, _opts(num_iterations=1), mapper=mapper,
+            callbacks=[LearningRateSchedule([0.2])],
+        )
+        np.testing.assert_allclose(
+            r2.booster.leaf_values, r1.booster.leaf_values * 2.0, rtol=1e-5
+        )
+
+
+class TestIterationHooks:
+    def test_hooks_fire_in_order_with_env(self):
+        X, y = _data()
+        bins, mapper = bin_dataset(X, max_bin=31)
+        log = []
+
+        class Recorder(TrainingCallback):
+            def before_training(self, env):
+                log.append(("before_training", env.iteration))
+
+            def before_iteration(self, env):
+                log.append(("before", env.iteration))
+
+            def after_iteration(self, env):
+                log.append(("after", env.iteration))
+                return None
+
+            def after_training(self, env):
+                log.append(("after_training", env.iteration))
+
+        train(bins, y, _opts(num_iterations=3), mapper=mapper, callbacks=[Recorder()])
+        assert log[0] == ("before_training", 0)
+        assert log[-1] == ("after_training", 2)
+        inner = log[1:-1]
+        assert inner == [
+            ("before", 0), ("after", 0),
+            ("before", 1), ("after", 1),
+            ("before", 2), ("after", 2),
+        ]
+
+    def test_after_iteration_stop_truncates_training(self):
+        X, y = _data()
+        bins, mapper = bin_dataset(X, max_bin=31)
+
+        class StopAt2(TrainingCallback):
+            def after_iteration(self, env):
+                return env.iteration >= 1  # stop after the 2nd tree
+
+        r = train(bins, y, _opts(num_iterations=10), mapper=mapper,
+                  callbacks=[StopAt2()])
+        assert r.booster.num_trees == 2
+
+    def test_delegate_stop_composes_with_metric_early_stopping(self):
+        """Dynamic-LR delegate + metric early stopping together — the
+        VerifyLightGBMClassifier.scala:394 interaction. The delegate's LR
+        decay must not break the metric early-stop bookkeeping."""
+        X, y = _data(n=1200)
+        bins, mapper = bin_dataset(X, max_bin=31)
+        vb, _ = bin_dataset(X[:300], mapper=mapper)
+
+        seen = []
+
+        class Spy(TrainingCallback):
+            def get_learning_rate(self, it):
+                return 0.3 * (0.8 ** it)
+
+            def after_iteration(self, env):
+                seen.append(env.evals["v"]["auc"][-1])
+                return None
+
+        r = train(
+            bins, y, _opts(num_iterations=40, early_stopping_round=3),
+            mapper=mapper,
+            valid_sets=[("v", vb, y[:300], None)],
+            callbacks=[Spy()],
+        )
+        # the callback saw every recorded eval, and early stopping engaged
+        assert seen == r.evals["v"]["auc"]
+        assert r.booster.num_trees <= 40
+
+
+class TestEstimatorSurface:
+    def test_set_delegate_threads_into_fit(self):
+        X, y = _data(n=400)
+        t = Table({
+            "features": list(X.astype(np.float64)),
+            "label": y,
+        })
+        hits = []
+
+        class Hook(TrainingCallback):
+            def after_iteration(self, env):
+                hits.append(env.iteration)
+                return None
+
+        clf = LightGBMClassifier(numIterations=3, numLeaves=7).set_delegate(Hook())
+        clf.fit(t)
+        assert hits == [0, 1, 2]
+
+    def test_delegates_do_not_serialize(self, tmp_path):
+        X, y = _data(n=300)
+        t = Table({"features": list(X.astype(np.float64)), "label": y})
+        clf = LightGBMClassifier(numIterations=2).set_delegate(TrainingCallback())
+        model = clf.fit(t)
+        p = str(tmp_path / "m")
+        model.save(p)  # must not try to serialize the live delegate
+        type(model).load(p)
+
+
+def test_lr_schedule_with_bagging_scan_layout():
+    """Bagging masks + LR schedule ride the scan together (4-tuple xs
+    layout); a constant schedule must still reproduce the plain bagged fit
+    exactly."""
+    X, y = _data()
+    bins, mapper = bin_dataset(X, max_bin=31)
+    kw = dict(bagging_fraction=0.7, bagging_freq=1, seed=3)
+    r_plain = train(bins, y, _opts(**kw), mapper=mapper)
+    r_sched = train(
+        bins, y, _opts(**kw), mapper=mapper,
+        callbacks=[LearningRateSchedule(lambda it: 0.1)],
+    )
+    np.testing.assert_allclose(
+        r_sched.booster.leaf_values, r_plain.booster.leaf_values, rtol=1e-6
+    )

@@ -237,3 +237,24 @@ def test_multi_output_node_raises():
     fn, params = from_onnx(buf)
     with pytest.raises(NotImplementedError, match="2 outputs"):
         fn(params, {"x": np.zeros((1, 1, 4, 4), np.float32)})
+
+
+def test_unsqueeze_mixed_negative_axes():
+    """ONNX Unsqueeze axes refer to the OUTPUT rank: axes=[-3, 1] on a 1-D
+    input must produce shape (1, 1, S) like numpy's expand_dims on the
+    normalized axes, not raise or misplace dims."""
+    from mmlspark_tpu.dnn.onnx_proto import encode_model, encode_node
+
+    for axes, in_shape, want in [
+        ([-3, 1], (5,), (1, 1, 5)),
+        ([0, -1], (5,), (1, 5, 1)),
+        ([1], (2, 3), (2, 1, 3)),
+        ([-1], (2, 3), (2, 3, 1)),
+    ]:
+        buf = encode_model(
+            [encode_node("Unsqueeze", ["x"], ["y"], attrs={"axes": axes})], {}, ["x"], ["y"]
+        )
+        fn, params = from_onnx(buf)
+        x = np.zeros(in_shape, np.float32)
+        out = np.asarray(fn(params, {"x": x})["y"])
+        assert out.shape == want, (axes, out.shape, want)
