@@ -144,3 +144,93 @@ def test_expert_count_mismatch_raises():
     gates = jnp.asarray(rng.normal(size=(8, 8)), jnp.float32)
     with pytest.raises(ValueError, match="one expert per device"):
         moe_apply(_expert_fn, (ws, bs), x, gates, mesh)
+
+
+class TestDNNModelConsumers:
+    """The pipe/expert ops behind the PUBLIC DNNModel API — a user-facing
+    transform engages the axes, not just the raw ops."""
+
+    def test_pipeline_mode_through_dnnmodel(self):
+        from mmlspark_tpu.data.table import Table
+        from mmlspark_tpu.dnn import DNNModel
+
+        rng = np.random.default_rng(0)
+        d, n, p = 8, 24, 4
+        params = _stack_params(rng, p, d)
+        X = rng.normal(size=(n, d)).astype(np.float32)
+
+        out = DNNModel(
+            pipelineStageFn=_stage_fn,
+            modelParams=params,
+            feedDict={"x": "f"},
+            fetchDict={"y": "output"},
+            batchSize=8,
+            numMicrobatches=2,
+            meshConfig=MeshConfig(data=2, pipe=p),
+        ).transform(Table({"f": X}))
+
+        want = np.asarray(_sequential(params, jnp.asarray(X)))
+        np.testing.assert_allclose(out.column("y"), want, rtol=2e-4, atol=2e-5)
+
+    def test_moe_mode_through_dnnmodel(self):
+        from mmlspark_tpu.data.table import Table
+        from mmlspark_tpu.dnn import DNNModel
+
+        rng = np.random.default_rng(1)
+        d, n, e = 8, 30, 8
+        experts = (
+            jnp.asarray(rng.normal(size=(e, d, d)) * 0.5, jnp.float32),
+            jnp.asarray(rng.normal(size=(e, d)) * 0.1, jnp.float32),
+        )
+        gate = jnp.asarray(rng.normal(size=(d, e)), jnp.float32)
+        X = rng.normal(size=(n, d)).astype(np.float32)
+
+        def expert_fn(params, x):
+            w, b = params
+            return jnp.tanh(x @ w + b)
+
+        out = DNNModel(
+            expertFn=expert_fn,
+            modelParams={"experts": experts, "gate": gate},
+            feedDict={"x": "f"},
+            fetchDict={"y": "output"},
+            batchSize=10,
+            meshConfig=MeshConfig(data=1, expert=e),
+        ).transform(Table({"f": X}))
+
+        # reference: dense per-token top-1 expert
+        logits = X @ np.asarray(gate)
+        probs = np.exp(logits - logits.max(axis=1, keepdims=True))
+        probs /= probs.sum(axis=1, keepdims=True)
+        assign = logits.argmax(axis=1)
+        want = np.zeros_like(X)
+        for i in range(n):
+            w_, b_ = np.asarray(experts[0][assign[i]]), np.asarray(experts[1][assign[i]])
+            want[i] = np.tanh(X[i] @ w_ + b_) * probs[i, assign[i]]
+        np.testing.assert_allclose(out.column("y"), want, rtol=2e-4, atol=2e-5)
+
+    def test_mode_exclusivity_raises(self):
+        from mmlspark_tpu.dnn import DNNModel
+
+        with pytest.raises(ValueError, match="exactly one of"):
+            DNNModel(
+                applyFn=lambda p, i: i,
+                pipelineStageFn=_stage_fn,
+                feedDict={"x": "f"},
+                fetchDict={"y": "output"},
+            )._jitted()
+        with pytest.raises(ValueError, match="exactly one of"):
+            DNNModel(feedDict={"x": "f"}, fetchDict={"y": "output"})._jitted()
+
+    def test_moe_params_shape_validated(self):
+        from mmlspark_tpu.dnn import DNNModel
+
+        m = DNNModel(
+            expertFn=lambda p, x: x,
+            modelParams={"gate": np.zeros((4, 2))},  # missing 'experts'
+            feedDict={"x": "f"},
+            fetchDict={"y": "output"},
+        )
+        _, _, place = m._jitted()
+        with pytest.raises(ValueError, match="experts"):
+            place(m.getModelParams())
