@@ -21,26 +21,43 @@ def _auc(y, score):
     return float(auc(np.asarray(y), np.asarray(score), np.ones(len(y))))
 
 
+def _split(X, y, seed=0, frac=0.8):
+    rng = np.random.default_rng(seed)
+    perm = rng.permutation(len(y))
+    X, y = X[perm], np.asarray(y, dtype=np.float64)[perm]
+    n = int(frac * len(y))
+    return Table({"features": X[:n], "label": y[:n]}), (X[n:], y[n:])
+
+
 @pytest.fixture(scope="module")
 def datasets():
-    from sklearn.datasets import load_breast_cancer, load_diabetes
+    from sklearn.datasets import (
+        load_breast_cancer,
+        load_diabetes,
+        load_digits,
+        load_wine,
+        make_friedman1,
+    )
 
-    rng = np.random.default_rng(0)
     bc = load_breast_cancer()
-    perm = rng.permutation(len(bc.target))
-    Xb, yb = bc.data[perm], bc.target[perm].astype(np.float64)
-    nb = int(0.8 * len(yb))
-
     db = load_diabetes()
-    perm2 = rng.permutation(len(db.target))
-    Xd, yd = db.data[perm2], db.target[perm2].astype(np.float64)
-    nd = int(0.8 * len(yd))
-    return {
-        "bc_train": Table({"features": Xb[:nb], "label": yb[:nb]}),
-        "bc_test": (Xb[nb:], yb[nb:]),
-        "db_train": Table({"features": Xd[:nd], "label": yd[:nd]}),
-        "db_test": (Xd[nd:], yd[nd:]),
-    }
+    wine = load_wine()  # 3-class
+    digits = load_digits()  # second classifier dataset: digit 0 vs rest
+    y_dig = (digits.target == 0).astype(np.float64)
+    Xf, yf = make_friedman1(  # second regressor dataset (locally generated)
+        n_samples=800, n_features=10, noise=1.0, random_state=0
+    )
+
+    out = {}
+    for name, X, y, seed in (
+        ("bc", bc.data, bc.target, 0),
+        ("db", db.data, db.target, 0),
+        ("wine", wine.data, wine.target, 1),
+        ("digits", digits.data, y_dig, 2),
+        ("friedman", Xf, yf, 3),
+    ):
+        out[f"{name}_train"], out[f"{name}_test"] = _split(X, y, seed)
+    return out
 
 
 def test_golden_metrics(datasets):
@@ -92,7 +109,94 @@ def test_golden_metrics(datasets):
     acc = float((tout.column("prediction") == yt).mean())
     suite.add("breast_cancer_trainclassifier_acc", acc, 0.03)
 
+    # Second dataset per family, mirroring the reference's multi-dataset
+    # golden matrix (benchmarks_VerifyLightGBMClassifier.csv spans 8).
+    Xg, yg = datasets["digits_test"]
+    dclf = LightGBMClassifier(
+        numIterations=30, numLeaves=15, seed=0, parallelism="serial"
+    ).fit(datasets["digits_train"])
+    suite.add(
+        "digits_zero_gbdt_auc", _auc(yg, dclf.booster.raw_margin(Xg)[:, 0]), 0.01
+    )
+
+    Xfr, yfr = datasets["friedman_test"]
+    freg = LightGBMRegressor(
+        numIterations=60, numLeaves=15, seed=0, parallelism="serial"
+    ).fit(datasets["friedman_train"])
+    frmse = float(np.sqrt(np.mean((freg.booster.raw_margin(Xfr)[:, 0] - yfr) ** 2)))
+    suite.add("friedman_gbdt_rmse", frmse, 0.5, higher_is_better=False)
+
+    fvw = VowpalWabbitRegressor(numPasses=8).fit(datasets["friedman_train"])
+    fvout = fvw.transform(Table({"features": Xfr, "label": yfr}))
+    fvrmse = float(np.sqrt(np.mean((fvout.column("prediction") - yfr) ** 2)))
+    suite.add("friedman_vw_rmse", fvrmse, 1.0, higher_is_better=False)
+
+    # Multiclass golden (wine, 3 classes)
+    Xw, yw = datasets["wine_test"]
+    wclf = LightGBMClassifier(
+        objective="multiclass", numIterations=30, numLeaves=7, seed=0,
+        parallelism="serial", minDataInLeaf=5,
+    ).fit(datasets["wine_train"])
+    wacc = float(
+        (wclf.booster.raw_margin(Xw).argmax(axis=1) == yw).mean()
+    )
+    suite.add("wine_multiclass_acc", wacc, 0.05)
+
     suite.verify(GOLDEN)
+
+
+def test_golden_ranker_ndcg():
+    """Ranker golden (the reference pins lambdarank metrics in its
+    benchmark CSVs; here ndcg@5 on a deterministic synthetic query set)."""
+    from mmlspark_tpu.lightgbm import LightGBMRanker
+    from mmlspark_tpu.lightgbm.ranker import ndcg_at_k
+
+    rng = np.random.default_rng(9)
+    q, per_group = 40, 12
+    n = q * per_group
+    X = rng.normal(size=(n, 5))
+    rel = np.clip((X[:, 0] + rng.normal(scale=0.4, size=n)) * 1.5 + 1.5, 0, 4).round()
+    group = np.repeat(np.arange(q), per_group)
+    t = Table({
+        "features": X, "label": rel.astype(np.float64),
+        "query": group.astype(np.int64),
+    })
+    model = LightGBMRanker(
+        numIterations=30, groupCol="query", minDataInLeaf=5, seed=0,
+        parallelism="serial",
+    ).fit(t)
+    score = ndcg_at_k(rel, model.transform(t)["prediction"], group, k=5)
+
+    suite = BenchmarkSuite("ranker_metrics")
+    suite.add("synthetic_ranker_ndcg5", float(score), 0.02)
+    suite.verify(os.path.join(os.path.dirname(GOLDEN), "golden_ranker.csv"))
+
+
+def test_golden_tune_hyperparameters(datasets):
+    """TuneHyperparameters golden (benchmarks_VerifyTuneHyperparameters.csv
+    analogue): the CV-best metric of a fixed sweep is pinned."""
+    from mmlspark_tpu.automl import TuneHyperparameters
+    from mmlspark_tpu.automl.hyperparam import (
+        DiscreteHyperParam,
+        DoubleRangeHyperParam,
+    )
+    from mmlspark_tpu.lightgbm import LightGBMClassifier
+
+    tuned = TuneHyperparameters(
+        models=LightGBMClassifier(numIterations=15, parallelism="serial"),
+        paramSpace={
+            "numLeaves": DiscreteHyperParam([7, 15]),
+            "learningRate": DoubleRangeHyperParam(0.05, 0.3),
+        },
+        evaluationMetric="accuracy",
+        numFolds=3,
+        numRuns=4,
+        seed=5,
+    ).fit(datasets["bc_train"])
+
+    suite = BenchmarkSuite("tune_metrics")
+    suite.add("breast_cancer_tune_best_acc", float(tuned.getBestMetric()), 0.03)
+    suite.verify(os.path.join(os.path.dirname(GOLDEN), "golden_tune.csv"))
 
 
 class TestHarness:
