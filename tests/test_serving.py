@@ -231,3 +231,23 @@ class TestDistributedV2:
                 s0 = services[0]
                 status, out = _post(f"http://{s0['host']}:{s0['port']}/", {"input": 7.0})
                 assert status == 200 and out["prediction"] == 14.0
+
+
+class TestConcurrentLoad:
+    def test_distributed_under_load_with_worker_death(self):
+        """The HTTPv2Suite.scala:315-387 shape: concurrent clients hammer
+        multiple listeners; one listener dies mid-stream and its clients
+        fail over to the surviving endpoints. Every request must succeed
+        with the correct answer and the latency distribution stays sane."""
+        import threading
+        import time as _time
+
+        from benchmarks.serving_latency import concurrent_load_latency
+
+        out = concurrent_load_latency(
+            num_servers=3, num_clients=8, reqs_per_client=15, kill_worker=True
+        )
+        assert out["requests"] == 8 * 15
+        assert out["errors"] == 0, out  # failover absorbed the worker death
+        assert out["failovers"] >= 1, out  # the death actually happened mid-stream
+        assert out["p50_ms"] < 250, out
