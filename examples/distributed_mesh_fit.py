@@ -1,0 +1,66 @@
+"""Example: distributed GBDT training over a device mesh.
+
+    python examples/distributed_mesh_fit.py
+
+Shards 100k rows over the mesh ``data`` axis (8 virtual CPU devices here;
+the same code runs one-device-per-chip on a TPU pod slice). The histogram
+build is a row-sum, so XLA inserts the cross-device allreduce — LightGBM's
+data_parallel socket allreduce expressed as sharding annotations. See
+docs/mesh_scaling.md for the measured scaling profile.
+"""
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    # Request 8 virtual CPU devices BEFORE jax initializes (on a real pod
+    # slice, skip this — jax.devices() already spans the slice).
+    from mmlspark_tpu.parallel.mesh import force_platform
+
+    force_platform("cpu", min_devices=8)
+
+    import jax
+
+    from mmlspark_tpu.data.table import Table
+    from mmlspark_tpu.lightgbm import LightGBMClassifier
+    from mmlspark_tpu.lightgbm.objectives import auc
+
+    rng = np.random.default_rng(0)
+    n, f = 100_000, 16
+    X = rng.normal(size=(n, f))
+    y = ((X[:, 0] + X[:, 1] * X[:, 2] + 0.4 * rng.normal(size=n)) > 0).astype(
+        np.float64
+    )
+    n_train = int(0.8 * n)
+    train_t = Table({"features": X[:n_train], "label": y[:n_train]})
+
+    print(f"devices: {len(jax.devices())} ({jax.default_backend()})")
+
+    # parallelism="data_parallel" (the default) builds the mesh over all
+    # devices; numTasks caps it (the reference's executor-count knob).
+    clf = LightGBMClassifier(numIterations=20, numLeaves=31, numTasks=8)
+    model = clf.fit(train_t)
+
+    margins = model.booster.raw_margin(X[n_train:])[:, 0]
+    a = auc(y[n_train:], margins, np.ones(n - n_train))
+    print(f"holdout AUC (8-way data-parallel fit): {a:.4f}")
+
+    # The same model scores identically regardless of the training layout.
+    serial = LightGBMClassifier(
+        numIterations=20, numLeaves=31, parallelism="serial"
+    ).fit(train_t)
+    a_serial = auc(
+        y[n_train:], serial.booster.raw_margin(X[n_train:])[:, 0],
+        np.ones(n - n_train),
+    )
+    print(f"holdout AUC (single-device fit):       {a_serial:.4f}")
+    assert abs(a - a_serial) < 5e-3
+
+
+if __name__ == "__main__":
+    main()

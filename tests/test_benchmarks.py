@@ -249,3 +249,13 @@ class TestHarness:
         better = BenchmarkSuite("s")
         better.add("auc", 0.99, 0.01)
         better.verify(str(golden))  # improvements never fail
+
+
+def test_api_reference_up_to_date():
+    """The generated API reference (docs/api/) must match the code — the
+    CI-validated codegen artifact (CodeGen.scala:15-48 analogue). Regenerate
+    with `python -m mmlspark_tpu.core.apigen` after changing any Param."""
+    from mmlspark_tpu.core.apigen import _default_out_dir, check
+
+    stale = check(_default_out_dir())
+    assert not stale, f"API reference drift, regenerate: {stale}"
