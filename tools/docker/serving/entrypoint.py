@@ -1,0 +1,75 @@
+"""Serving container entrypoint: load a saved pipeline/model, serve it.
+
+    python entrypoint.py --model /models/pipeline --port 8890 [--servers 2]
+
+``--model`` is a stage saved with ``.save()`` (PipelineModel or any
+transformer); requests POST ``{"<input-col>": value}`` to ``/`` and get the
+transformed row back. ``/healthz`` on the registry port reports liveness
+for the k8s probes (tools/helm).
+"""
+
+import argparse
+import signal
+import sys
+import threading
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", required=True, help="path saved via stage.save()")
+    ap.add_argument("--host", default="0.0.0.0", help="bind address")
+    ap.add_argument("--port", type=int, default=8890)
+    ap.add_argument("--registry-port", type=int, default=8891)
+    ap.add_argument("--servers", type=int, default=1, help="listener count")
+    ap.add_argument("--input-col", default="input")
+    ap.add_argument("--output-col", default="prediction")
+    ap.add_argument("--max-batch", type=int, default=64)
+    ap.add_argument("--max-latency-ms", type=float, default=2.0)
+    args = ap.parse_args()
+
+    from mmlspark_tpu.core.serialize import load_stage
+    from mmlspark_tpu.serving import (
+        DistributedServingServer,
+        RegistrationService,
+        ServingServer,
+    )
+
+    model = load_stage(args.model)
+    registry = RegistrationService(host=args.host, port=args.registry_port).start()
+    if args.servers > 1:
+        server = DistributedServingServer(
+            model,
+            num_servers=args.servers,
+            host=args.host,
+            registry=registry,
+            input_col=args.input_col,
+            output_col=args.output_col,
+            max_batch_size=args.max_batch,
+            max_latency_ms=args.max_latency_ms,
+        ).start()
+        urls = [i.url for i in server.service_info]
+    else:
+        server = ServingServer(
+            model,
+            host=args.host,
+            port=args.port,
+            input_col=args.input_col,
+            output_col=args.output_col,
+            max_batch_size=args.max_batch,
+            max_latency_ms=args.max_latency_ms,
+        ).start()
+        registry.register(server.info)
+        urls = [server.info.url]
+    print(f"serving {args.model} on {urls} (registry :{args.registry_port})", flush=True)
+
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+    stop.wait()
+    server.stop()
+    registry.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
