@@ -164,14 +164,17 @@ def main():
     tpu_secs, tpu_margins, booster = _fit_tpu(Xtr, ytr, Xte)
     tpu_tput = N_ROWS * N_ITERS / tpu_secs
     auc_tpu = _auc(yte, tpu_margins)
-    pred_tpu = _predict_throughput_tpu(booster, Xtr)
+    # throughput is per-row: cap the measurement batch so the one-dispatch
+    # (N, T, I) decision tensor stays in HBM at any BENCH_ROWS
+    pred_rows = min(N_ROWS, 400_000)
+    pred_tpu = _predict_throughput_tpu(booster, Xtr[:pred_rows])
 
     try:
         cpu_secs, cpu_margins, clf = _fit_cpu(Xtr, ytr, Xte)
         cpu_tput = N_ROWS * N_ITERS / cpu_secs
         auc_cpu = _auc(yte, cpu_margins)
         vs = tpu_tput / cpu_tput
-        pred_cpu = _predict_throughput_cpu(clf, Xtr)
+        pred_cpu = _predict_throughput_cpu(clf, Xtr[:pred_rows])
     except Exception as e:  # pragma: no cover
         print(f"cpu baseline failed: {e}", file=sys.stderr)
         cpu_secs, auc_cpu, vs, pred_cpu = 0.0, 0.0, 0.0, 0.0

@@ -1,0 +1,50 @@
+"""Example: long-context attention sharded over the mesh ``seq`` axis.
+
+    python examples/long_context_ring_attention.py
+
+Ring attention: a sequence longer than one device's memory budget shards
+over the ``seq`` axis; K/V blocks rotate around the ring via ppermute
+(nearest-neighbor ICI on real hardware) with online-softmax accumulation,
+so the full (S, S) score matrix never materializes. Verified here against
+the O(S^2) reference on an 8-virtual-device mesh.
+"""
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    from mmlspark_tpu.parallel.mesh import force_platform
+
+    force_platform("cpu", min_devices=8)
+
+    import jax.numpy as jnp
+
+    from mmlspark_tpu.ops.ring_attention import attention_reference, ring_attention
+    from mmlspark_tpu.parallel.mesh import MeshConfig, make_mesh
+
+    mesh = make_mesh(MeshConfig(data=1, seq=8))
+    rng = np.random.default_rng(0)
+    b, s, h, d = 2, 1024, 4, 32  # 128 positions per device
+    q, k, v = (
+        jnp.asarray(rng.normal(size=(b, s, h, d)), jnp.float32) for _ in range(3)
+    )
+
+    out_ring = ring_attention(q, k, v, mesh, causal=True)
+    out_ref = attention_reference(q, k, v, causal=True)
+    err = float(np.max(np.abs(np.asarray(out_ring) - np.asarray(out_ref))))
+    print(f"causal ring attention over seq=8: S={s}, max |err| vs O(S^2) ref = {err:.2e}")
+    assert err < 1e-4
+
+    # communication story: each device exchanges its (S/8, d) K/V block 7
+    # times — all nearest-neighbor hops, no all-gather of the sequence
+    per_hop = (s // 8) * h * d * 4 * 2
+    print(f"per-device per-hop K/V traffic: {per_hop/1024:.0f} KiB x 7 hops")
+
+
+if __name__ == "__main__":
+    main()
