@@ -1,8 +1,10 @@
 """Cognitive services on Table (reference ``cognitive/``, SURVEY.md §2.17)."""
 
 from mmlspark_tpu.cognitive import schemas
+from mmlspark_tpu.cognitive.audio import CompressedStream, WavStream
 from mmlspark_tpu.cognitive.base import CognitiveServicesBase, ServiceParam
 from mmlspark_tpu.cognitive.search import AddDocuments, SearchIndexClient
+from mmlspark_tpu.cognitive.speech_sdk import SpeechToTextSDK
 from mmlspark_tpu.cognitive.services import (
     NER,
     OCR,
@@ -49,5 +51,8 @@ __all__ = [
     "RecognizeText",
     "ServiceParam",
     "SpeechToText",
+    "SpeechToTextSDK",
+    "CompressedStream",
+    "WavStream",
     "TextSentiment",
 ]

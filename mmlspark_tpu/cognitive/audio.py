@@ -1,0 +1,121 @@
+"""Audio pull streams for streaming speech recognition.
+
+Re-design of the reference's ``cognitive/AudioStreams.scala:16-84``
+(``WavStream``/``CompressedStream`` — PullAudioInputStreamCallback
+adapters for the Speech SDK): a WAV header parser with the same strict
+contract (RIFF/WAVE, PCM format tag, mono, 16 kHz, 16-bit — the asserts
+mirror the Scala line for line) and a frame iterator that feeds the
+streaming transport in bounded chunks, so arbitrarily long audio never
+materializes in one buffer.
+"""
+
+from __future__ import annotations
+
+import io
+import struct
+from typing import Iterator, Union
+
+
+class WavStream:
+    """Pull stream over a WAV payload: validates the header, then yields the
+    PCM data in ``chunk_size``-byte frames (``WavStream.read``'s contract)."""
+
+    def __init__(self, data: Union[bytes, io.RawIOBase], chunk_size: int = 3200):
+        # 3200 bytes = 100 ms of 16 kHz mono 16-bit PCM (the SDK's cadence)
+        self._stream = io.BytesIO(data) if isinstance(data, (bytes, bytearray)) else data
+        self.chunk_size = int(chunk_size)
+        self._parse_wav_header()
+
+    # -- header ------------------------------------------------------------
+
+    def _read_exact(self, n: int) -> bytes:
+        buf = self._stream.read(n)
+        if buf is None or len(buf) != n:
+            raise ValueError("truncated WAV header")
+        return buf
+
+    def _uint32(self) -> int:
+        return struct.unpack("<I", self._read_exact(4))[0]
+
+    def _uint16(self) -> int:
+        return struct.unpack("<H", self._read_exact(2))[0]
+
+    def _parse_wav_header(self) -> None:
+        if self._read_exact(4) != b"RIFF":
+            raise ValueError("RIFF")
+        self._uint32()  # file length
+        if self._read_exact(4) != b"WAVE":
+            raise ValueError("WAVE")
+        if self._read_exact(4) != b"fmt ":
+            raise ValueError("fmt ")
+        format_size = self._uint32()
+        if format_size < 16:
+            raise ValueError("formatSize")
+        format_tag = self._uint16()
+        channels = self._uint16()
+        samples_per_sec = self._uint32()
+        self._uint32()  # avg bytes/sec
+        self._uint16()  # block align
+        bits_per_sample = self._uint16()
+        # the reference's exact contract (AudioStreams.scala:63-67)
+        if format_tag != 1:
+            raise ValueError("PCM")
+        if channels != 1:
+            raise ValueError("single channel")
+        if samples_per_sec != 16000:
+            raise ValueError("samples per second")
+        if bits_per_sample != 16:
+            raise ValueError("bits per sample")
+        if format_size > 16:
+            self._read_exact(format_size - 16)
+        if self._read_exact(4) != b"data":
+            raise ValueError("data")
+        self.data_length = self._uint32()
+
+    # -- pull interface ----------------------------------------------------
+
+    def read(self, n: int) -> bytes:
+        """One frame of at most ``n`` bytes (empty at end of stream)."""
+        return self._stream.read(n) or b""
+
+    def frames(self) -> Iterator[bytes]:
+        while True:
+            frame = self.read(self.chunk_size)
+            if not frame:
+                return
+            yield frame
+
+    def close(self) -> None:
+        self._stream.close()
+
+
+class CompressedStream:
+    """Opaque compressed audio (mp3/ogg — ``CompressedStream``,
+    AudioStreams.scala:84+): no header validation, frames pass through for
+    server-side decoding."""
+
+    def __init__(self, data: Union[bytes, io.RawIOBase], chunk_size: int = 4096):
+        self._stream = io.BytesIO(data) if isinstance(data, (bytes, bytearray)) else data
+        self.chunk_size = int(chunk_size)
+
+    def read(self, n: int) -> bytes:
+        return self._stream.read(n) or b""
+
+    def frames(self) -> Iterator[bytes]:
+        while True:
+            frame = self.read(self.chunk_size)
+            if not frame:
+                return
+            yield frame
+
+    def close(self) -> None:
+        self._stream.close()
+
+
+def make_audio_stream(data: bytes, file_type: str = "wav", chunk_size: int = 3200):
+    """Factory matching ``SpeechToTextSDK``'s fileType dispatch."""
+    if file_type == "wav":
+        return WavStream(data, chunk_size=chunk_size)
+    if file_type in ("mp3", "ogg"):
+        return CompressedStream(data, chunk_size=chunk_size)
+    raise ValueError(f"unsupported audio fileType {file_type!r} (wav|mp3|ogg)")

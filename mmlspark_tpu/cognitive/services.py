@@ -219,8 +219,9 @@ class DetectAnomalies(CognitiveServicesBase):
 
 class SpeechToText(CognitiveServicesBase):
     """``cognitive/SpeechToText.scala`` REST speech recognition: binary audio
-    body (the native Speech SDK streaming variant is out of TPU scope —
-    SURVEY.md §2.20 item 5 keeps it a host HTTP client)."""
+    body in ONE request. For the streaming variant (pull-stream frames over
+    chunked transfer, the Speech SDK transport shape) see
+    :class:`mmlspark_tpu.cognitive.SpeechToTextSDK`."""
 
     response_schema = schemas.SpeechResponse
     audioDataCol = Param("Column of audio bytes", default="audio", converter=to_str)

@@ -73,7 +73,7 @@ def _stage_section(qual: str, cls: type) -> str:
     lines.append(f"*{_kind(cls)}* — `{qual}`")
     if summary:
         lines += ["", summary]
-    params = cls.params() if callable(getattr(cls, "params", None)) else {}
+    params = dict(getattr(cls, "_param_specs", {}))
     if params:
         lines += [
             "",

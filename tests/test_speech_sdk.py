@@ -1,0 +1,178 @@
+"""SpeechToTextSDK streaming transport (``SpeechToTextSDK.scala:66-249`` /
+``AudioStreams.scala:16-84``): WAV pull-stream validation and chunked
+streaming against an in-process endpoint that decodes transfer chunks."""
+
+import io
+import json
+import struct
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.cognitive import SpeechToTextSDK, WavStream
+from mmlspark_tpu.cognitive.audio import CompressedStream, make_audio_stream
+from mmlspark_tpu.data.table import Table
+
+
+def make_wav(n_samples=16000, extra_fmt=0) -> bytes:
+    """Valid PCM mono 16 kHz 16-bit WAV."""
+    pcm = (np.sin(np.linspace(0, 100, n_samples)) * 20000).astype("<i2").tobytes()
+    fmt_size = 16 + extra_fmt
+    fmt = struct.pack("<HHIIHH", 1, 1, 16000, 32000, 2, 16) + b"\0" * extra_fmt
+    return (
+        b"RIFF" + struct.pack("<I", 36 + extra_fmt + len(pcm)) + b"WAVE"
+        + b"fmt " + struct.pack("<I", fmt_size) + fmt
+        + b"data" + struct.pack("<I", len(pcm)) + pcm
+    )
+
+
+class ChunkedSpeechMock:
+    """Speech endpoint that DECODES the chunked request body, records every
+    transfer chunk, and replies with one 'Recognizing' event per chunk plus
+    a final 'Success' utterance — the SDK event stream shape."""
+
+    def __init__(self):
+        self.calls = []
+        mock = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def do_POST(self):  # noqa: N802
+                assert self.headers.get("Transfer-Encoding") == "chunked", (
+                    "client must stream (no Content-Length upload)"
+                )
+                chunks = []
+                while True:
+                    size = int(self.rfile.readline().strip(), 16)
+                    if size == 0:
+                        self.rfile.readline()  # trailing CRLF
+                        break
+                    chunks.append(self.rfile.read(size))
+                    self.rfile.readline()
+                mock.calls.append({
+                    "path": self.path,
+                    "headers": dict(self.headers),
+                    "chunks": chunks,
+                })
+                events = [
+                    {"RecognitionStatus": "Recognizing",
+                     "DisplayText": f"partial-{i}", "Offset": i}
+                    for i in range(len(chunks))
+                ] + [{"RecognitionStatus": "Success",
+                      "DisplayText": f"hello after {len(chunks)} chunks",
+                      "Offset": 0, "Duration": 100}]
+                data = json.dumps(events).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def log_message(self, *args):
+                pass
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.url = f"http://127.0.0.1:{self._httpd.server_address[1]}/speech"
+
+    def __enter__(self):
+        threading.Thread(target=self._httpd.serve_forever, daemon=True).start()
+        return self
+
+    def __exit__(self, *exc):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+class TestWavStream:
+    def test_frames_reassemble_payload(self):
+        wav = make_wav(8000)
+        ws = WavStream(wav, chunk_size=1000)
+        frames = list(ws.frames())
+        assert all(len(f) <= 1000 for f in frames)
+        assert len(frames) == 16  # 16000 bytes of PCM
+        assert b"".join(frames) == wav[44:]
+        assert ws.data_length == 16000
+
+    def test_extended_format_header(self):
+        ws = WavStream(make_wav(1000, extra_fmt=2))
+        assert b"".join(ws.frames()) == make_wav(1000, extra_fmt=2)[-2000:]
+
+    @pytest.mark.parametrize("mutate,err", [
+        (lambda b: b"JUNK" + b[4:], "RIFF"),
+        (lambda b: b[:8] + b"EVAW" + b[12:], "WAVE"),
+        # stereo
+        (lambda b: b[:22] + struct.pack("<H", 2) + b[24:], "single channel"),
+        # 8 kHz
+        (lambda b: b[:24] + struct.pack("<I", 8000) + b[28:], "samples per second"),
+        # 8-bit
+        (lambda b: b[:34] + struct.pack("<H", 8) + b[36:], "bits per sample"),
+        # non-PCM
+        (lambda b: b[:20] + struct.pack("<H", 3) + b[22:], "PCM"),
+    ])
+    def test_header_contract(self, mutate, err):
+        with pytest.raises(ValueError, match=err):
+            WavStream(mutate(make_wav(100)))
+
+    def test_compressed_passthrough_and_factory(self):
+        blob = b"\xff\xfbnot-really-mp3" * 100
+        cs = CompressedStream(blob, chunk_size=256)
+        assert b"".join(cs.frames()) == blob
+        assert isinstance(make_audio_stream(make_wav(10), "wav"), WavStream)
+        assert isinstance(make_audio_stream(blob, "mp3"), CompressedStream)
+        with pytest.raises(ValueError, match="fileType"):
+            make_audio_stream(blob, "flac")
+
+
+class TestSpeechToTextSDK:
+    def test_streams_chunks_and_collects_events(self):
+        wav = make_wav(16000)  # 32000 PCM bytes -> 10 chunks of 3200
+        with ChunkedSpeechMock() as mock:
+            sdk = SpeechToTextSDK(
+                url=mock.url, subscriptionKey="k", outputCol="text",
+                audioDataCol="audio", language="en-US",
+            )
+            t = Table({"audio": np.array([wav], dtype=object)})
+            out = sdk.transform(t)
+        events = out["text"][0]
+        call = mock.calls[0]
+        assert len(call["chunks"]) == 10
+        assert b"".join(call["chunks"]) == wav[44:]
+        assert call["headers"]["Ocp-Apim-Subscription-Key"] == "k"
+        assert "language=en-US" in call["path"]
+        # intermediate events kept by default
+        assert [e["RecognitionStatus"] for e in events].count("Recognizing") == 10
+        assert events[-1]["DisplayText"] == "hello after 10 chunks"
+
+    def test_finals_only_when_streaming_disabled(self):
+        with ChunkedSpeechMock() as mock:
+            sdk = SpeechToTextSDK(
+                url=mock.url, subscriptionKey="k", outputCol="text",
+                streamIntermediateResults=False,
+            )
+            out = sdk.transform(Table({"audio": np.array([make_wav(4800)], dtype=object)}))
+        events = out["text"][0]
+        assert len(events) == 1
+        assert events[0]["RecognitionStatus"] == "Success"
+
+    def test_invalid_wav_routes_to_error_col(self):
+        with ChunkedSpeechMock() as mock:
+            sdk = SpeechToTextSDK(
+                url=mock.url, subscriptionKey="k", outputCol="text",
+                errorCol="err",
+            )
+            out = sdk.transform(
+                Table({"audio": np.array([b"not audio", make_wav(1600)], dtype=object)})
+            )
+        assert out["text"][0] is None and "RIFF" in out["err"][0]
+        assert out["text"][1] is not None and out["err"][1] is None
+
+    def test_custom_endpoint_id_rides_query(self):
+        with ChunkedSpeechMock() as mock:
+            SpeechToTextSDK(
+                url=mock.url, subscriptionKey="k", outputCol="text",
+                endpointId="my-model",
+            ).transform(Table({"audio": np.array([make_wav(1600)], dtype=object)}))
+        assert "cid=my-model" in mock.calls[0]["path"]
