@@ -16,14 +16,36 @@ import struct
 from typing import Iterator, Union
 
 
-class WavStream:
+class _PullStream:
+    """Shared pull-stream plumbing: bytes-or-stream wrapping, frame
+    iteration, close (the ``PullAudioInputStreamCallback`` read contract)."""
+
+    def __init__(self, data: Union[bytes, io.RawIOBase], chunk_size: int):
+        self._stream = io.BytesIO(data) if isinstance(data, (bytes, bytearray)) else data
+        self.chunk_size = int(chunk_size)
+
+    def read(self, n: int) -> bytes:
+        """One frame of at most ``n`` bytes (empty at end of stream)."""
+        return self._stream.read(n) or b""
+
+    def frames(self) -> Iterator[bytes]:
+        while True:
+            frame = self.read(self.chunk_size)
+            if not frame:
+                return
+            yield frame
+
+    def close(self) -> None:
+        self._stream.close()
+
+
+class WavStream(_PullStream):
     """Pull stream over a WAV payload: validates the header, then yields the
     PCM data in ``chunk_size``-byte frames (``WavStream.read``'s contract)."""
 
     def __init__(self, data: Union[bytes, io.RawIOBase], chunk_size: int = 3200):
         # 3200 bytes = 100 ms of 16 kHz mono 16-bit PCM (the SDK's cadence)
-        self._stream = io.BytesIO(data) if isinstance(data, (bytes, bytearray)) else data
-        self.chunk_size = int(chunk_size)
+        super().__init__(data, chunk_size)
         self._parse_wav_header()
 
     # -- header ------------------------------------------------------------
@@ -72,44 +94,14 @@ class WavStream:
             raise ValueError("data")
         self.data_length = self._uint32()
 
-    # -- pull interface ----------------------------------------------------
 
-    def read(self, n: int) -> bytes:
-        """One frame of at most ``n`` bytes (empty at end of stream)."""
-        return self._stream.read(n) or b""
-
-    def frames(self) -> Iterator[bytes]:
-        while True:
-            frame = self.read(self.chunk_size)
-            if not frame:
-                return
-            yield frame
-
-    def close(self) -> None:
-        self._stream.close()
-
-
-class CompressedStream:
+class CompressedStream(_PullStream):
     """Opaque compressed audio (mp3/ogg — ``CompressedStream``,
     AudioStreams.scala:84+): no header validation, frames pass through for
     server-side decoding."""
 
     def __init__(self, data: Union[bytes, io.RawIOBase], chunk_size: int = 4096):
-        self._stream = io.BytesIO(data) if isinstance(data, (bytes, bytearray)) else data
-        self.chunk_size = int(chunk_size)
-
-    def read(self, n: int) -> bytes:
-        return self._stream.read(n) or b""
-
-    def frames(self) -> Iterator[bytes]:
-        while True:
-            frame = self.read(self.chunk_size)
-            if not frame:
-                return
-            yield frame
-
-    def close(self) -> None:
-        self._stream.close()
+        super().__init__(data, chunk_size)
 
 
 def make_audio_stream(data: bytes, file_type: str = "wav", chunk_size: int = 3200):

@@ -68,17 +68,22 @@ class SpeechToTextSDK(_HasServiceParams, HasOutputCol, Transformer):
         url = self.getUrl()
         if not url:
             raise ValueError("SpeechToTextSDK requires url")
+        # URL params come from the is_url_param flag on the declarations —
+        # the same contract CognitiveServicesBase uses — so a new param
+        # can't be silently dropped by a hand-kept list.
         params = {}
-        for name in ("language", "format", "profanity"):
-            v = self._resolve_service_param(name, table, row)
-            if v is not None:
-                params[name] = v
+        for name, spec in type(self)._param_specs.items():
+            if isinstance(spec, ServiceParam) and spec.is_url_param:
+                v = self._resolve_service_param(name, table, row)
+                if v is not None:
+                    params[name] = v
         if self.getEndpointId():
             params["cid"] = self.getEndpointId()
         parts = urlsplit(url)
         path = parts.path or "/"
-        if params:
-            path = f"{path}?{urlencode(params)}"
+        query = "&".join(q for q in (parts.query, urlencode(params)) if q)
+        if query:
+            path = f"{path}?{query}"
 
         file_type = self._resolve_service_param("fileType", table, row) or "wav"
         stream = make_audio_stream(audio, file_type, chunk_size=self.getChunkSize())

@@ -311,6 +311,9 @@ class DNNModel(Model):
         )
         for lo, hi in bounds:
             pad_to = batch_size if self.getMiniBatcher() else n
+            if self.getPipelineStageFn() is not None:
+                # GPipe needs batch % microbatches == 0 even un-minibatched
+                pad_to += (-pad_to) % self.getNumMicrobatches()
             inputs = {
                 model_in: _stack_batch(table.column(col)[lo:hi], pad_to, dtype)
                 for model_in, col in feeds.items()

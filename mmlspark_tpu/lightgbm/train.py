@@ -1189,8 +1189,10 @@ def train(
                 if stale >= opts.early_stopping_round:
                     break
 
+    # scan path: all iterations ran inside one program (trees list unused)
+    iters_done = opts.num_iterations if stacked_trees is not None else len(trees)
     for cb in callbacks:
-        cb.after_training(_cb_env(max(0, len(trees) - 1)))
+        cb.after_training(_cb_env(max(0, iters_done - 1)))
 
     if opts.verbosity >= 1:
         import logging as _logging

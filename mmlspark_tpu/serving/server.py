@@ -421,15 +421,20 @@ class DistributedServingServer:
         max_batch_size: int = 64,
         max_latency_ms: float = 2.0,
         max_retries: int = 1,
+        base_port: int = 0,
         **kwargs,
     ):
         self.loop = _BatchLoop(
             model, input_col, output_col, max_batch_size, max_latency_ms,
             max_retries,
         )
+        # base_port > 0: listeners bind base_port, base_port+1, ... (the
+        # deployable layout — k8s Services need declared ports); 0 keeps
+        # OS-assigned ephemeral ports for tests.
         self.servers = [
             ServingServer(
                 model, host=host, name=f"{name}-{i}", loop=self.loop,
+                port=(base_port + i) if base_port else 0,
                 input_col=input_col, output_col=output_col, **kwargs,
             )
             for i in range(num_servers)

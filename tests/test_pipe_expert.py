@@ -234,3 +234,24 @@ class TestDNNModelConsumers:
         _, _, place = m._jitted()
         with pytest.raises(ValueError, match="experts"):
             place(m.getModelParams())
+
+
+def test_pipeline_mode_unbatched_pads_to_microbatches():
+    """miniBatcher=False with a row count not divisible by numMicrobatches
+    must pad internally instead of raising."""
+    from mmlspark_tpu.data.table import Table
+    from mmlspark_tpu.dnn import DNNModel
+
+    rng = np.random.default_rng(2)
+    d, p = 8, 4
+    params = _stack_params(rng, p, d)
+    X = rng.normal(size=(10, d)).astype(np.float32)  # 10 % 4 != 0
+    out = DNNModel(
+        pipelineStageFn=_stage_fn,
+        modelParams=params,
+        feedDict={"x": "f"}, fetchDict={"y": "output"},
+        miniBatcher=False, numMicrobatches=p,
+        meshConfig=MeshConfig(data=2, pipe=p),
+    ).transform(Table({"f": X}))
+    want = np.asarray(_sequential(params, jnp.asarray(X)))
+    np.testing.assert_allclose(out.column("y"), want, rtol=2e-4, atol=2e-5)

@@ -251,3 +251,13 @@ class TestConcurrentLoad:
         assert out["errors"] == 0, out  # failover absorbed the worker death
         assert out["failovers"] >= 1, out  # the death actually happened mid-stream
         assert out["p50_ms"] < 250, out
+
+
+def test_distributed_base_port_binds_sequential_ports():
+    """base_port pins listener ports (the k8s Service contract)."""
+    srv = DistributedServingServer(_Doubler(), num_servers=2, base_port=28990)
+    with srv:
+        ports = [i.port for i in srv.service_info]
+        assert ports == [28990, 28991]
+        status, out = _post(srv.service_info[1].url, {"input": 4.0})
+        assert status == 200 and out["prediction"] == 8.0

@@ -176,3 +176,14 @@ class TestSpeechToTextSDK:
                 endpointId="my-model",
             ).transform(Table({"audio": np.array([make_wav(1600)], dtype=object)}))
         assert "cid=my-model" in mock.calls[0]["path"]
+
+
+def test_preexisting_query_string_preserved():
+    """A query already on the configured url must survive param assembly."""
+    with ChunkedSpeechMock() as mock:
+        SpeechToTextSDK(
+            url=mock.url + "?initialSilenceTimeoutMs=600",
+            subscriptionKey="k", outputCol="text",
+        ).transform(Table({"audio": np.array([make_wav(1600)], dtype=object)}))
+    path = mock.calls[0]["path"]
+    assert "initialSilenceTimeoutMs=600" in path and "language=en-US" in path

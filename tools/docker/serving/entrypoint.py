@@ -41,6 +41,7 @@ def main() -> int:
             model,
             num_servers=args.servers,
             host=args.host,
+            base_port=args.port,  # listeners bind port, port+1, ... (k8s Service)
             registry=registry,
             input_col=args.input_col,
             output_col=args.output_col,
