@@ -255,7 +255,12 @@ def test_api_reference_up_to_date():
     """The generated API reference (docs/api/) must match the code — the
     CI-validated codegen artifact (CodeGen.scala:15-48 analogue). Regenerate
     with `python -m mmlspark_tpu.core.apigen` after changing any Param."""
-    from mmlspark_tpu.core.apigen import _default_out_dir, check
+    from mmlspark_tpu.core.apigen import (
+        _default_out_dir,
+        _default_r_dir,
+        check,
+        check_r,
+    )
 
-    stale = check(_default_out_dir())
+    stale = check(_default_out_dir()) + check_r(_default_r_dir())
     assert not stale, f"API reference drift, regenerate: {stale}"
