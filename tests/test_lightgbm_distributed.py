@@ -37,3 +37,36 @@ def test_num_tasks_caps_shards():
     t = Table({"features": d.data.astype(np.float64), "label": d.target.astype(np.float64)})
     m = LightGBMClassifier(numIterations=3, numTasks=2).fit(t)
     assert m.booster.num_trees == 3
+
+
+def test_mesh_fit_with_bagging_validation_early_stop(mesh8):
+    """The loop path under the mesh with everything on: bagging resampling,
+    feature fraction, a validation set, and early stopping — collective
+    programs interleaved with per-iteration host decisions."""
+    import numpy as np
+
+    from mmlspark_tpu.lightgbm.binning import bin_dataset
+    from mmlspark_tpu.lightgbm.objectives import auc
+    from mmlspark_tpu.lightgbm.train import TrainOptions, train
+
+    rng = np.random.default_rng(4)
+    n, f = 16384, 10
+    X = rng.normal(size=(n, f))
+    y = ((X[:, 0] + X[:, 1] * X[:, 2] + 0.3 * rng.normal(size=n)) > 0).astype(
+        np.float64
+    )
+    bins, mapper = bin_dataset(X, max_bin=63)
+    vb, _ = bin_dataset(X[:4000], mapper=mapper)
+    opts = TrainOptions(
+        objective="binary", num_iterations=25, num_leaves=15, max_bin=63,
+        bagging_fraction=0.7, bagging_freq=1, feature_fraction=0.8,
+        early_stopping_round=5, seed=11,
+    )
+    r = train(
+        bins, y, opts, mapper=mapper, mesh=mesh8,
+        valid_sets=[("v", vb, y[:4000], None)],
+    )
+    assert 1 <= r.booster.num_trees <= 25
+    a = auc(y, r.booster.raw_margin(X)[:, 0], np.ones(n))
+    assert a > 0.85, a
+    assert len(r.evals["v"]["auc"]) == r.booster.num_trees

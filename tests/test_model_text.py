@@ -234,3 +234,38 @@ class TestAgainstRealLightGBM:
         theirs = their_booster.predict(X[:200], raw_score=True)
         ours = b.raw_margin(X[:200])[:, 0]
         np.testing.assert_allclose(theirs, ours, rtol=1e-5, atol=1e-6)
+
+
+class TestWarmStartFromText:
+    def test_continue_training_from_lightgbm_text(self):
+        """A booster round-tripped through the LightGBM text format can seed
+        continued training via modelString (the reference's saveNativeModel ->
+        setModelString flow, LightGBMClassifier.scala:172-194)."""
+        from mmlspark_tpu.data.table import Table
+        from mmlspark_tpu.lightgbm.classifier import LightGBMClassifier
+
+        rng = np.random.default_rng(3)
+        X = rng.normal(size=(800, 6))
+        y = ((X[:, 0] + X[:, 1] * X[:, 2]) > 0).astype(np.float64)
+        t = Table({"features": X, "label": y})
+
+        m1 = LightGBMClassifier(numIterations=5, numLeaves=7).fit(t)
+        text = m1.get_model_string()
+        assert text.startswith("tree\n")
+
+        m2 = LightGBMClassifier(
+            numIterations=5, numLeaves=7, modelString=text
+        ).fit(t)
+        # the continuation starts from the text model's margins: first new
+        # tree must differ from a cold fit's first tree
+        cold = LightGBMClassifier(numIterations=5, numLeaves=7).fit(t)
+        assert not np.allclose(
+            m2.booster.leaf_values[0], cold.booster.leaf_values[0]
+        )
+        # and the warm model must outperform (or match) the 5-tree base
+        from mmlspark_tpu.lightgbm.objectives import auc
+
+        base = auc(y, m1.booster.raw_margin(X)[:, 0], np.ones(len(y)))
+        warm = auc(y, m2.booster.raw_margin(X)[:, 0]
+                   + m1.booster.raw_margin(X)[:, 0], np.ones(len(y)))
+        assert warm >= base - 1e-6
