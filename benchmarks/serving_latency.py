@@ -45,6 +45,45 @@ def _percentiles(times):
     }
 
 
+def http_edge_keepalive_latency(n=500):
+    """One persistent HTTP/1.1 connection, n sequential requests — the
+    steady-state client shape (no TCP setup per call)."""
+    import http.client
+
+    from mmlspark_tpu.core.pipeline import Transformer
+    from mmlspark_tpu.serving import ServingServer
+
+    class Doubler(Transformer):
+        def transform(self, table):
+            x = np.asarray(table.column("input"), dtype=np.float64)
+            return table.with_column("prediction", x * 2)
+
+    with ServingServer(Doubler(), max_latency_ms=0.2) as srv:
+        conn = http.client.HTTPConnection("127.0.0.1", srv.info.port)
+        conn.connect()
+        import socket
+
+        conn.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        body = json.dumps({"input": 1.0}).encode()
+
+        def call():
+            conn.request("POST", "/", body=body,
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            resp.read()
+            assert resp.status == 200
+
+        for _ in range(20):
+            call()
+        times = []
+        for _ in range(n):
+            t0 = time.perf_counter()
+            call()
+            times.append(time.perf_counter() - t0)
+        conn.close()
+    return _percentiles(times)
+
+
 def http_edge_latency(n=200):
     from mmlspark_tpu.core.pipeline import Transformer
     from mmlspark_tpu.serving import ServingServer
@@ -219,6 +258,7 @@ def main():
     import jax
 
     edge = http_edge_latency()
+    edge_ka = http_edge_keepalive_latency()
     dev1 = device_forward_latency(batch=1)
     dev8 = device_forward_latency(batch=8)
     served = served_resnet_latency()
@@ -226,6 +266,7 @@ def main():
     report = {
         "backend": jax.default_backend(),
         "http_edge": edge,
+        "http_edge_keepalive": edge_ka,
         "resnet18_forward_ms": {"batch1": dev1, "batch8": dev8},
         "served_resnet18_end_to_end": served,
         "concurrent_load_distributed": load,

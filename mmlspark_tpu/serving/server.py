@@ -237,15 +237,31 @@ class _ListenerMixin:
 
     def _make_handler(self, loop: _BatchLoop, input_col: str):
         class Handler(BaseHTTPRequestHandler):
+            # HTTP/1.1: connections persist across requests, so steady-state
+            # clients skip TCP setup per call — the "sub-millisecond" serving
+            # posture of the reference (mmlspark-serving.md) needs keep-alive.
+            # Every response path MUST therefore carry Content-Length, or a
+            # keep-alive client would block waiting for a close that never
+            # comes. Nagle must be off: coalescing the status line with the
+            # body write otherwise interacts with delayed ACKs into ~40 ms
+            # stalls per keep-alive request.
+            protocol_version = "HTTP/1.1"
+            disable_nagle_algorithm = True
+
+            def _reply_bytes(self, status: int, data: bytes) -> None:
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
             def do_POST(self):  # noqa: N802 (http.server API)
                 length = int(self.headers.get("Content-Length", 0))
                 body = self.rfile.read(length)
                 try:
                     payload = json.loads(body) if body else None
                 except json.JSONDecodeError:
-                    self.send_response(400)
-                    self.end_headers()
-                    self.wfile.write(b'{"error": "invalid json"}')
+                    self._reply_bytes(400, b'{"error": "invalid json"}')
                     return
                 if isinstance(payload, dict) and input_col in payload:
                     payload = payload[input_col]
@@ -253,14 +269,9 @@ class _ListenerMixin:
                 loop.submit(req)
                 req.event.wait(timeout=30.0)
                 if req.response is None:
-                    self.send_response(504)
-                    self.end_headers()
+                    self._reply_bytes(504, b'{"error": "timeout"}')
                     return
-                self.send_response(req.status)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(req.response)))
-                self.end_headers()
-                self.wfile.write(req.response)
+                self._reply_bytes(req.status, req.response)
 
             def log_message(self, *args):  # silence default stderr logging
                 pass
