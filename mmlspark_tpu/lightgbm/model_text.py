@@ -264,7 +264,7 @@ def from_lightgbm_text(s: str):
     objective = _parse_objective(header.get("objective", "regression"))
     if objective not in (
         "binary", "multiclass", "regression", "regression_l1", "huber",
-        "quantile", "poisson", "tweedie",
+        "quantile", "poisson", "tweedie", "lambdarank",
     ):
         raise ValueError(f"unsupported objective in model text: {objective!r}")
     max_feature_idx = int(header.get("max_feature_idx", 0))

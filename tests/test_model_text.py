@@ -269,3 +269,26 @@ class TestWarmStartFromText:
         warm = auc(y, m2.booster.raw_margin(X)[:, 0]
                    + m1.booster.raw_margin(X)[:, 0], np.ones(len(y)))
         assert warm >= base - 1e-6
+
+
+def test_ranker_round_trip():
+    """lambdarank boosters survive the text format (scores are raw margins,
+    so the round trip is rank-exact)."""
+    from mmlspark_tpu.data.table import Table
+    from mmlspark_tpu.lightgbm import LightGBMRanker
+
+    rng = np.random.default_rng(9)
+    q, per = 20, 10
+    n = q * per
+    X = rng.normal(size=(n, 5))
+    rel = np.clip(X[:, 0] * 1.5 + 1.5, 0, 4).round()
+    t = Table({
+        "features": X, "label": rel.astype(np.float64),
+        "query": np.repeat(np.arange(q), per).astype(np.int64),
+    })
+    b = LightGBMRanker(numIterations=3, groupCol="query", minDataInLeaf=2).fit(t).booster
+    b2 = from_lightgbm_text(to_lightgbm_text(b))
+    assert b2.objective == "lambdarank"
+    np.testing.assert_allclose(
+        b2.raw_margin(X), b.raw_margin(X), rtol=1e-5, atol=1e-6
+    )
