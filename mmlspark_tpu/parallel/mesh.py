@@ -299,6 +299,12 @@ def force_platform(platform: str, min_devices: int = 1) -> None:
         xla_bridge._clear_backends()
         if hasattr(xla_bridge.get_backend, "cache_clear"):
             xla_bridge.get_backend.cache_clear()
+        # Compiled-executable caches survive the backend teardown and can be
+        # REUSED against the new client: a program traced on the old
+        # single-device backend then silently misexecutes collectives on the
+        # new multi-device one (observed as wrong ring-attention output after
+        # an entry()-style warm-up preceded the platform switch).
+        jax.clear_caches()
     jax.config.update("jax_platforms", platform)
     if len(jax.devices()) < min_devices:
         raise RuntimeError(
