@@ -162,3 +162,21 @@ def test_serialization_fuzzing(test_object, tmp_path):
             _tables_close(model.transform(tt), model2.transform(tt))
     elif test_object.check_transform:
         _tables_close(stage.transform(tt), reloaded.transform(tt))
+
+
+def test_ci_shards_cover_every_test_file():
+    """Every tests/test_*.py must appear in a CI shard — a new test file
+    that CI never runs is a silent coverage hole (the same class of
+    meta-check as the stage-fixture requirement above)."""
+    import os
+    import re
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    ci = open(os.path.join(root, ".github", "workflows", "ci.yml")).read()
+    sharded = set(re.findall(r"tests/test_\w+\.py", ci))
+    on_disk = {
+        f"tests/{f}" for f in os.listdir(os.path.dirname(os.path.abspath(__file__)))
+        if f.startswith("test_") and f.endswith(".py")
+    }
+    missing = sorted(on_disk - sharded)
+    assert not missing, f"test files absent from CI shards: {missing}"
