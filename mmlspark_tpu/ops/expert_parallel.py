@@ -1,13 +1,19 @@
 """Expert parallelism over the mesh ``expert`` axis (MoE dispatch).
 
-Experts shard one-per-device over the ``expert`` axis. Routing is top-1 by
-gate score; the static-shape TPU formulation is masked-dense dispatch:
-every device applies ITS expert to the full token batch, masks the tokens
-routed elsewhere, scales by the gate probability, and a single
-``lax.psum`` combines the expert outputs (each token received exactly one
-expert's contribution). Dense compute trades FLOPs for static shapes and
-zero load-imbalance stalls; the capacity-based all_to_all variant is the
-follow-on once expert counts outgrow the masked form.
+Experts shard one-per-device over the ``expert`` axis. Two dispatch
+formulations, both static-shape:
+
+- :func:`moe_apply` — masked-dense: every device applies ITS expert to the
+  full (replicated) token batch, masks the tokens routed elsewhere, and a
+  ``lax.psum`` combines. Dense compute trades FLOPs for zero
+  load-imbalance stalls; right while the batch fits replicated.
+- :func:`moe_apply_a2a` — capacity-based ``all_to_all`` (the GShard
+  layout): tokens shard over the expert axis, each device packs its local
+  tokens into fixed-capacity per-expert send buffers, ONE all_to_all
+  routes buffers to the owning expert, the expert runs on its received
+  tokens, and the reverse all_to_all brings outputs home. Compute and
+  memory per device stay ∝ B/E; tokens beyond an expert's capacity are
+  dropped (output zero), the standard capacity-factor contract.
 """
 
 from __future__ import annotations
@@ -79,5 +85,93 @@ def moe_apply(
             P(),
         ),
         out_specs=P(),
+        check_vma=False,
+    )(expert_params, x, assign, chosen_p)
+
+
+def moe_apply_a2a(
+    expert_fn: Callable,
+    expert_params,
+    x: jax.Array,
+    gate_logits: jax.Array,
+    mesh,
+    capacity_factor: float = 1.25,
+):
+    """Top-1 MoE via capacity-based all_to_all dispatch.
+
+    ``x`` (B, D) and ``gate_logits`` (B, E) shard their batch over the
+    ``expert`` mesh axis (B divisible by E); expert e lives on device e.
+    Each device packs its B/E local tokens into (E, C) send slots with
+    ``C = ceil(B/E/E * capacity_factor)`` per destination, one
+    ``all_to_all`` delivers every expert its (E, C) received tokens, the
+    expert runs once on E*C tokens, and the reverse all_to_all routes
+    outputs back. Tokens that overflow an expert's local capacity are
+    DROPPED (zero output, the capacity-factor contract). Falls back to the
+    masked-dense form when the expert axis is 1."""
+    e_mesh = int(mesh.shape.get(AXIS_EXPERT, 1))
+    if e_mesh <= 1:
+        return moe_apply(expert_fn, expert_params, x, gate_logits, mesh)
+    e_total = jax.tree.leaves(expert_params)[0].shape[0]
+    if e_total != e_mesh:
+        raise ValueError(
+            f"{e_total} experts but expert axis of {e_mesh} — one expert "
+            "per device"
+        )
+    b, d = x.shape
+    if b % e_mesh != 0:
+        raise ValueError(f"batch {b} not divisible by expert axis {e_mesh}")
+    b_local = b // e_mesh
+    import math
+
+    cap = max(1, math.ceil(b_local / e_mesh * capacity_factor))
+
+    probs = jax.nn.softmax(gate_logits, axis=1)
+    assign = jnp.argmax(gate_logits, axis=1).astype(jnp.int32)  # (B,)
+    chosen_p = jnp.take_along_axis(probs, assign[:, None], axis=1)  # (B, 1)
+
+    def local_fn(params_local, x_l, assign_l, chosen_l):
+        params_one = jax.tree.map(lambda a: a[0], params_local)
+        # position of each local token within its destination expert's
+        # send buffer (rank among same-destination tokens, in order)
+        dest_oh = (
+            assign_l[:, None] == jnp.arange(e_mesh, dtype=jnp.int32)[None, :]
+        )  # (b_local, E)
+        pos = jnp.cumsum(dest_oh.astype(jnp.int32), axis=0) - 1  # rank per dest
+        my_pos = jnp.take_along_axis(pos, assign_l[:, None], axis=1)[:, 0]
+        keep = my_pos < cap  # overflow tokens dropped
+
+        # scatter local tokens into (E, C, D) send buffers; slot (e, c)
+        # holds the c-th kept token destined for expert e
+        slot = jnp.where(keep, assign_l * cap + my_pos, e_mesh * cap)  # drop->OOB
+        send = jnp.zeros((e_mesh * cap, x_l.shape[1]), x_l.dtype).at[slot].set(
+            x_l, mode="drop"
+        ).reshape(e_mesh, cap, x_l.shape[1])
+
+        # deliver: device e receives the e-th buffer from every source
+        recv = lax.all_to_all(send, AXIS_EXPERT, split_axis=0, concat_axis=0,
+                              tiled=True)  # (E*C, D) tokens for MY expert
+        out = expert_fn(params_one, recv)  # (E*C, D_out)
+
+        # route home: reverse all_to_all returns each source its slots
+        back = lax.all_to_all(
+            out.reshape(e_mesh, cap, out.shape[-1]), AXIS_EXPERT,
+            split_axis=0, concat_axis=0, tiled=True,
+        ).reshape(e_mesh * cap, out.shape[-1])
+
+        # gather my tokens' outputs from their slots; dropped -> zero
+        safe_slot = jnp.where(keep, slot, 0)
+        y = back[safe_slot] * keep[:, None] * chosen_l
+        return y
+
+    return jax.shard_map(
+        local_fn,
+        mesh=mesh,
+        in_specs=(
+            jax.tree.map(lambda _: P(AXIS_EXPERT), expert_params),
+            P(AXIS_EXPERT),
+            P(AXIS_EXPERT),
+            P(AXIS_EXPERT),
+        ),
+        out_specs=P(AXIS_EXPERT),
         check_vma=False,
     )(expert_params, x, assign, chosen_p)
