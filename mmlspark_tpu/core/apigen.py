@@ -201,11 +201,23 @@ def render_r() -> Dict[str, str]:
             lines.append("#'")
             lines.append(f"#' {doc}")
         params = dict(getattr(cls, "_param_specs", {}))
-        for name in sorted(params):
-            p = params[name]
-            d = "" if p.default is NO_DEFAULT else f" (default {p.default!r})"
-            doc_line = (p.doc or "").replace("\n", " ")
-            lines.append(f"#' @param {name} {doc_line}{d}")
+        if params:
+            # the function signature is `...` (kwargs pass through to the
+            # Python constructor), so roxygen documents the ONE real
+            # argument — per-param detail rides @section to keep
+            # `R CMD check`'s usage/doc consistency happy
+            lines.append("#'")
+            lines.append("#' @section Parameters:")
+            lines.append("#' \\itemize{")
+            for name in sorted(params):
+                p = params[name]
+                d = "" if p.default is NO_DEFAULT else f" (default {p.default!r})"
+                doc_line = (p.doc or "").replace("\n", " ").replace("%", "\\%")
+                lines.append(f"#'   \\item \\code{{{name}}}: {doc_line}{d}")
+            lines.append("#' }")
+        lines.append(
+            "#' @param ... named params forwarded to the Python constructor"
+        )
         lines.append("#' @export")
         lines.append(f"{fn} <- function(...) {{")
         lines.append(f'  mt_stage("{module}", "{cls.__name__}", ...)')
@@ -222,8 +234,9 @@ def render_r() -> Dict[str, str]:
             "    with `python -m mmlspark_tpu.core.apigen`.\n"
             "Imports: reticulate\n"
             "Encoding: UTF-8\n"
-            "License: MIT\n"
+            "License: MIT + file LICENSE\n"
         ),
+        "LICENSE": "YEAR: 2026\nCOPYRIGHT HOLDER: mmlspark-tpu contributors\n",
         "NAMESPACE": (
             "# GENERATED — every mt_* constructor is exported\n"
             'exportPattern("^mt_")\n'

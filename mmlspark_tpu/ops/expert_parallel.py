@@ -149,7 +149,10 @@ def moe_apply_a2a(
 
         # deliver: device e receives the e-th buffer from every source
         recv = lax.all_to_all(send, AXIS_EXPERT, split_axis=0, concat_axis=0,
-                              tiled=True)  # (E*C, D) tokens for MY expert
+                              tiled=True)
+        # flatten (E, C, D) -> (E*C, D): expert_fn's contract is a 2-D token
+        # batch, same as the masked-dense path
+        recv = recv.reshape(e_mesh * cap, recv.shape[-1])
         out = expert_fn(params_one, recv)  # (E*C, D_out)
 
         # route home: reverse all_to_all returns each source its slots
