@@ -92,6 +92,13 @@ class LightGBMParams(
         "of 1 via the panel histogram kernel)",
         default=8, converter=to_int, validator=gt(0),
     )
+    leafBatchRatio = Param(
+        "Only batch leaves whose gain >= ratio * pass-best (0 = off; 1.0 "
+        "reproduces exact best-first; ~0.2 measured to IMPROVE holdout AUC "
+        "past both exact best-first and the CPU engine at ~20% extra fit "
+        "time — docs/perf_histogram.md)",
+        default=0.0, converter=to_float, validator=in_range(0, 1),
+    )
     numBatches = Param("Split training into sequential batches (0=off)", default=0, converter=to_int, validator=ge(0))
     modelString = Param("Warm-start booster string", default="", converter=to_str)
     verbosity = Param("Verbosity", default=-1, converter=to_int)
@@ -132,6 +139,7 @@ class LightGBMParams(
             seed=self.getSeed(),
             growth=self.getGrowthPolicy(),
             leaf_batch=self.getLeafBatch(),
+            leaf_batch_ratio=self.getLeafBatchRatio(),
             tree_learner=(
                 "voting_parallel"
                 if self.getParallelism() == "voting_parallel"

@@ -92,6 +92,9 @@ class TrainOptions:
     other_rate: float = 0.1  # goss: sampled fraction of the rest
     drop_rate: float = 0.1  # dart: per-tree drop probability
     leaf_batch: int = 8  # frontier leaves split per histogram pass (1 = exact best-first)
+    # only batch leaves with gain >= ratio * pass-best (0 = off): tightens
+    # multi-leaf passes toward best-first; 1.0 reproduces leaf_batch=1
+    leaf_batch_ratio: float = 0.0
     verbosity: int = -1
 
     @property
@@ -479,6 +482,11 @@ def _build_tree_leafwise(
         can = (top_g > opts.min_gain_to_split) & (
             st["n_splits"] + j < num_leaves - 1
         )  # monotone in j: gains sorted descending, budget consumed in order
+        if opts.leaf_batch_ratio > 0.0:
+            # quality gate: only leaves whose gain is within ratio of the
+            # pass best split together — tightens batched growth toward
+            # sequential best-first (monotone in j: gains sorted)
+            can = can & (top_g >= opts.leaf_batch_ratio * top_g[0])
         lslot = 2 * (st["n_splits"] + j) + 1
         rslot = lslot + 1
         # Guarded scatter indices: disabled lanes write out of range (m) and

@@ -349,3 +349,39 @@ class TestPathMatrixPredict:
             b.max_depth,
         ))
         np.testing.assert_array_equal(b.predict_leaf(X), old_l)
+
+
+class TestLeafBatchRatio:
+    def test_ratio_one_reproduces_exact_best_first(self):
+        """leaf_batch_ratio=1.0 only batches exact gain ties, so (absent
+        ties) every pass splits one leaf and the tree equals the
+        leaf_batch=1 sequential build bit for bit."""
+        X, y = _make_binary(n=700)
+        bins, mapper = bin_dataset(X, max_bin=31)
+        base = dict(objective="binary", num_iterations=4, num_leaves=15, max_bin=31)
+        seq = train(bins, y, TrainOptions(**base, leaf_batch=1), mapper=mapper)
+        gated = train(
+            bins, y, TrainOptions(**base, leaf_batch=8, leaf_batch_ratio=1.0),
+            mapper=mapper,
+        )
+        for field in ("split_feature", "split_bin", "left_child", "right_child",
+                      "is_leaf"):
+            np.testing.assert_array_equal(
+                getattr(gated.booster, field), getattr(seq.booster, field),
+                err_msg=field,
+            )
+        np.testing.assert_allclose(
+            gated.booster.leaf_values, seq.booster.leaf_values, rtol=1e-6
+        )
+
+    def test_ratio_gate_still_fills_leaf_budget(self):
+        X, y = _make_binary(n=700)
+        bins, mapper = bin_dataset(X, max_bin=31)
+        r = train(
+            bins, y,
+            TrainOptions(objective="binary", num_iterations=2, num_leaves=15,
+                         max_bin=31, leaf_batch=8, leaf_batch_ratio=0.3),
+            mapper=mapper,
+        )
+        # every tree still reaches the leaf budget when data supports it
+        assert (np.asarray(r.booster.is_leaf).sum(axis=1) == 15).all()
