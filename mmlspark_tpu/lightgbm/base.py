@@ -87,9 +87,14 @@ class LightGBMParams(
         default="leafwise", converter=to_str, validator=one_of("leafwise", "depthwise"),
     )
     leafBatch = Param(
-        "Frontier leaves split per histogram pass under leafwise growth "
-        "(1 = exact sequential best-first; >1 approximates it at ~pass cost "
-        "of 1 via the panel histogram kernel)",
+        "Frontier leaves split per histogram pass under leafwise growth. "
+        "NOTE: the default (8) is a batched APPROXIMATION of LightGBM's "
+        "sequential best-first growth — up to 8 frontier leaves commit "
+        "together, so default fits are not best-first-exact and differ "
+        "slightly from the native engine's trees (bench AUC delta ~0.001, "
+        "docs/perf_histogram.md). Set leafBatch=1 for the exact sequential "
+        "algorithm (~4x slower), or leafBatchRatio=1.0 to keep batching "
+        "only for exact gain ties. >1 costs ~one pass via the panel kernel",
         default=8, converter=to_int, validator=gt(0),
     )
     leafBatchRatio = Param(

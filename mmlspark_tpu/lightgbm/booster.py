@@ -13,7 +13,9 @@ implicit heap: depth can reach ``num_leaves - 1``):
 
 - ``split_feature``   (M,) int32   — internal nodes; 0 at leaves/dead slots
 - ``split_threshold`` (M,) float32 — raw-value "go left if NaN or x <= t";
-                                      +inf at dead slots
+                                      +inf at dead slots (float64 on imported
+                                      LightGBM models; predict snaps DOWN to
+                                      f32, see ``_thr_f32``)
 - ``split_bin``       (M,) int32   — binned-space threshold (training path)
 - ``left_child`` / ``right_child`` (M,) int32 — slot indices
 - ``is_leaf``         (M,) bool
@@ -53,7 +55,7 @@ def _predict_chunk_rows(t: int, i: int, budget_bytes: int = 256 << 20) -> int:
 @dataclasses.dataclass
 class Booster:
     split_feature: np.ndarray  # (T, M) int32
-    split_threshold: np.ndarray  # (T, M) float32
+    split_threshold: np.ndarray  # (T, M) float32 (float64 on imported models)
     split_bin: np.ndarray  # (T, M) int32
     left_child: np.ndarray  # (T, M) int32
     right_child: np.ndarray  # (T, M) int32
@@ -187,8 +189,14 @@ class Booster:
         d = dict(d)
         for k in ("split_feature", "split_bin", "left_child", "right_child"):
             d[k] = np.asarray(d[k], dtype=np.int32)
-        for k in ("split_threshold", "leaf_values", "init_score"):
+        for k in ("leaf_values", "init_score"):
             d[k] = np.asarray(d[k], dtype=np.float32)
+        # thresholds keep f64 when they arrive as f64 (imported LightGBM
+        # models); trained-here boosters are exact f32 values either way
+        thr = np.asarray(d["split_threshold"])
+        d["split_threshold"] = thr.astype(
+            np.float64 if thr.dtype == np.float64 else np.float32
+        )
         d["is_leaf"] = np.asarray(d["is_leaf"], dtype=bool)
         for k in ("cover", "split_gain"):
             if d.get(k) is not None:
@@ -287,6 +295,21 @@ def _csr_chunks(X, target_bytes: int = 256 << 20):
 # is host-precomputed once per booster (cached) and baked as constants.
 
 
+def _thr_f32(thr) -> np.ndarray:
+    """f64 thresholds → the LARGEST f32 value <= each threshold. For f32
+    inputs x, ``x <= thr_f32`` then decides identically to LightGBM's f64
+    ``x <= thr`` (round-to-nearest narrowing could round UP past the
+    threshold and admit rows the f64 comparison rejects)."""
+    thr = np.asarray(thr)
+    if thr.dtype != np.float64:
+        return thr.astype(np.float32)
+    t32 = thr.astype(np.float32)
+    over = t32.astype(np.float64) > thr
+    if over.any():
+        t32 = np.where(over, np.nextafter(t32, np.float32(-np.inf)), t32)
+    return t32
+
+
 def _leaf_paths(b: "Booster", t: int):
     """Host precompute for trees[:t]: per-tree padded constants
     (FEATS (T,I), THRS (T,I), P (T,I,L), PLEN (T,L), LVALS (T,L),
@@ -319,7 +342,7 @@ def _leaf_paths(b: "Booster", t: int):
         th = np.full(max_i, np.inf, np.float32)  # padding: always-left, off-path
         nl = np.ones(max_i, bool)  # padding: NaN goes left (off-path anyway)
         fe[: len(internal)] = b.split_feature[ti][internal]
-        th[: len(internal)] = b.split_threshold[ti][internal]
+        th[: len(internal)] = _thr_f32(b.split_threshold[ti][internal])
         if b.nan_left is not None:
             nl[: len(internal)] = b.nan_left[ti][internal]
         P = np.zeros((max_i, max_l), np.float32)

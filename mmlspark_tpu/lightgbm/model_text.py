@@ -378,7 +378,13 @@ def from_lightgbm_text(s: str):
 
     booster = Booster(
         split_feature=pad("feat", 0, np.int32),
-        split_threshold=pad("thr", np.inf, np.float32),
+        # float64: LightGBM thresholds are f64 midpoints; narrowing here would
+        # misroute rows whose f32-cast value falls between the f64 threshold
+        # and its f32 rounding. Predict snaps to f32 DOWNWARD (booster.py
+        # _thr_f32), which preserves the f64 decision set exactly for f32
+        # inputs; residual contract: f64 inputs that straddle an f32 gap can
+        # still differ (the predict kernel compares in f32).
+        split_threshold=pad("thr", np.inf, np.float64),
         split_bin=np.zeros((t, m), np.int32),
         left_child=pad("left", 0, np.int32),
         right_child=pad("right", 0, np.int32),

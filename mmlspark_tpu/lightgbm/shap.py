@@ -54,11 +54,15 @@ def _shap_one_tree(feat, thr, left, right, is_leaf, leaf_val, cover, X, nan_left
     phi = np.zeros((n, num_features), dtype=np.float64)
 
     # Hot child per row per node (row's own decision), precomputed in
-    # float32 — the same comparison grid as the jitted predict path, so
-    # boundary values route identically and additivity holds exactly.
+    # float32 with the SAME downward f64→f32 threshold snap as the jitted
+    # predict path (booster._thr_f32), so boundary values route identically
+    # and additivity (sum == raw_margin) holds exactly — round-to-nearest
+    # narrowing here would diverge from predict on imported f64 thresholds.
+    from mmlspark_tpu.lightgbm.booster import _thr_f32
+
     xv = X[:, feat].astype(np.float32)  # (N, M)
     nl = np.ones(len(feat), bool) if nan_left is None else np.asarray(nan_left, bool)
-    goes_left = (np.isnan(xv) & nl[None, :]) | (xv <= thr[None, :].astype(np.float32))
+    goes_left = (np.isnan(xv) & nl[None, :]) | (xv <= _thr_f32(thr)[None, :])
 
     root_cover = max(float(cover[0]), 1e-12)
 

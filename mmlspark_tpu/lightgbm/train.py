@@ -485,8 +485,11 @@ def _build_tree_leafwise(
         if opts.leaf_batch_ratio > 0.0:
             # quality gate: only leaves whose gain is within ratio of the
             # pass best split together — tightens batched growth toward
-            # sequential best-first (monotone in j: gains sorted)
-            can = can & (top_g >= opts.leaf_batch_ratio * top_g[0])
+            # sequential best-first (monotone in j: gains sorted). Lane 0 IS
+            # the pass best, so it always qualifies — without that exemption
+            # a negative best gain (legal when min_gain_to_split < 0) fails
+            # its own ratio test and the while_loop never makes progress.
+            can = can & ((j == 0) | (top_g >= opts.leaf_batch_ratio * top_g[0]))
         lslot = 2 * (st["n_splits"] + j) + 1
         rslot = lslot + 1
         # Guarded scatter indices: disabled lanes write out of range (m) and

@@ -385,3 +385,19 @@ class TestLeafBatchRatio:
         )
         # every tree still reaches the leaf budget when data supports it
         assert (np.asarray(r.booster.is_leaf).sum(axis=1) == 15).all()
+
+    def test_negative_min_gain_terminates(self):
+        """A negative min_gain_to_split (legal on a directly-constructed
+        TrainOptions) combined with leaf_batch_ratio must still make
+        progress: the pass best always qualifies for its own ratio gate,
+        so the while_loop cannot spin on an uncommittable frontier."""
+        X, y = _make_binary(n=400)
+        bins, mapper = bin_dataset(X, max_bin=15)
+        r = train(
+            bins, y,
+            TrainOptions(objective="binary", num_iterations=2, num_leaves=7,
+                         max_bin=15, min_gain_to_split=-5.0,
+                         leaf_batch=4, leaf_batch_ratio=0.5),
+            mapper=mapper,
+        )
+        assert r.booster.num_trees == 2

@@ -292,3 +292,42 @@ def test_ranker_round_trip():
     np.testing.assert_allclose(
         b2.raw_margin(X), b.raw_margin(X), rtol=1e-5, atol=1e-6
     )
+
+
+def test_imported_f64_thresholds_route_like_lightgbm():
+    """Imported thresholds stay float64 and predict snaps them DOWN to f32,
+    so f32 feature values falling between an f64 threshold and its
+    round-to-nearest f32 narrowing route exactly as native LightGBM's f64
+    comparison would."""
+    # a threshold strictly between two adjacent f32 values, closer to the
+    # UPPER one (round-to-nearest would round up past it)
+    lo = np.float32(1.0)
+    hi = np.nextafter(lo, np.float32(2.0))
+    thr64 = float(lo) + 0.75 * (float(hi) - float(lo))
+    assert np.float32(thr64) == hi  # round-to-nearest narrows UP
+    text = "\n".join([
+        "tree", "version=v3", "num_class=1", "num_tree_per_iteration=1",
+        "label_index=0", "max_feature_idx=0", "objective=regression",
+        "feature_names=f0", "feature_infos=[0:2]", "tree_sizes=0", "",
+        "Tree=0", "num_leaves=2", "num_cat=0", "split_feature=0",
+        "split_gain=1", f"threshold={thr64!r}", "decision_type=10",
+        "left_child=-1", "right_child=-2", "leaf_value=-1 1",
+        "leaf_weight=1 1", "leaf_count=1 1", "internal_value=0",
+        "internal_weight=2", "internal_count=2", "is_linear=0",
+        "shrinkage=1", "", "", "end of trees", "",
+        "pandas_categorical:null", "",
+    ])
+    b = from_lightgbm_text(text)
+    assert b.split_threshold.dtype == np.float64
+    # x = hi is ABOVE thr64, so LightGBM routes it right (leaf value 1);
+    # a round-to-nearest f32 threshold (== hi) would wrongly route it left.
+    X = np.array([[float(lo)], [float(hi)]], dtype=np.float64)
+    out = b.raw_margin(X)[:, 0]
+    assert out[0] == -1.0  # lo <= thr64 -> left
+    assert out[1] == 1.0   # hi > thr64 -> right (fails if narrowing rounds up)
+    # the JSON round-trip preserves the f64 dtype
+    b2 = type(b).from_string(b.to_json_string())
+    assert b2.split_threshold.dtype == np.float64
+    # TreeSHAP must use the same snapped comparison grid as predict, or
+    # additivity breaks on exactly these straddling thresholds
+    np.testing.assert_allclose(b.features_shap(X).sum(axis=-1)[:, 0], out)

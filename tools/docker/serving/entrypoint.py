@@ -19,13 +19,22 @@ def main() -> int:
     ap.add_argument("--model", required=True, help="path saved via stage.save()")
     ap.add_argument("--host", default="0.0.0.0", help="bind address")
     ap.add_argument("--port", type=int, default=8890)
-    ap.add_argument("--registry-port", type=int, default=8891)
+    ap.add_argument("--registry-port", type=int, default=8899)
     ap.add_argument("--servers", type=int, default=1, help="listener count")
     ap.add_argument("--input-col", default="input")
     ap.add_argument("--output-col", default="prediction")
     ap.add_argument("--max-batch", type=int, default=64)
     ap.add_argument("--max-latency-ms", type=float, default=2.0)
     args = ap.parse_args()
+
+    # Listeners bind port..port+servers-1; a registry port inside that range
+    # would EADDRINUSE against listener i = registry_port - port at startup.
+    if args.port <= args.registry_port < args.port + max(1, args.servers):
+        ap.error(
+            f"--registry-port {args.registry_port} collides with the listener "
+            f"range {args.port}..{args.port + max(1, args.servers) - 1}; "
+            "pick a registry port outside it"
+        )
 
     from mmlspark_tpu.core.serialize import load_stage
     from mmlspark_tpu.serving import (
