@@ -1,0 +1,114 @@
+"""A/B: precomputed-U histogram pass vs the compare-built panel kernel.
+
+Run ON the real chip, idle machine, one TPU process:
+
+    python benchmarks/hist_u_ab.py [N] [F] [B] [K_NODES]
+
+Measurement discipline (memory: axon tunnel): every timed op runs inside a
+jitted 20-iteration ``fori_loop`` whose input is perturbed per iteration
+(or XLA hoists the loop-invariant call), synced by fetching a small slice.
+"""
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from mmlspark_tpu.ops.histogram import build_histograms
+from mmlspark_tpu.ops.u_histogram import (
+    build_histograms_u,
+    build_u,
+    make_u_spec,
+    stat_rows,
+)
+
+N = int(sys.argv[1]) if len(sys.argv) > 1 else 409_600
+F = int(sys.argv[2]) if len(sys.argv) > 2 else 28
+B = int(sys.argv[3]) if len(sys.argv) > 3 else 256
+KN = int(sys.argv[4]) if len(sys.argv) > 4 else 8
+# 200, NOT 20: the tunnel's ~100 ms sync-fetch latency adds ~5 ms/iter to a
+# 20-rep loop (the round-3 inflation documented in docs/perf_histogram.md)
+REPS = int(sys.argv[5]) if len(sys.argv) > 5 else 200
+
+
+def sync(x):
+    return np.asarray(x.reshape(-1)[:4])
+
+
+def timed(make_loop, *args, label=""):
+    loop = jax.jit(make_loop)
+    sync(loop(*args))  # compile
+    t0 = time.perf_counter()
+    sync(loop(*args))
+    dt = (time.perf_counter() - t0) / REPS * 1000
+    print(f"{label:40s} {dt:8.2f} ms/pass")
+    return dt
+
+
+def main():
+    rng = np.random.default_rng(0)
+    bins = rng.integers(0, B, size=(N, F)).astype(np.uint8)
+    g = rng.normal(size=N).astype(np.float32)
+    h = rng.uniform(0.1, 1.0, size=N).astype(np.float32)
+    c = np.ones(N, np.float32)
+    node = rng.integers(0, KN, size=N).astype(np.int32)
+
+    bins_d = jnp.asarray(bins)
+    g_d, h_d, c_d = jnp.asarray(g), jnp.asarray(h), jnp.asarray(c)
+    node_d = jnp.asarray(node)
+    spec = make_u_spec(B, F)
+    print(f"N={N} F={F} B={B} nodes={KN} K_pad={spec.k_pad} "
+          f"U_int8={spec.k_pad * N / 1e9:.2f} GB backend={jax.default_backend()}")
+
+    # --- baseline: compare-built panel kernel (the previous hot path)
+    def loop_cmp(bins_, g_, h_, c_, node_):
+        def body(i, acc):
+            gi = g_ * (1 + i.astype(jnp.float32) * 1e-9)
+            hist = build_histograms(bins_, gi, h_, c_, node_, KN, B, method="pallas")
+            return acc + hist[0, 0, 0, 0]
+
+        return lax.fori_loop(0, REPS, body, jnp.float32(0.0))
+
+    t_cmp = timed(loop_cmp, bins_d, g_d, h_d, c_d, node_d,
+                  label="compare-built panel kernel")
+
+    # --- U build (once per fit) — ONE jitted callable, warm timing
+    build8 = jax.jit(lambda b_: build_u(b_, spec, jnp.int8))
+    u8 = build8(bins_d)
+    sync(u8)
+    t0 = time.perf_counter()
+    u8 = build8(bins_d)
+    sync(u8)
+    print(f"{'U build (int8, warm)':40s} "
+          f"{(time.perf_counter() - t0) * 1000:8.2f} ms once/fit")
+
+    # --- U pass, per-pass stat build vs per-tree hoisted stat rows
+    def loop_u(hoist_stats):
+        def fn(u_, g_, h_, c_, node_):
+            pre = stat_rows(g_, h_, c_) if hoist_stats else None
+
+            def body(i, acc):
+                gi = g_ * (1 + i.astype(jnp.float32) * 1e-9)
+                hist = build_histograms_u(
+                    u_, gi, h_, c_, node_ + (i % 2), KN, spec,
+                    stats=pre,
+                )
+                return acc + hist[0, 0, 0, 0]
+
+            return lax.fori_loop(0, REPS, body, jnp.float32(0.0))
+
+        return fn
+
+    t_u = timed(loop_u(False), u8, g_d, h_d, c_d, node_d,
+                label="U pass (stats built per pass)")
+    t_uh = timed(loop_u(True), u8, g_d, h_d, c_d, node_d,
+                 label="U pass (stat rows hoisted per tree)")
+
+    print(f"speedup vs compare-built: {t_cmp / min(t_u, t_uh):.2f}x")
+
+
+if __name__ == "__main__":
+    main()

@@ -118,6 +118,26 @@ def apply_bins(X: np.ndarray, mapper: BinMapper) -> np.ndarray:
     return out
 
 
+def bin_dataset_to_device(
+    X: np.ndarray,
+    max_bin: int = 255,
+    mapper: Optional[BinMapper] = None,
+):
+    """Bin on the host, then dispatch ONE asynchronous ``jax.device_put`` —
+    the transfer flies while the caller sets up the rest of the fit
+    (remote-attached chips pay ~0.3-0.45 s of fixed cost PER transfer, so
+    chunked uploads measured strictly slower than one shot). Returns
+    (device_bins uint8 (N, F), mapper); feed the device array straight to
+    :func:`~mmlspark_tpu.lightgbm.train.train` (it skips its own upload
+    for device-resident bins)."""
+    import jax
+
+    X = np.asarray(X, dtype=np.float64)
+    if mapper is None:
+        mapper = fit_bin_mapper(X, max_bin=max_bin)
+    return jax.device_put(np.ascontiguousarray(apply_bins(X, mapper))), mapper
+
+
 def bin_dataset(
     X, max_bin: int = 255, mapper: Optional[BinMapper] = None
 ) -> Tuple[np.ndarray, BinMapper]:

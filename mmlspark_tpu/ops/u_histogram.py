@@ -1,0 +1,196 @@
+"""Precomputed-U histogram pass: hoist the one-hot build out of the hot loop.
+
+The compare-built histogram kernels (``ops/pallas_histogram.py``) pay the
+VPU one-hot construction — the binding resource of the op
+(``docs/perf_histogram.md``) — on EVERY pass. But bins are static across a
+fit: the one-hot matrix ``U[off_f + b, i] = (bins[i, f] == b)`` can be built
+ONCE on device (int8, transposed so rows ride the lane dimension) and every
+histogram pass becomes one MXU contraction against the node-keyed stat panel
+
+    hist[col, d] = sum_i U[col, i] * panel[d, i]        (K, 3k) = U @ panelᵀ
+
+an "NT" matmul with BOTH operands' contraction on their lane axis — no
+relayout anywhere in the hot loop. That layout discipline is the whole
+game on this toolchain: every (N,) -> (N, D) lane-broadcast or f32->int8
+convert of row vectors measured 3-5 ms by itself (sublane<->lane shuffles),
+as much as the dot. Measured at the bench hot shape (400k x 28 x 256, 8
+nodes, v5e): 4.9 ms vs 12.7 ms for the compare-built panel kernel — the
+one-hot is s8 (exact 0/1), the panel bf16, f32 accumulation: the IDENTICAL
+precision model as the compare-built kernel's default MXU pass, so split
+decisions and histogram sums agree in distribution (both: g/h bf16 input
+rounding, counts exact).
+
+This is the TPU analogue of the reference engine's bin-major feature
+groups (its native dataset also fixes the bin layout once,
+``lightgbm/LightGBMUtils.scala:212-239``) — pay the layout once, stream it
+every pass.
+
+Feature packing rides in the U row layout: feature f owns rows
+``[off_f, off_f + width_f)`` where ``width_f`` is its ACTUAL bin count
+(``BinMapper.num_bins``), so K = sum_f width_f, not F * max_bin — on real
+datasets with low-cardinality features U (and the HBM re-stream that bounds
+the pass) shrinks proportionally. A static (F, max_bin) gather map expands
+the packed result back to the dense (k, F, B, 3) histogram the split search
+consumes.
+
+Memory: U is fit-resident HBM (K_pad · N_pad bytes as int8). Callers gate
+on :func:`u_bytes` — at 400k x 28 x 256 that is ~2.9 GB (fine on 16 GB
+v5e), at 4M it would be 29 GB (gate fails, compare-built kernels take
+over).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+_LANE = 128
+_N_ALIGN = 512  # row padding granularity (lane-dim alignment for U tiles)
+
+
+@dataclasses.dataclass(frozen=True)
+class USpec:
+    """Static host-side description of the packed one-hot layout (hashable:
+    part of the jitted-program cache key)."""
+
+    widths: Tuple[int, ...]  # per-feature bin count (incl. missing bin)
+    offsets: Tuple[int, ...]  # per-feature first packed row of U
+    k: int  # sum of widths
+    k_pad: int  # k rounded up to the sublane block
+    num_bins: int  # dense histogram width B the caller expects
+
+    @property
+    def num_features(self) -> int:
+        return len(self.widths)
+
+
+def make_u_spec(num_bins: int, num_features: int, per_feature=None) -> USpec:
+    """``per_feature`` = BinMapper.num_bins (actual per-feature widths);
+    None = uniform ``num_bins`` (no mapper — e.g. pre-binned input)."""
+    if per_feature is None:
+        widths = [num_bins] * num_features
+    else:
+        widths = [int(min(max(w, 1), num_bins)) for w in per_feature]
+    offsets = np.concatenate([[0], np.cumsum(widths[:-1])]).astype(int)
+    k = int(np.sum(widths))
+    k_pad = ((k + _LANE - 1) // _LANE) * _LANE
+    return USpec(
+        widths=tuple(widths), offsets=tuple(int(o) for o in offsets),
+        k=k, k_pad=k_pad, num_bins=num_bins,
+    )
+
+
+def u_bytes(n_rows: int, spec: USpec) -> int:
+    """Resident HBM cost of the int8 U for ``n_rows`` (pre-padding)."""
+    n_pad = ((n_rows + _N_ALIGN - 1) // _N_ALIGN) * _N_ALIGN
+    return n_pad * spec.k_pad
+
+
+def build_u(bins: jax.Array, spec: USpec, dtype=jnp.int8) -> jax.Array:
+    """(K_pad, N_pad) TRANSPOSED one-hot of the packed bin ids — ONE compare
+    pass's worth of VPU work (~120 ms at 400k x 28 x 256), paid once per
+    fit. The bin axis leads so (a) the build concatenates feature blocks on
+    the MAJOR axis (contiguous; the (N, K) layout's minor-axis concat
+    measured ~10x slower) and (b) the pass contraction is lane-on-lane.
+    Pad rows carry bin id -1 (match no U row, contribute nothing)."""
+    n, f = bins.shape
+    pad = (-n) % _N_ALIGN
+    ids = bins.astype(jnp.int32)
+    if pad:
+        ids = jnp.pad(ids, ((0, pad), (0, 0)), constant_values=-1)
+    ids_t = ids.T  # (F, N_pad)
+    rows = []
+    for j in range(f):
+        w = spec.widths[j]
+        oh = (
+            jnp.arange(w, dtype=jnp.int32)[:, None] == ids_t[j][None, :]
+        ).astype(dtype)
+        rows.append(oh)
+    tail = spec.k_pad - spec.k
+    if tail:
+        rows.append(jnp.zeros((tail, n + pad), dtype))
+    return jnp.concatenate(rows, axis=0)
+
+
+def _dense_maps(spec: USpec) -> Tuple[np.ndarray, np.ndarray]:
+    """(F, B) packed-row gather map + validity mask for expanding the packed
+    (K, D) result into the dense (F, B, D) histogram."""
+    f, b = spec.num_features, spec.num_bins
+    idx = np.zeros((f, b), np.int32)
+    mask = np.zeros((f, b), np.float32)
+    for j in range(f):
+        w = spec.widths[j]
+        idx[j, :w] = spec.offsets[j] + np.arange(w)
+        mask[j, :w] = 1.0
+    return idx, mask
+
+
+@functools.lru_cache(maxsize=64)
+def _dense_maps_cached(spec: USpec):
+    return _dense_maps(spec)
+
+
+def stat_rows(grad: jax.Array, hess: jax.Array, count: jax.Array) -> jax.Array:
+    """(3, N) bf16 stat stack [g; h; c] in the row-on-lanes layout the panel
+    wants. Node-independent — build it ONCE per tree and reuse across every
+    pass of that tree (g/h/c are fixed within a tree)."""
+    return jnp.stack(
+        [grad, hess, count], axis=0
+    ).astype(jnp.bfloat16)
+
+
+def build_histograms_u(
+    u: jax.Array,  # (K_pad, N_pad) int8 from build_u
+    grad: jax.Array,  # (N,) — ignored when stats is given
+    hess: jax.Array,
+    count: jax.Array,
+    node: jax.Array,  # (N,) int32; out-of-range => row contributes nothing
+    num_nodes: int,
+    spec: USpec,
+    *,
+    stats: Optional[jax.Array] = None,  # (3, N) bf16 from stat_rows()
+) -> jax.Array:
+    """(num_nodes, F, B, 3) float32 — same contract as
+    ``ops.histogram.build_histograms`` but with the one-hot precomputed.
+
+    The per-pass work is: a (3k, N) transposed panel (node-key select over
+    the stat rows, built entirely in the row-on-lanes layout) and one
+    s8 x bf16 NT matmul. Precision model = the compare-built kernel's
+    default MXU pass (bf16 inputs, f32 accumulation; counts exact)."""
+    if 3 * num_nodes > _LANE:
+        raise ValueError(f"panel width 3*{num_nodes} exceeds one lane group")
+    k = num_nodes
+    n = node.shape[0]
+    n_pad = u.shape[1]
+
+    if stats is None:
+        stats = stat_rows(grad, hess, count)
+    # (3k, N) stat-major transposed panel: row s*k+j carries stat s for rows
+    # whose node key is j, 0 elsewhere. node broadcasts across SUBLANES
+    # (cheap); no lane-dim relayout anywhere.
+    key = jnp.tile(jnp.arange(k, dtype=jnp.int32), 3)[:, None]  # (3k, 1)
+    mask_t = key == node.astype(jnp.int32)[None, :]  # (3k, N)
+    vals_t = jnp.repeat(stats, k, axis=0)  # (3k, N) bf16
+    panel_t = jnp.where(mask_t, vals_t, jnp.bfloat16(0))
+    if n_pad != n:
+        panel_t = jnp.pad(panel_t, ((0, 0), (0, n_pad - n)))
+    # Materialize: without the barrier XLA re-fuses the panel build into the
+    # dot's rhs load and recomputes it per K-tile (measured ~2x slower).
+    panel_t = lax.optimization_barrier(panel_t)
+
+    packed = lax.dot_general(
+        u.astype(jnp.bfloat16), panel_t,
+        (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32,
+    )  # (K_pad, 3k)
+
+    f, b = spec.num_features, spec.num_bins
+    idx, mask = _dense_maps_cached(spec)
+    dense = packed[jnp.asarray(idx).reshape(-1)].reshape(f, b, 3 * k)
+    dense = dense * jnp.asarray(mask)[:, :, None]
+    return dense.reshape(f, b, 3, k).transpose(3, 0, 1, 2)

@@ -1,0 +1,175 @@
+"""Precomputed-U histogram path (ops/u_histogram.py) and its train wiring.
+
+The U pass replaces the reference engine's per-iteration native histogram
+construction (``lightgbm/TrainUtils.scala:220-315``) with one MXU
+contraction against a fit-resident one-hot; these tests pin (a) numerical
+agreement with the bf16-input reference model, (b) exact counts, (c) the
+packed per-feature-width layout, and (d) end-to-end training parity when
+the path is forced on CPU (``histogram_method='u'``)."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from mmlspark_tpu.lightgbm.binning import bin_dataset
+from mmlspark_tpu.lightgbm.objectives import auc
+from mmlspark_tpu.lightgbm.train import TrainOptions, train
+from mmlspark_tpu.ops.histogram import build_histograms
+from mmlspark_tpu.ops.u_histogram import (
+    build_histograms_u,
+    build_u,
+    make_u_spec,
+    stat_rows,
+    u_bytes,
+)
+
+
+def _mixed_case(seed=0, n=3000, k=5):
+    rng = np.random.default_rng(seed)
+    widths = [32, 5, 17, 32, 2, 9, 31]
+    f, b = len(widths), 32
+    bins = np.stack(
+        [rng.integers(0, w, size=n) for w in widths], axis=1
+    ).astype(np.int32)
+    g = rng.normal(size=n).astype(np.float32)
+    h = rng.uniform(0.1, 1, size=n).astype(np.float32)
+    c = (rng.uniform(size=n) > 0.2).astype(np.float32)
+    node = rng.integers(-1, k + 2, size=n).astype(np.int32)  # incl. OOR keys
+    return widths, f, b, bins, g, h, c, node
+
+
+class TestUHistogram:
+    def test_matches_bf16_reference_and_counts_exact(self):
+        widths, f, b, bins, g, h, c, node = _mixed_case()
+        k = 5
+        m = ((node >= 0) & (node < k)).astype(np.float32)
+        bf = lambda a: np.asarray(jnp.asarray(a, jnp.bfloat16), np.float32)
+        # reference: exact sums of bf16-rounded inputs — the precision model
+        # of the MXU pass (bf16 inputs, f32 accumulation)
+        ref = np.asarray(build_histograms(
+            jnp.asarray(bins), jnp.asarray(bf(g) * m), jnp.asarray(bf(h) * m),
+            jnp.asarray(c * m), jnp.asarray(np.clip(node, 0, k - 1)), k, b,
+            method="segment",
+        ))
+        spec = make_u_spec(b, f, per_feature=widths)
+        assert spec.k == sum(widths)  # packed, not f*b
+        u = build_u(jnp.asarray(bins), spec)
+        assert u.shape[0] == spec.k_pad
+        for stats in (None, stat_rows(jnp.asarray(g), jnp.asarray(h), jnp.asarray(c))):
+            out = np.asarray(build_histograms_u(
+                u, jnp.asarray(g), jnp.asarray(h), jnp.asarray(c),
+                jnp.asarray(node), k, spec, stats=stats,
+            ))
+            np.testing.assert_array_equal(out[..., 2], ref[..., 2])  # counts
+            np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-4)
+
+    def test_out_of_range_nodes_are_the_in_leaf_mask(self):
+        widths, f, b, bins, g, h, c, node = _mixed_case(seed=3)
+        spec = make_u_spec(b, f, per_feature=widths)
+        u = build_u(jnp.asarray(bins), spec)
+        k = 4
+        out = np.asarray(build_histograms_u(
+            u, jnp.asarray(g), jnp.asarray(h), jnp.asarray(c),
+            jnp.asarray(node), k, spec,
+        ))
+        in_range = (node >= 0) & (node < k)
+        # total count over all cells of feature 0 == rows with in-range keys
+        assert out[:, 0, :, 2].sum() == (c * in_range).sum()
+
+    def test_panel_width_guard(self):
+        widths, f, b, bins, g, h, c, node = _mixed_case()
+        spec = make_u_spec(b, f, per_feature=widths)
+        u = build_u(jnp.asarray(bins), spec)
+        with pytest.raises(ValueError, match="lane group"):
+            build_histograms_u(
+                u, jnp.asarray(g), jnp.asarray(h), jnp.asarray(c),
+                jnp.asarray(node), 64, spec,
+            )
+
+    def test_u_bytes_budget(self):
+        spec = make_u_spec(256, 28)
+        assert u_bytes(400_000, spec) == 400_384 * spec.k_pad  # 512-aligned rows
+
+
+class TestUTrainParity:
+    def test_forced_u_path_matches_default(self):
+        rng = np.random.default_rng(0)
+        n = 3000
+        X = rng.normal(size=(n, 8))
+        y = ((X[:, 0] * 1.5 + X[:, 1] * X[:, 2]) > 0).astype(np.float64)
+        bins, mp = bin_dataset(X, max_bin=63)
+        base = dict(objective="binary", num_iterations=6, num_leaves=15, max_bin=63)
+        r0 = train(bins, y, TrainOptions(**base), mapper=mp)
+        ru = train(bins, y, TrainOptions(**base, histogram_method="u"), mapper=mp)
+        a0 = auc(y, r0.booster.raw_margin(X)[:, 0], np.ones(n))
+        au = auc(y, ru.booster.raw_margin(X)[:, 0], np.ones(n))
+        # CPU default path is exact f32; the U path is the bf16 MXU model —
+        # structurally near-identical trees, AUC within noise
+        assert abs(a0 - au) < 0.005, (a0, au)
+
+    @pytest.mark.parametrize("variant", ["depthwise", "goss", "bagging", "multiclass"])
+    def test_u_path_boosting_variants(self, variant):
+        rng = np.random.default_rng(1)
+        n = 2000
+        X = rng.normal(size=(n, 6))
+        if variant == "multiclass":
+            y = np.digitize(X[:, 0], [-0.5, 0.5]).astype(np.float64)
+            extra = dict(objective="multiclass", num_class=3)
+        else:
+            y = (X[:, 0] + X[:, 1] > 0).astype(np.float64)
+            extra = dict(objective="binary")
+        if variant == "depthwise":
+            extra.update(growth="depthwise", max_depth=4)
+        elif variant == "goss":
+            extra.update(boosting_type="goss")
+        elif variant == "bagging":
+            extra.update(bagging_fraction=0.7, bagging_freq=1)
+        bins, mp = bin_dataset(X, max_bin=31)
+        r = train(
+            bins, y,
+            TrainOptions(num_iterations=4, num_leaves=7, max_bin=31,
+                         histogram_method="u", **extra),
+            mapper=mp,
+        )
+        margins = r.booster.raw_margin(X)
+        if variant == "multiclass":
+            acc = (margins.argmax(1) == y).mean()
+            assert acc > 0.7, acc
+        else:
+            a = auc(y, margins[:, 0], np.ones(n))
+            assert a > 0.85, a
+
+    def test_device_resident_bins_accepted(self):
+        from mmlspark_tpu.lightgbm.binning import bin_dataset_to_device
+
+        rng = np.random.default_rng(2)
+        n = 1500
+        X = rng.normal(size=(n, 5))
+        y = (X[:, 0] > 0).astype(np.float64)
+        bins_np, mp = bin_dataset(X, max_bin=31)
+        bins_dev, mp2 = bin_dataset_to_device(X, max_bin=31)
+        np.testing.assert_array_equal(np.asarray(bins_dev), bins_np)
+        np.testing.assert_array_equal(mp2.edges, mp.edges)
+        opts = TrainOptions(objective="binary", num_iterations=3,
+                            num_leaves=7, max_bin=31)
+        r_np = train(bins_np, y, opts, mapper=mp)
+        r_dev = train(bins_dev, y, opts, mapper=mp2)
+        np.testing.assert_allclose(
+            r_dev.booster.leaf_values, r_np.booster.leaf_values, rtol=1e-6
+        )
+
+    def test_forced_u_with_voting_parallel_degrades_gracefully(self):
+        rng = np.random.default_rng(3)
+        n = 1200
+        X = rng.normal(size=(n, 5))
+        y = (X[:, 0] > 0).astype(np.float64)
+        bins, mp = bin_dataset(X, max_bin=31)
+        r = train(
+            bins, y,
+            TrainOptions(objective="binary", num_iterations=3, num_leaves=7,
+                         max_bin=31, histogram_method="u",
+                         tree_learner="voting_parallel", top_k=3),
+            mapper=mp,
+        )
+        a = auc(y, r.booster.raw_margin(X)[:, 0], np.ones(n))
+        assert a > 0.85, a
