@@ -102,7 +102,7 @@ def _predict_throughput_tpu(booster, X, reps=10):
     )
 
     t = booster._used_trees(None)
-    feats, thrs, P, plen, lvals, _, nanl = _paths_cache(booster, t)
+    feats, thrs, P, plen, lvals, _, nanl, _ = _paths_cache(booster, t)
     Xd = jnp.asarray(X, jnp.float32)
     cargs = [jnp.asarray(a) for a in (feats, thrs, nanl, P, plen, lvals)]
     isc = jnp.asarray(booster.init_score)

@@ -122,6 +122,10 @@ def to_list_str(v: Any) -> list:
     return [to_str(x) for x in v]
 
 
+def to_list_int(v: Any) -> list:
+    return [to_int(x) for x in v]
+
+
 def in_range(lo: float, hi: float) -> Callable[[Any], bool]:
     return lambda v: lo <= v <= hi
 

@@ -30,6 +30,8 @@ from mmlspark_tpu.core.params import (
     to_bool,
     to_float,
     to_int,
+    to_list_int,
+    to_list_str,
     to_str,
 )
 from mmlspark_tpu.core.pipeline import Estimator, Model
@@ -104,6 +106,28 @@ class LightGBMParams(
         "time — docs/perf_histogram.md)",
         default=0.0, converter=to_float, validator=in_range(0, 1),
     )
+    categoricalSlotIndexes = Param(
+        "Feature indexes treated as categorical (value-identity bins + "
+        "LightGBM sorted-set split search)",
+        default=[], converter=to_list_int,
+    )
+    categoricalSlotNames = Param(
+        "Feature names treated as categorical (resolved against the "
+        "assembled feature names, e.g. 'f3')",
+        default=[], converter=to_list_str,
+    )
+    maxCatThreshold = Param(
+        "Max categories in a categorical split's left set",
+        default=32, converter=to_int, validator=gt(0),
+    )
+    catSmooth = Param(
+        "Smoothing for the categorical g/h bin ordering",
+        default=10.0, converter=to_float, validator=ge(0),
+    )
+    catL2 = Param(
+        "Extra L2 applied to categorical split gains",
+        default=10.0, converter=to_float, validator=ge(0),
+    )
     numBatches = Param("Split training into sequential batches (0=off)", default=0, converter=to_int, validator=ge(0))
     modelString = Param("Warm-start booster string", default="", converter=to_str)
     verbosity = Param("Verbosity", default=-1, converter=to_int)
@@ -154,6 +178,9 @@ class LightGBMParams(
             top_rate=self.getTopRate(),
             other_rate=self.getOtherRate(),
             drop_rate=self.getDropRate(),
+            max_cat_threshold=self.getMaxCatThreshold(),
+            cat_smooth=self.getCatSmooth(),
+            cat_l2=self.getCatL2(),
         )
         kwargs.update(self._extra_train_options())
         return TrainOptions(**kwargs)
@@ -240,7 +267,30 @@ class LightGBMBase(LightGBMParams, Estimator):
         num_class = self._num_classes(y)
         opts = self._make_options(num_class)
 
-        bins, mapper = bin_dataset(X, max_bin=opts.max_bin)
+        # Categorical slot resolution (LightGBMBase.scala:148-156): indexes
+        # union names resolved against the assembled feature names f0..fN.
+        cat_slots = set(self.getCategoricalSlotIndexes() or [])
+        names = self.getCategoricalSlotNames() or []
+        num_features = X.shape[1] if hasattr(X, "shape") else X.num_features
+        bad = sorted(i for i in cat_slots if not (0 <= i < num_features))
+        if bad:
+            raise ValueError(
+                f"categoricalSlotIndexes out of range for {num_features} "
+                f"features: {bad}"
+            )
+        if names:
+            name_to_idx = {f"f{i}": i for i in range(num_features)}
+            for nm in names:
+                if nm not in name_to_idx:
+                    raise ValueError(
+                        f"categoricalSlotNames: unknown feature name {nm!r}"
+                    )
+                cat_slots.add(name_to_idx[nm])
+
+        bins, mapper = bin_dataset(
+            X, max_bin=opts.max_bin,
+            categorical_features=sorted(cat_slots) or None,
+        )
         valid_sets = []
         if valid_table is not None and valid_table.num_rows > 0:
             Xv, yv, wv, _ = self._prepare(valid_table, num_features=X.shape[1])
@@ -327,6 +377,14 @@ def _ensemble_margin(boosters: List[Booster], bins: np.ndarray, mapper: BinMappe
                     jnp.asarray(b.right_child[t]),
                     jnp.asarray(b.is_leaf[t]),
                     b.max_depth,
+                    cat_node=(
+                        None if b.cat_nodes is None
+                        else jnp.asarray(b.cat_nodes[t])
+                    ),
+                    cat_mask=(
+                        None if b.cat_masks is None
+                        else jnp.asarray(b.cat_masks[t])
+                    ),
                 )
                 m = m.at[:, t % b.num_classes].add(jnp.asarray(b.leaf_values[t])[leaf])
             return m
@@ -364,6 +422,9 @@ def _merge_boosters(boosters: List[Booster]) -> Booster:
         best_iteration=-1,
         feature_names=first.feature_names,
         bin_edges=first.bin_edges,
+        cat_nodes=cat("cat_nodes"),
+        cat_masks=cat("cat_masks"),
+        cat_values=first.cat_values,
     )
 
 

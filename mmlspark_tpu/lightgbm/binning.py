@@ -25,15 +25,32 @@ MISSING_BIN = 0
 class BinMapper:
     """Per-feature quantile bin edges. ``edges[f]`` has shape (max_bin-1,);
     value v maps to bin ``1 + searchsorted(edges[f], v, 'left')`` (bin 0 = NaN).
-    ``upper[f][b]`` is the raw-value threshold meaning "bin <= b goes left"."""
+    ``upper[f][b]`` is the raw-value threshold meaning "bin <= b goes left".
+
+    Categorical features (``categoricalSlotIndexes``/``Names``, reference
+    ``lightgbm/LightGBMParams.scala:125-133``) bin by VALUE IDENTITY instead:
+    each of the up to ``max_bin - 1`` most frequent category values owns one
+    bin (``cat_values[f][b-1]`` is bin b's raw value, a bijection), and any
+    other/unseen/NaN value maps to the missing bin 0 — which categorical
+    split search treats as "not in any left set" (routes right), matching
+    LightGBM's unseen-category behavior."""
 
     edges: np.ndarray  # (F, max_bin-1) float64, padded with +inf
     num_bins: np.ndarray  # (F,) actual bin count per feature (incl. missing bin)
     max_bin: int
+    # feature index -> sorted-by-frequency raw category values (bin i+1 <-> v[i])
+    cat_values: Optional[dict] = None
 
     @property
     def num_features(self) -> int:
         return self.edges.shape[0]
+
+    @property
+    def categorical_features(self):
+        return sorted(self.cat_values) if self.cat_values else []
+
+    def is_categorical(self, feature: int) -> bool:
+        return bool(self.cat_values) and feature in self.cat_values
 
     def threshold_value(self, feature: int, bin_idx: int) -> float:
         """Raw-value decision threshold for 'go left if x <= t' at bin_idx."""
@@ -45,10 +62,13 @@ def fit_bin_mapper(
     max_bin: int = 255,
     sample_cnt: int = 200_000,
     seed: int = 0,
+    categorical_features=None,
 ) -> BinMapper:
     """Compute per-feature quantile edges (LightGBM ``bin_construct_sample_cnt``
-    defaults to 200k sampled rows)."""
+    defaults to 200k sampled rows). ``categorical_features``: indices binned
+    by value identity (one bin per frequent category)."""
     n, f = X.shape
+    cat_set = set(int(c) for c in (categorical_features or []))
     if n > sample_cnt:
         rng = np.random.default_rng(seed)
         idx = rng.choice(n, size=sample_cnt, replace=False)
@@ -58,10 +78,20 @@ def fit_bin_mapper(
     # max_bin usable value bins (bin 0 reserved for missing) -> max_bin-1 edges.
     edges = np.full((f, max_bin - 1), np.inf, dtype=np.float64)
     num_bins = np.zeros(f, dtype=np.int32)
+    cat_values: dict = {}
     qs = np.linspace(0, 1, max_bin)
     for j in range(f):
         col = sample[:, j]
         col = col[~np.isnan(col)]
+        if j in cat_set:
+            u, counts = np.unique(col, return_counts=True)
+            # most frequent first (ties by value — deterministic); capacity
+            # max_bin - 1 value bins; the rest fall to missing (-> right)
+            order = np.lexsort((u, -counts))
+            vals = u[order][: max_bin - 1]
+            cat_values[j] = np.asarray(vals, dtype=np.float64)
+            num_bins[j] = len(vals) + 1  # + missing bin
+            continue
         if col.size == 0:
             num_bins[j] = 1
             continue
@@ -70,7 +100,9 @@ def fit_bin_mapper(
         k = len(e)
         edges[j, :k] = e
         num_bins[j] = k + 2  # +1 missing bin, +1 overflow bin above last edge
-    return _snap_edges(edges, num_bins, max_bin)
+    mapper = _snap_edges(edges, num_bins, max_bin)
+    mapper.cat_values = cat_values or None
+    return mapper
 
 
 def _edges_from_counts(
@@ -96,18 +128,41 @@ def _snap_edges(edges: np.ndarray, num_bins: np.ndarray, max_bin: int) -> BinMap
     return BinMapper(edges=edges, num_bins=num_bins, max_bin=max_bin)
 
 
+def cat_to_bins(col: np.ndarray, values: np.ndarray) -> np.ndarray:
+    """Raw category column -> bin ids: value ``values[i]`` -> bin ``i+1``;
+    NaN/unseen -> missing bin 0. The ONE definition of categorical bin
+    assignment (train, predict, and SHAP must agree)."""
+    order = np.argsort(values, kind="stable")
+    sv = values[order]
+    col = np.asarray(col, dtype=np.float64)
+    pos = np.searchsorted(sv, col)
+    pos = np.clip(pos, 0, len(sv) - 1) if len(sv) else np.zeros(len(col), np.int64)
+    hit = len(sv) > 0
+    match = (sv[pos] == col) if hit else np.zeros(len(col), bool)
+    bins = np.where(match, (order[pos] + 1) if hit else 0, MISSING_BIN)
+    return np.where(np.isnan(col), MISSING_BIN, bins).astype(np.int64)
+
+
 def apply_bins(X: np.ndarray, mapper: BinMapper) -> np.ndarray:
     """Map raw features to uint8 bin indices (row-major (N, F) uint8).
     Uses the host C++ library when built (bit-identical contract,
-    ``native/mmlspark_native.cpp``); numpy otherwise."""
+    ``native/mmlspark_native.cpp``); numpy otherwise. Categorical columns
+    are overlaid afterwards (value-identity bins, ``cat_to_bins``)."""
     from mmlspark_tpu.native import apply_bins_native
 
     native = apply_bins_native(np.asarray(X, dtype=np.float64), mapper.edges, mapper.max_bin)
     if native is not None:
+        if mapper.cat_values:
+            native = np.array(native, copy=True)
+            for j, vals in mapper.cat_values.items():
+                native[:, j] = cat_to_bins(X[:, j], vals).astype(np.uint8)
         return native
     n, f = X.shape
     out = np.zeros((n, f), dtype=np.uint8)
     for j in range(f):
+        if mapper.is_categorical(j):
+            out[:, j] = cat_to_bins(X[:, j], mapper.cat_values[j]).astype(np.uint8)
+            continue
         # float32 comparison grid — identical to the predict/SHAP paths.
         col = X[:, j].astype(np.float32)
         nan_mask = np.isnan(col)
@@ -122,6 +177,7 @@ def bin_dataset_to_device(
     X: np.ndarray,
     max_bin: int = 255,
     mapper: Optional[BinMapper] = None,
+    categorical_features=None,
 ):
     """Bin on the host, then dispatch ONE asynchronous ``jax.device_put`` —
     the transfer flies while the caller sets up the rest of the fit
@@ -134,22 +190,32 @@ def bin_dataset_to_device(
 
     X = np.asarray(X, dtype=np.float64)
     if mapper is None:
-        mapper = fit_bin_mapper(X, max_bin=max_bin)
+        mapper = fit_bin_mapper(
+            X, max_bin=max_bin, categorical_features=categorical_features
+        )
     return jax.device_put(np.ascontiguousarray(apply_bins(X, mapper))), mapper
 
 
 def bin_dataset(
-    X, max_bin: int = 255, mapper: Optional[BinMapper] = None
+    X, max_bin: int = 255, mapper: Optional[BinMapper] = None,
+    categorical_features=None,
 ) -> Tuple[np.ndarray, BinMapper]:
     from mmlspark_tpu.data.sparse import CSRMatrix
 
     if isinstance(X, CSRMatrix):
+        if categorical_features:
+            raise ValueError(
+                "categorical features are not supported on sparse (CSR) "
+                "input — densify the categorical columns first"
+            )
         if mapper is None:
             mapper = fit_bin_mapper_csr(X, max_bin=max_bin)
         return apply_bins_csr(X, mapper), mapper
     X = np.asarray(X, dtype=np.float64)
     if mapper is None:
-        mapper = fit_bin_mapper(X, max_bin=max_bin)
+        mapper = fit_bin_mapper(
+            X, max_bin=max_bin, categorical_features=categorical_features
+        )
     return apply_bins(X, mapper), mapper
 
 

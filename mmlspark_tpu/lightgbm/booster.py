@@ -74,6 +74,30 @@ class Booster:
     # None = all True (trees trained here always send missing left); imported
     # LightGBM models carry per-node directions from their decision_type.
     nan_left: Optional[np.ndarray] = None
+    # Categorical splits (reference LightGBMParams.scala:125-133): cat_nodes
+    # (T, M) bool marks categorical decisions; cat_masks (T, M, Bc) bool is
+    # the LEFT set over the feature's value-bin ids; cat_values maps feature
+    # -> sorted-by-frequency raw category values (bin i+1 <-> values[i]).
+    # A raw value not in cat_values (unseen/NaN) routes RIGHT, matching
+    # native LightGBM's unseen-category behavior.
+    cat_nodes: Optional[np.ndarray] = None
+    cat_masks: Optional[np.ndarray] = None
+    cat_values: Optional[Dict[int, np.ndarray]] = None
+
+    @property
+    def has_categorical(self) -> bool:
+        return self.cat_nodes is not None and bool(np.any(self.cat_nodes))
+
+    def _cat_binned(self, X: np.ndarray) -> np.ndarray:
+        """Replace categorical columns of a raw batch with their value-bin
+        ids (float) — the predict-side twin of training's binning, via the
+        shared ``cat_to_bins`` rule."""
+        from mmlspark_tpu.lightgbm.binning import cat_to_bins
+
+        Xp = np.array(X, dtype=np.float64, copy=True)
+        for f, vals in (self.cat_values or {}).items():
+            Xp[:, f] = cat_to_bins(X[:, f], np.asarray(vals, np.float64))
+        return Xp
 
     @property
     def num_trees(self) -> int:
@@ -118,21 +142,33 @@ class Booster:
             return np.broadcast_to(
                 self.init_score[None, :], (X.shape[0], self.num_classes)
             ).copy()
-        feats, thrs, P, plen, lvals, _, nanl = _paths_cache(self, t)
-        X32 = np.asarray(X, dtype=np.float32)
+        feats, thrs, P, plen, lvals, _, nanl, _ = _paths_cache(self, t)
+        has_cat = self.has_categorical
+        X32 = np.asarray(
+            self._cat_binned(X) if has_cat else X, dtype=np.float32
+        )
+        if has_cat:
+            iscat, catm = _cat_paths_cache(self, t)
         chunk = _predict_chunk_rows(*feats.shape)
         outs = []
         for lo in range(0, max(len(X32), 1), chunk):
-            outs.append(
-                np.asarray(
-                    _predict_margin_paths_jit(
-                        jnp.asarray(X32[lo : lo + chunk]),
-                        jnp.asarray(feats), jnp.asarray(thrs), jnp.asarray(nanl),
-                        jnp.asarray(P), jnp.asarray(plen), jnp.asarray(lvals),
-                        jnp.asarray(self.init_score), self.num_classes,
-                    )
-                )
+            xd = jnp.asarray(X32[lo : lo + chunk])
+            cargs = (
+                jnp.asarray(feats), jnp.asarray(thrs), jnp.asarray(nanl),
+                jnp.asarray(P), jnp.asarray(plen),
             )
+            if has_cat:
+                m = _predict_margin_paths_cat_jit(
+                    xd, *cargs, jnp.asarray(iscat), jnp.asarray(catm),
+                    jnp.asarray(lvals), jnp.asarray(self.init_score),
+                    self.num_classes,
+                )
+            else:
+                m = _predict_margin_paths_jit(
+                    xd, *cargs, jnp.asarray(lvals),
+                    jnp.asarray(self.init_score), self.num_classes,
+                )
+            outs.append(np.asarray(m))
         return np.concatenate(outs, axis=0) if outs else np.zeros((0, self.num_classes), np.float32)
 
     def predict_leaf(
@@ -147,20 +183,29 @@ class Booster:
         t = self._used_trees(num_iteration)
         if t == 0:
             return np.zeros((np.shape(X)[0], 0), np.int32)
-        feats, thrs, P, plen, _, lslots, nanl = _paths_cache(self, t)
-        X32 = np.asarray(X, dtype=np.float32)
+        feats, thrs, P, plen, _, lslots, nanl, _ = _paths_cache(self, t)
+        has_cat = self.has_categorical
+        X32 = np.asarray(
+            self._cat_binned(X) if has_cat else X, dtype=np.float32
+        )
+        if has_cat:
+            iscat, catm = _cat_paths_cache(self, t)
         chunk = _predict_chunk_rows(*feats.shape)
         outs = []
         for lo in range(0, max(len(X32), 1), chunk):
-            outs.append(
-                np.asarray(
-                    _predict_leaf_paths_jit(
-                        jnp.asarray(X32[lo : lo + chunk]),
-                        jnp.asarray(feats), jnp.asarray(thrs), jnp.asarray(nanl),
-                        jnp.asarray(P), jnp.asarray(plen), jnp.asarray(lslots),
-                    )
-                )
+            xd = jnp.asarray(X32[lo : lo + chunk])
+            cargs = (
+                jnp.asarray(feats), jnp.asarray(thrs), jnp.asarray(nanl),
+                jnp.asarray(P), jnp.asarray(plen),
             )
+            if has_cat:
+                leaves = _predict_leaf_paths_cat_jit(
+                    xd, *cargs, jnp.asarray(iscat), jnp.asarray(catm),
+                    jnp.asarray(lslots),
+                )
+            else:
+                leaves = _predict_leaf_paths_jit(xd, *cargs, jnp.asarray(lslots))
+            outs.append(np.asarray(leaves))
         return np.concatenate(outs, axis=0) if outs else np.zeros((0, t), np.int32)
 
     def features_shap(
@@ -201,10 +246,16 @@ class Booster:
         for k in ("cover", "split_gain"):
             if d.get(k) is not None:
                 d[k] = np.asarray(d[k], dtype=np.float32)
-        if d.get("nan_left") is not None:
-            d["nan_left"] = np.asarray(d["nan_left"], dtype=bool)
+        for k in ("nan_left", "cat_nodes", "cat_masks"):
+            if d.get(k) is not None:
+                d[k] = np.asarray(d[k], dtype=bool)
         if d.get("bin_edges") is not None:
             d["bin_edges"] = np.asarray(d["bin_edges"], dtype=np.float64)
+        if d.get("cat_values") is not None:
+            d["cat_values"] = {
+                int(k): np.asarray(v, dtype=np.float64)
+                for k, v in d["cat_values"].items()
+            }
         return Booster(**d)
 
     def model_to_string(self) -> str:
@@ -225,6 +276,10 @@ class Booster:
         for k, v in d.items():
             if isinstance(v, np.ndarray):
                 d[k] = {"__nd__": v.tolist(), "dtype": str(v.dtype), "shape": v.shape}
+        if d.get("cat_values") is not None:
+            d["cat_values"] = {
+                str(k): np.asarray(v).tolist() for k, v in d["cat_values"].items()
+            }
         return json.dumps(d)
 
     @staticmethod
@@ -370,6 +425,9 @@ def _leaf_paths(b: "Booster", t: int):
         np.stack(lvals_l),
         np.stack(lslots_l),
         np.stack(nanl_l),
+        # per-tree internal-slot ordering — the ONE derivation that every
+        # row of the padded constants above follows; _cat_paths reuses it
+        [internal for _, internal in per_tree],
     )
 
 
@@ -414,11 +472,83 @@ def _predict_leaf_paths_jit(X, feats, thrs, nanl, P, plen, lslots):
     ).astype(jnp.int32)
 
 
+def _path_match_cat(X, feats, thrs, nanl, P, plen, iscat, catm):
+    """(N, T, L) leaf membership with categorical decisions: categorical
+    columns of ``X`` hold value-bin ids (``Booster._cat_binned``); at cat
+    nodes d = mask[bin] (bin 0 = unseen/NaN => right)."""
+    x = jnp.take(X, feats.reshape(-1), axis=1)
+    n = X.shape[0]
+    t, i = feats.shape
+    x = x.reshape(n, t, i)
+    d_num = (jnp.isnan(x) & nanl[None]) | (x <= thrs[None])
+    xb = jnp.clip(x, 0, catm.shape[-1] - 1).astype(jnp.int32)
+    d_cat = catm[
+        jnp.arange(t)[None, :, None], jnp.arange(i)[None, None, :], xb
+    ]  # (N, T, I)
+    d = jnp.where(iscat[None], d_cat, d_num)
+    D = 2.0 * d.astype(jnp.float32) - 1.0
+    score = jnp.einsum(
+        "nti,til->ntl", D, P, preferred_element_type=jnp.float32,
+        precision=lax.Precision.HIGHEST,
+    )
+    return score >= plen[None]
+
+
+@partial(jax.jit, static_argnames=("num_classes",))
+def _predict_margin_paths_cat_jit(
+    X, feats, thrs, nanl, P, plen, iscat, catm, lvals, init_score, num_classes
+):
+    match = _path_match_cat(X, feats, thrs, nanl, P, plen, iscat, catm)
+    contrib = jnp.einsum(
+        "ntl,tl->nt", match.astype(jnp.float32), lvals,
+        preferred_element_type=jnp.float32, precision=lax.Precision.HIGHEST,
+    )
+    n, t = contrib.shape
+    rounds = t // num_classes
+    margins = contrib.reshape(n, rounds, num_classes).sum(axis=1)
+    return margins + init_score[None, :]
+
+
+@jax.jit
+def _predict_leaf_paths_cat_jit(X, feats, thrs, nanl, P, plen, iscat, catm, lslots):
+    match = _path_match_cat(X, feats, thrs, nanl, P, plen, iscat, catm)
+    return jnp.einsum(
+        "ntl,tl->nt", match.astype(jnp.float32), lslots.astype(jnp.float32),
+        precision=lax.Precision.HIGHEST,
+    ).astype(jnp.int32)
+
+
 def _paths_cache(b: "Booster", t: int):
     cache = getattr(b, "_path_cache", None)
     if cache is None or cache[0] != t:
         consts = _leaf_paths(b, t)
         object.__setattr__(b, "_path_cache", (t, consts))
+        cache = (t, consts)
+    return cache[1]
+
+
+def _cat_paths(b: "Booster", t: int):
+    """(ISCAT (T, I), CATM (T, I, Bc)) aligned by construction with
+    _leaf_paths' padded constants (it shares the internal-slot ordering
+    _leaf_paths returns — no second derivation to drift)."""
+    consts = _paths_cache(b, t)
+    max_i = consts[0].shape[1]
+    internals = consts[7]
+    bc = b.cat_masks.shape[-1]
+    iscat = np.zeros((t, max_i), bool)
+    catm = np.zeros((t, max_i, bc), bool)
+    for ti in range(t):
+        internal = internals[ti]
+        iscat[ti, : len(internal)] = b.cat_nodes[ti][internal]
+        catm[ti, : len(internal)] = b.cat_masks[ti][internal]
+    return iscat, catm
+
+
+def _cat_paths_cache(b: "Booster", t: int):
+    cache = getattr(b, "_cat_path_cache", None)
+    if cache is None or cache[0] != t:
+        consts = _cat_paths(b, t)
+        object.__setattr__(b, "_cat_path_cache", (t, consts))
         cache = (t, consts)
     return cache[1]
 

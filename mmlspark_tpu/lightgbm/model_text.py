@@ -13,17 +13,23 @@ Encoding notes (mirroring LightGBM's ``src/io/tree.cpp`` / ``gbdt_model_text.cpp
 - A tree with L leaves has L-1 internal nodes. ``left_child``/``right_child``
   entries >= 0 index internal nodes; negative entries encode leaves as
   ``~leaf_index`` (i.e. leaf j is stored as -(j+1)).
-- ``decision_type`` is a bit field: bit 0 = categorical (unsupported here),
-  bit 1 = default_left, bits 2-3 = missing type (0 none, 1 zero, 2 NaN).
-  Trees trained here always route NaN left: ``decision_type = 10``.
+- ``decision_type`` is a bit field: bit 0 = categorical, bit 1 =
+  default_left, bits 2-3 = missing type (0 none, 1 zero, 2 NaN). Numeric
+  nodes trained here always route NaN left: ``decision_type = 10``.
+- Categorical splits (``num_cat > 0``): a cat node's ``threshold`` is an
+  index into ``cat_boundaries`` (num_cat+1 cumulative uint32-word offsets)
+  / ``cat_threshold`` (bitset words over RAW category values; value v in
+  the left set iff word[v//32] has bit v%32). Export requires the
+  category values be non-negative integers (LightGBM's own contract);
+  NaN/unseen values route right on both engines.
 - ``boost_from_average``: LightGBM has no init-score field — the init score
   lives inside the first iteration's leaf values. Export therefore folds
   ``init_score[c]`` into iteration-0 class-c leaf values; import leaves
   ``init_score = 0`` (the margins come out identical).
 - Floats print with ``%.17g`` (round-trip exact for float64).
 
-Out of scope (explicit errors): categorical splits (``num_cat > 0``),
-linear trees (``is_linear=1``), and ``missing_type=Zero``
+Out of scope (explicit errors): linear trees (``is_linear=1``) and
+``missing_type=Zero``
 (``zero_as_missing=true`` models). ``missing_type=None`` imports with the
 LightGBM predictor's convention that a NaN at such a node behaves like 0.0,
 which resolves to a static per-node direction ``nan_left = (0.0 <= threshold)``.
@@ -76,6 +82,10 @@ def to_lightgbm_text(booster, shrinkage: float = 1.0) -> str:
             "iteration's leaf values"
         )
 
+    cat_nodes_all = booster.cat_nodes
+    cat_masks_all = booster.cat_masks
+    cat_values_all = booster.cat_values or {}
+
     tree_strs: List[str] = []
     for ti in range(t):
         is_leaf = np.asarray(booster.is_leaf[ti], dtype=bool)
@@ -83,6 +93,10 @@ def to_lightgbm_text(booster, shrinkage: float = 1.0) -> str:
         right = np.asarray(booster.right_child[ti])
         feat = np.asarray(booster.split_feature[ti])
         thr = np.asarray(booster.split_threshold[ti], dtype=np.float64)
+        cat_node = (
+            np.asarray(cat_nodes_all[ti], bool)
+            if cat_nodes_all is not None else np.zeros(len(feat), bool)
+        )
         lval = np.asarray(booster.leaf_values[ti], dtype=np.float64)
         gain = (
             np.asarray(booster.split_gain[ti], dtype=np.float64)
@@ -135,6 +149,11 @@ def to_lightgbm_text(booster, shrinkage: float = 1.0) -> str:
         def child_ref(slot: int) -> int:
             return internal_ids[slot] if not is_leaf[slot] else ~leaf_ids[slot]
 
+        # categorical nodes: threshold = index into cat_boundaries /
+        # cat_threshold (bitsets over RAW category values, uint32 words)
+        cat_boundaries = [0]
+        cat_words: List[int] = []
+        slot_by_ii = {ii: slot for slot, ii in internal_ids.items()}
         for slot in order:
             if is_leaf[slot]:
                 li = leaf_ids[slot]
@@ -150,13 +169,40 @@ def to_lightgbm_text(booster, shrinkage: float = 1.0) -> str:
             lc[ii] = child_ref(int(left[slot]))
             rc[ii] = child_ref(int(right[slot]))
             iw[ii] = cover[slot]
+        num_cat = 0
+        for ii in range(ni):  # cat indexes assigned in internal-node order
+            slot = slot_by_ii[ii]
+            if not cat_node[slot]:
+                continue
+            f_idx = int(feat[slot])
+            vals_f = np.asarray(cat_values_all.get(f_idx, ()), np.float64)
+            bins_in = np.nonzero(np.asarray(cat_masks_all[ti][slot], bool))[0]
+            bins_in = bins_in[(bins_in >= 1) & (bins_in <= len(vals_f))]
+            raw = vals_f[bins_in - 1]
+            if raw.size == 0 or np.any(raw < 0) or np.any(np.mod(raw, 1) != 0):
+                raise ValueError(
+                    f"tree {ti} slot {slot}: categorical split values must "
+                    "be non-negative integers for LightGBM's bitset format "
+                    f"(got {raw[:5]}...)"
+                )
+            raw_i = raw.astype(np.int64)
+            nwords = int(raw_i.max()) // 32 + 1
+            words = np.zeros(nwords, np.uint32)
+            np.bitwise_or.at(
+                words, raw_i // 32, np.uint32(1) << (raw_i % 32).astype(np.uint32)
+            )
+            th[ii] = float(num_cat)
+            dt[ii] = 1 | (2 << 2)  # bit0 categorical, missing NaN (-> right)
+            cat_words.extend(int(w) for w in words)
+            cat_boundaries.append(len(cat_words))
+            num_cat += 1
 
         if num_leaves == 0:  # degenerate: root itself missing (cannot happen)
             num_leaves = 1
 
         fields = [
             f"num_leaves={num_leaves}",
-            "num_cat=0",
+            f"num_cat={num_cat}",
             f"split_feature={_fmt_int(sf)}",
             f"split_gain={_fmt(sg)}",
             f"threshold={_fmt(th)}",
@@ -169,6 +215,13 @@ def to_lightgbm_text(booster, shrinkage: float = 1.0) -> str:
             f"internal_value={_fmt(ivalue)}",
             f"internal_weight={_fmt(iw)}",
             f"internal_count={_fmt_int(np.round(iw))}",
+        ]
+        if num_cat:
+            fields += [
+                f"cat_boundaries={_fmt_int(cat_boundaries)}",
+                f"cat_threshold={_fmt_int(cat_words)}",
+            ]
+        fields += [
             "is_linear=0",
             f"shrinkage={_G % shrinkage}",
         ]
@@ -289,8 +342,7 @@ def from_lightgbm_text(s: str):
     trees = []
     for bi, blk in enumerate(blocks):
         num_leaves = int(_block_value(blk, "num_leaves"))
-        if int(blk.get("num_cat", "0")) > 0:
-            raise ValueError(f"tree {bi}: categorical splits are not supported")
+        num_cat = int(blk.get("num_cat", "0"))
         if blk.get("is_linear", "0").strip() not in ("0", ""):
             raise ValueError(f"tree {bi}: linear trees are not supported")
         lv = np.fromstring(_block_value(blk, "leaf_value"), sep=" ")
@@ -320,16 +372,47 @@ def from_lightgbm_text(s: str):
         if any(len(a) != ni for a in (sf, th, dt, lc, rc)):
             raise ValueError(f"tree {bi}: inconsistent internal-node array lengths")
 
-        if np.any(dt & 1):
-            raise ValueError(f"tree {bi}: categorical decision_type")
+        is_cat_i = (dt & 1) != 0
         missing = (dt >> 2) & 3
-        if np.any(missing == 1):
+        if np.any((missing == 1) & ~is_cat_i):
             raise ValueError(
                 f"tree {bi}: zero_as_missing models are not supported"
             )
         default_left = (dt & 2) != 0
         # missing_type None: LightGBM's predictor treats NaN like 0.0 there.
         nan_left_i = np.where(missing == 0, 0.0 <= th, default_left)
+        nan_left_i = np.where(is_cat_i, False, nan_left_i)  # cat NaN -> right
+
+        # Categorical nodes: threshold = index into cat_boundaries /
+        # cat_threshold; decode each node's bitset into raw value arrays.
+        cat_sets = {}
+        if np.any(is_cat_i) and num_cat == 0:
+            raise ValueError(
+                f"tree {bi}: categorical decision_type on a node but "
+                "num_cat=0 (cat_boundaries/cat_threshold missing)"
+            )
+        if num_cat > 0 and np.any(is_cat_i):
+            cbound = np.fromstring(
+                _block_value(blk, "cat_boundaries"), sep=" "
+            ).astype(np.int64)
+            cwords = np.fromstring(
+                _block_value(blk, "cat_threshold"), sep=" "
+            ).astype(np.int64)
+            for ii in np.nonzero(is_cat_i)[0]:
+                c = int(th[ii])
+                if not (0 <= c < num_cat):
+                    raise ValueError(
+                        f"tree {bi}: categorical threshold index {c} out of "
+                        f"range for num_cat={num_cat}"
+                    )
+                words = cwords[cbound[c] : cbound[c + 1]]
+                vals = [
+                    wi * 32 + bit
+                    for wi, w in enumerate(words)
+                    for bit in range(32)
+                    if (int(w) >> bit) & 1
+                ]
+                cat_sets[int(ii)] = np.asarray(vals, np.int64)
 
         # LightGBM indices -> slot layout: internal i -> slot i,
         # leaf j -> slot ni + j (any consistent layout works for routing).
@@ -353,7 +436,9 @@ def from_lightgbm_text(s: str):
             cover_s[ni:] = lcnt
         for ii in range(ni):
             feat[ii] = sf[ii]
-            thr_s[ii] = th[ii]
+            # cat nodes: the file's threshold is a cat index, meaningless as
+            # a numeric cut — keep +inf; routing uses the decoded value set
+            thr_s[ii] = np.inf if ii in cat_sets else th[ii]
             left_s[ii] = slot_of(lc[ii])
             right_s[ii] = slot_of(rc[ii])
             nanl_s[ii] = bool(nan_left_i[ii])
@@ -364,7 +449,7 @@ def from_lightgbm_text(s: str):
         trees.append(
             dict(feat=feat, thr=thr_s, left=left_s, right=right_s,
                  is_leaf=isl, lval=lval_s, nanl=nanl_s, cover=cover_s,
-                 gain=gain_s)
+                 gain=gain_s, cat=cat_sets)
         )
 
     t = len(trees)
@@ -375,6 +460,31 @@ def from_lightgbm_text(s: str):
         for ti, tr in enumerate(trees):
             out[ti, : len(tr[key])] = tr[key]
         return out
+
+    # Booster-level categorical state: per-feature sorted value lists (the
+    # union of every node's bitset on that feature) and per-node masks over
+    # the value-bin ids (bin i+1 <-> values[i]; bin 0 = unseen/NaN).
+    cat_nodes = cat_masks = cat_values = None
+    if any(tr.get("cat") for tr in trees):
+        feat_vals: dict = {}
+        for tr in trees:
+            for slot, vals in tr.get("cat", {}).items():
+                f_ = int(tr["feat"][slot])
+                feat_vals.setdefault(f_, set()).update(int(v) for v in vals)
+        cat_values = {
+            f_: np.asarray(sorted(s), np.float64) for f_, s in feat_vals.items()
+        }
+        bc = max(len(v) for v in cat_values.values()) + 1
+        cat_nodes = np.zeros((t, m), bool)
+        cat_masks = np.zeros((t, m, bc), bool)
+        for ti, tr in enumerate(trees):
+            for slot, vals in tr.get("cat", {}).items():
+                f_ = int(tr["feat"][slot])
+                idx = np.searchsorted(
+                    cat_values[f_], np.asarray(vals, np.float64)
+                )
+                cat_nodes[ti, slot] = True
+                cat_masks[ti, slot, idx + 1] = True
 
     booster = Booster(
         split_feature=pad("feat", 0, np.int32),
@@ -399,6 +509,9 @@ def from_lightgbm_text(s: str):
         feature_names=feature_names
         or [f"Column_{j}" for j in range(max_feature_idx + 1)],
         nan_left=pad("nanl", True, bool),
+        cat_nodes=cat_nodes,
+        cat_masks=cat_masks,
+        cat_values=cat_values,
     )
     return booster
 

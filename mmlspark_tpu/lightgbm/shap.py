@@ -29,6 +29,8 @@ def tree_shap(booster, X: np.ndarray, num_iteration: Optional[int] = None) -> np
     t_used = booster._used_trees(num_iteration)
     n, f = X.shape
     c = booster.num_classes
+    has_cat = booster.has_categorical
+    Xr = booster._cat_binned(X) if has_cat else X  # cat cols -> value-bin ids
     phi = np.zeros((n, c, f + 1), dtype=np.float64)
     phi[:, :, f] += np.asarray(booster.init_score, dtype=np.float64)[None, :]
     for t in range(t_used):
@@ -40,8 +42,10 @@ def tree_shap(booster, X: np.ndarray, num_iteration: Optional[int] = None) -> np
             booster.is_leaf[t],
             booster.leaf_values[t],
             booster.cover[t],
-            X,
+            Xr,
             nan_left=None if booster.nan_left is None else booster.nan_left[t],
+            cat_node=None if not has_cat else booster.cat_nodes[t],
+            cat_mask=None if not has_cat else booster.cat_masks[t],
         )
         cls = t % c
         phi[:, cls, :f] += contrib
@@ -49,7 +53,8 @@ def tree_shap(booster, X: np.ndarray, num_iteration: Optional[int] = None) -> np
     return phi
 
 
-def _shap_one_tree(feat, thr, left, right, is_leaf, leaf_val, cover, X, nan_left=None):
+def _shap_one_tree(feat, thr, left, right, is_leaf, leaf_val, cover, X,
+                   nan_left=None, cat_node=None, cat_mask=None):
     n, num_features = X.shape
     phi = np.zeros((n, num_features), dtype=np.float64)
 
@@ -63,6 +68,13 @@ def _shap_one_tree(feat, thr, left, right, is_leaf, leaf_val, cover, X, nan_left
     xv = X[:, feat].astype(np.float32)  # (N, M)
     nl = np.ones(len(feat), bool) if nan_left is None else np.asarray(nan_left, bool)
     goes_left = (np.isnan(xv) & nl[None, :]) | (xv <= _thr_f32(thr)[None, :])
+    if cat_node is not None and np.any(cat_node):
+        # categorical columns of X hold value-bin ids (tree_shap pre-bins);
+        # left iff the node's set contains the bin — same rule as predict
+        bc = cat_mask.shape[-1]
+        xb = np.clip(np.nan_to_num(xv, nan=0.0), 0, bc - 1).astype(np.int64)
+        gl_cat = cat_mask[np.arange(len(feat))[None, :], xb]
+        goes_left = np.where(cat_node[None, :], gl_cat, goes_left)
 
     root_cover = max(float(cover[0]), 1e-12)
 

@@ -95,6 +95,12 @@ class TrainOptions:
     # only batch leaves with gain >= ratio * pass-best (0 = off): tightens
     # multi-leaf passes toward best-first; 1.0 reproduces leaf_batch=1
     leaf_batch_ratio: float = 0.0
+    # categorical split search (LightGBMParams.scala:125-133 forwards these
+    # to native LightGBM; same names/defaults as the native engine):
+    categorical_slots: tuple = ()  # feature indices treated as categorical
+    max_cat_threshold: int = 32  # max categories in a split's left set
+    cat_smooth: float = 10.0  # smoothing for the g/h category sort
+    cat_l2: float = 10.0  # extra L2 applied to categorical split gains
     verbosity: int = -1
 
     @property
@@ -141,6 +147,8 @@ class TreeArrays(NamedTuple):
     cover: jax.Array
     gain: jax.Array
     row_leaf: jax.Array  # (N,) final leaf slot of every training row
+    cat_node: jax.Array  # (M,) bool: categorical split at this node
+    cat_mask: jax.Array  # (M, B) bool left-set bins ((M, 1) placeholder when no cat)
 
 
 class SplitSearch(NamedTuple):
@@ -157,6 +165,9 @@ class SplitSearch(NamedTuple):
     rval: jax.Array
     lcov: jax.Array
     rcov: jax.Array
+    is_cat: jax.Array  # (k,) bool: categorical split (bin = left-set size - 1)
+    cat_mask: jax.Array  # (k, B) bool: bins in the LEFT set (all-False if numeric)
+    value_cat: jax.Array  # (k,) own leaf value under l2+cat_l2 (cat-parent case)
 
 
 def _soft_threshold(g: jax.Array, l1: float) -> jax.Array:
@@ -203,6 +214,61 @@ def _split_search(
     )
     gain = jnp.where(valid, gain, -jnp.inf)
 
+    # Categorical split search (LightGBM's sorted-prefix algorithm, native
+    # FindBestThresholdCategoricalInner): bins of a categorical feature sort
+    # by sum_g / (sum_h + cat_smooth) and the candidate left sets are the
+    # prefixes of that order — scanned in BOTH directions (a small
+    # high-ratio set is a short descending prefix), capped at
+    # max_cat_threshold categories, with lambda_l2 + cat_l2 regularization.
+    # The missing bin 0 never enters a left set (unseen/NaN routes right).
+    has_cat = bool(opts.categorical_slots)
+    if has_cat:
+        # All sorted-prefix machinery runs on the (k, F_cat, B) SLICE only —
+        # sorts are the expensive primitive here, and categorical features
+        # are typically a small subset of the matrix.
+        cat_idx_np = np.asarray(sorted(opts.categorical_slots), np.int32)
+        cf_np = np.zeros(f, bool)
+        cf_np[cat_idx_np] = True
+        inv_np = np.zeros(f, np.int32)
+        inv_np[cat_idx_np] = np.arange(len(cat_idx_np))
+        cat_idx = jnp.asarray(cat_idx_np)
+        hist_c = hist[:, cat_idx]  # (k, Fc, B, 3)
+        gsum, hsum, cnt = hist_c[..., 0], hist_c[..., 1], hist_c[..., 2]
+        jpos = jnp.arange(b)[None, None, :]
+        nonempty = (cnt > 0) & (jpos > 0)
+        ratio = gsum / (hsum + opts.cat_smooth)
+        l2c = l2 + opts.cat_l2
+        big = jnp.float32(np.finfo(np.float32).max)
+        tgc = _soft_threshold(g_tot, l1)
+        parent_c = (tgc * tgc) / (h_tot + l2c)
+        fm_c = feature_mask[cat_idx]
+        dir_data = []
+        for sign in (1.0, -1.0):
+            key = jnp.where(nonempty, sign * ratio, big)  # empties sort last
+            order = jnp.argsort(key, axis=2)  # (k, Fc, B)
+            sg = jnp.cumsum(jnp.take_along_axis(gsum, order, 2), axis=2)
+            sh = jnp.cumsum(jnp.take_along_axis(hsum, order, 2), axis=2)
+            sc = jnp.cumsum(jnp.take_along_axis(cnt, order, 2), axis=2)
+            sne = jnp.cumsum(
+                jnp.take_along_axis(nonempty.astype(jnp.int32), order, 2), axis=2
+            )
+            grc, hrc, crc = g_tot[:, None, None] - sg, h_tot[:, None, None] - sh, c_tot[:, None, None] - sc
+            tlc, trc = _soft_threshold(sg, l1), _soft_threshold(grc, l1)
+            gain_c = tlc * tlc / (sh + l2c) + trc * trc / (hrc + l2c) - parent_c[:, None, None]
+            valid_c = (
+                (jpos + 1 <= opts.max_cat_threshold)
+                & (sne == jpos + 1)  # prefix of NONEMPTY bins only
+                & (sc >= opts.min_data_in_leaf)
+                & (crc >= opts.min_data_in_leaf)
+                & (sh >= opts.min_sum_hessian_in_leaf)
+                & (hrc >= opts.min_sum_hessian_in_leaf)
+                & (fm_c[None, :, None] > 0)
+            )
+            dir_data.append((jnp.where(valid_c, gain_c, -jnp.inf), order, sg, sh, sc))
+        gain_cat = jnp.maximum(dir_data[0][0], dir_data[1][0])
+        use_desc = dir_data[1][0] > dir_data[0][0]  # (k, Fc, B)
+        gain = gain.at[:, cat_idx, :].set(gain_cat)
+
     flat = gain.reshape(k, f * b)
     best_idx = jnp.argmax(flat, axis=1)  # (k,)
     best_gain = jnp.take_along_axis(flat, best_idx[:, None], axis=1)[:, 0]
@@ -224,6 +290,62 @@ def _split_search(
     thr_raw = edges[best_f, jnp.maximum(best_b - 1, 0)]
     thr_raw = jnp.where(best_b == 0, -jnp.inf, thr_raw).astype(jnp.float32)
 
+    is_cat_best = jnp.zeros(k, bool)
+    cat_mask = jnp.zeros((k, b), bool)
+    if has_cat:
+        # Native parity: leaves created BY a categorical split get outputs
+        # regularized with lambda_l2 + cat_l2 (LightGBM's
+        # CalculateSplittedLeafOutput for the categorical path).
+        def leaf_value_cat(g, h):
+            v = -_soft_threshold(g, l1) / (h + l2 + opts.cat_l2)
+            if opts.max_delta_step > 0:
+                v = jnp.clip(v, -opts.max_delta_step, opts.max_delta_step)
+            return v * lr
+
+        is_cat_best = jnp.asarray(cf_np)[best_f]  # (k,)
+        cpos = jnp.asarray(inv_np)[best_f]  # (k,) index into the cat slice
+        dsel = use_desc[iota, cpos, best_b]  # (k,) winning direction
+
+        def _at_best(x0, x1):
+            return jnp.where(
+                dsel, x1[iota, cpos, best_b], x0[iota, cpos, best_b]
+            )
+
+        glb_c = _at_best(dir_data[0][2], dir_data[1][2])
+        hlb_c = _at_best(dir_data[0][3], dir_data[1][3])
+        clb_c = _at_best(dir_data[0][4], dir_data[1][4])
+        glb = jnp.where(is_cat_best, glb_c, glb)
+        hlb = jnp.where(is_cat_best, hlb_c, hlb)
+        clb = jnp.where(is_cat_best, clb_c, clb)
+        thr_raw = jnp.where(is_cat_best, jnp.inf, thr_raw)
+        # Left-set membership: scatter ranks through the winning order —
+        # bins at sorted positions <= best_b are IN (best_b = set size - 1).
+        order_sel = jnp.where(
+            dsel[:, None],
+            dir_data[1][1][iota, cpos, :],
+            dir_data[0][1][iota, cpos, :],
+        )  # (k, B) bin ids in sorted order
+        in_prefix = jnp.arange(b)[None, :] <= best_b[:, None]  # (k, B) by rank
+        cat_mask = (
+            jnp.zeros((k, b), bool)
+            .at[iota[:, None], order_sel]
+            .set(in_prefix)
+            & is_cat_best[:, None]
+        )
+        lval = jnp.where(
+            is_cat_best, leaf_value_cat(glb, hlb), leaf_value(glb, hlb)
+        )
+        rval = jnp.where(
+            is_cat_best,
+            leaf_value_cat(g_tot - glb, h_tot - hlb),
+            leaf_value(g_tot - glb, h_tot - hlb),
+        )
+        value_cat = leaf_value_cat(g_tot, h_tot)
+    else:
+        lval = leaf_value(glb, hlb)
+        rval = leaf_value(g_tot - glb, h_tot - hlb)
+        value_cat = leaf_value(g_tot, h_tot)
+
     return SplitSearch(
         value=leaf_value(g_tot, h_tot),
         cover=c_tot,
@@ -232,10 +354,13 @@ def _split_search(
         feat=best_f,
         bin=best_b,
         thr=thr_raw,
-        lval=leaf_value(glb, hlb),
-        rval=leaf_value(g_tot - glb, h_tot - hlb),
+        lval=lval,
+        rval=rval,
         lcov=clb,
         rcov=c_tot - clb,
+        is_cat=is_cat_best,
+        cat_mask=cat_mask,
+        value_cat=value_cat,
     )
 
 
@@ -323,7 +448,9 @@ def _build_tree_depthwise(
     inherited = jnp.zeros(1, dtype=jnp.float32)
     cover_cur = jnp.zeros(1, dtype=jnp.float32)
 
+    has_cat = bool(opts.categorical_slots)
     feat_lv, bin_lv, thr_lv, cover_lv, gain_lv = [], [], [], [], []
+    iscat_lv, catmask_lv = [], []
 
     for d in range(depth):
         k = 1 << d
@@ -346,12 +473,20 @@ def _build_tree_depthwise(
         thr_lv.append(jnp.where(can_split, s.thr, jnp.inf).astype(jnp.float32))
         cover_lv.append(cover_here)
         gain_lv.append(jnp.where(can_split, s.gain, 0.0))
+        if has_cat:
+            iscat_lv.append(can_split & s.is_cat)
+            catmask_lv.append(s.cat_mask & can_split[:, None])
 
         # Route rows down one level.
         row_f = feat_lv[-1][local]
         row_b = bin_lv[-1][local]
         x_bin = jnp.take_along_axis(bins, row_f[:, None], axis=1)[:, 0]
-        go_right = (x_bin > row_b).astype(jnp.int32)
+        go_right = x_bin > row_b
+        if has_cat:
+            ic = iscat_lv[-1][local]
+            cm = catmask_lv[-1].reshape(-1)[local * b + x_bin.astype(jnp.int32)]
+            go_right = jnp.where(ic, ~cm, go_right)
+        go_right = go_right.astype(jnp.int32)
         node = 2 * node + 1 + go_right
 
         inherited = jnp.stack(
@@ -390,6 +525,16 @@ def _build_tree_depthwise(
         cover=jnp.concatenate([jnp.concatenate(cover_lv), cover_cur]),
         gain=jnp.concatenate([jnp.concatenate(gain_lv), jnp.zeros(leaves, jnp.float32)]),
         row_leaf=node,  # already absolute pointer slots
+        cat_node=(
+            jnp.concatenate([jnp.concatenate(iscat_lv), jnp.zeros(leaves, bool)])
+            if has_cat else jnp.zeros(internal + leaves, bool)
+        ),
+        cat_mask=(
+            jnp.concatenate(
+                [jnp.concatenate(catmask_lv), jnp.zeros((leaves, b), bool)]
+            )
+            if has_cat else jnp.zeros((internal + leaves, 1), bool)
+        ),
     )
 
 
@@ -473,6 +618,7 @@ def _build_tree_leafwise(
     def at0(template, s_):
         return template.at[0].set(s_[0])
 
+    has_cat = bool(opts.categorical_slots)
     zi = jnp.zeros(m, jnp.int32)
     zf = jnp.zeros(m, jnp.float32)
     state = dict(
@@ -497,6 +643,15 @@ def _build_tree_leafwise(
         c_bin=at0(zi, root.bin),
         c_thr=at0(zf, root.thr),
     )
+    if has_cat:
+        zb = jnp.zeros(m, bool)
+        zmb = jnp.zeros((m, b), bool)
+        state.update(
+            cat_node=zb,
+            cat_mask=zmb,
+            c_iscat=at0(zb, root.is_cat),
+            c_catmask=zmb.at[0].set(root.cat_mask[0]),
+        )
     if use_sub:
         state["leaf_hist"] = (
             jnp.zeros((m, f, b, 3), jnp.float32).at[0].set(root_hist[0])
@@ -536,6 +691,9 @@ def _build_tree_leafwise(
         sf = st["c_feat"][top_l]  # (k,) split feature / bin / threshold
         sb = st["c_bin"][top_l]
         sthr = st["c_thr"][top_l]
+        if has_cat:
+            sic = st["c_iscat"][top_l]  # (k,)
+            scm = st["c_catmask"][top_l]  # (k, B)
 
         # Route rows and build the pass's node keys in one unrolled sweep:
         # key = j for rows entering split j's LEFT child (subtraction mode;
@@ -549,6 +707,11 @@ def _build_tree_leafwise(
             colj = lax.dynamic_slice_in_dim(bins, sf[jj], 1, axis=1)[:, 0]
             in_j = (node == top_l[jj]) & can[jj]
             right_j = colj > sb[jj]
+            if has_cat:
+                # categorical: LEFT iff the row's bin is in the split set
+                right_j = jnp.where(
+                    sic[jj], ~scm[jj][colj.astype(jnp.int32)], right_j
+                )
             new_node = jnp.where(
                 in_j, jnp.where(right_j, rslot[jj], lslot[jj]), new_node
             )
@@ -602,9 +765,15 @@ def _build_tree_leafwise(
             .at[glslot].set(True, mode="drop")
             .at[grslot].set(True, mode="drop")
         )
+        # A final leaf's value comes from the split that CREATED it: children
+        # of categorical splits carry the l2+cat_l2 output (native parity).
+        lv_l, lv_r = cs.value[:k], cs.value[k:]
+        if has_cat:
+            lv_l = jnp.where(sic, cs.value_cat[:k], lv_l)
+            lv_r = jnp.where(sic, cs.value_cat[k:], lv_r)
         st["leaf_val"] = (
-            st["leaf_val"].at[glslot].set(cs.value[:k], mode="drop")
-            .at[grslot].set(cs.value[k:], mode="drop")
+            st["leaf_val"].at[glslot].set(lv_l, mode="drop")
+            .at[grslot].set(lv_r, mode="drop")
         )
         st["cover"] = (
             st["cover"].at[glslot].set(cs.cover[:k], mode="drop")
@@ -632,6 +801,17 @@ def _build_tree_leafwise(
             st["c_thr"].at[glslot].set(cs.thr[:k], mode="drop")
             .at[grslot].set(cs.thr[k:], mode="drop")
         )
+        if has_cat:
+            st["cat_node"] = st["cat_node"].at[gparent].set(sic, mode="drop")
+            st["cat_mask"] = st["cat_mask"].at[gparent].set(scm, mode="drop")
+            st["c_iscat"] = (
+                st["c_iscat"].at[glslot].set(cs.is_cat[:k], mode="drop")
+                .at[grslot].set(cs.is_cat[k:], mode="drop")
+            )
+            st["c_catmask"] = (
+                st["c_catmask"].at[glslot].set(cs.cat_mask[:k], mode="drop")
+                .at[grslot].set(cs.cat_mask[k:], mode="drop")
+            )
         st["n_splits"] = st["n_splits"] + can.sum().astype(jnp.int32)
         return st
 
@@ -648,6 +828,8 @@ def _build_tree_leafwise(
         cover=state["cover"],
         gain=state["gain"],
         row_leaf=state["node"],
+        cat_node=state["cat_node"] if has_cat else jnp.zeros(m, bool),
+        cat_mask=state["cat_mask"] if has_cat else jnp.zeros((m, 1), bool),
     )
 
 
@@ -657,16 +839,24 @@ def _build_tree_leafwise(
 
 
 def _route_binned(
-    bins: jax.Array, feat, binthr, left, right, is_leaf, steps: int
+    bins: jax.Array, feat, binthr, left, right, is_leaf, steps: int,
+    cat_node=None, cat_mask=None,
 ) -> jax.Array:
-    """Route binned rows through one pointer tree; returns final leaf slot."""
+    """Route binned rows through one pointer tree; returns final leaf slot.
+    ``cat_mask`` (M, B) bool: at categorical nodes (``cat_node``) a row goes
+    LEFT iff its bin is in the node's set ((M, 1) placeholder = no cats)."""
     n = bins.shape[0]
     node = jnp.zeros(n, dtype=jnp.int32)
     for _ in range(steps):
         fcur = feat[node]
         bcur = binthr[node]
         x_bin = jnp.take_along_axis(bins, fcur[:, None], axis=1)[:, 0]
-        nxt = jnp.where(x_bin <= bcur, left[node], right[node])
+        go_left = x_bin <= bcur
+        if cat_mask is not None and cat_mask.shape[-1] > 1:
+            bwidth = cat_mask.shape[-1]
+            cm = cat_mask.reshape(-1)[node * bwidth + x_bin.astype(jnp.int32)]
+            go_left = jnp.where(cat_node[node], cm, go_left)
+        nxt = jnp.where(go_left, left[node], right[node])
         node = jnp.where(is_leaf[node], node, nxt)
     return node
 
@@ -835,12 +1025,14 @@ def _make_tree_contrib(steps: int):
     used by dart mode to subtract dropped trees."""
 
     @jax.jit
-    def contrib(bins_v, feat, bthr, lc, rc, il, vals):
-        def per_class(f_, b_, l_, r_, i_, v_):
-            leaf = _route_binned(bins_v, f_, b_, l_, r_, i_, steps)
+    def contrib(bins_v, feat, bthr, lc, rc, il, vals, catn, catm):
+        def per_class(f_, b_, l_, r_, i_, v_, cn_, cm_):
+            leaf = _route_binned(
+                bins_v, f_, b_, l_, r_, i_, steps, cat_node=cn_, cat_mask=cm_
+            )
             return v_[leaf]
 
-        return jax.vmap(per_class, out_axes=1)(feat, bthr, lc, rc, il, vals)
+        return jax.vmap(per_class, out_axes=1)(feat, bthr, lc, rc, il, vals, catn, catm)
 
     return contrib
 
@@ -851,7 +1043,7 @@ def _make_valid_update(steps: int):
     def update(bins_v, margins_v, tree):
         return margins_v + contrib(
             bins_v, tree.feat, tree.bin, tree.left, tree.right, tree.is_leaf,
-            tree.leaf_val,
+            tree.leaf_val, tree.cat_node, tree.cat_mask,
         )
 
     return jax.jit(update, donate_argnums=(1,))
@@ -924,6 +1116,12 @@ def train(
     num_classes = objective.num_outputs_fn(opts.num_class)
     n, f = bins.shape
     num_bins = opts.max_bin + 1  # + missing bin
+    # The mapper is the single source of truth for categorical features
+    # (LightGBMBase.scala:148-156 likewise resolves slots before training).
+    if mapper is not None and mapper.cat_values:
+        opts = dataclasses.replace(
+            opts, categorical_slots=tuple(sorted(mapper.cat_values))
+        )
 
     w_is_default = w is None
     w = np.ones(n, dtype=np.float32) if w is None else np.asarray(w, dtype=np.float32)
@@ -1212,7 +1410,8 @@ def train(
 
         def contrib_of(tr, bins_v):
             return tree_contrib(
-                bins_v, tr.feat, tr.bin, tr.left, tr.right, tr.is_leaf, tr.leaf_val
+                bins_v, tr.feat, tr.bin, tr.left, tr.right, tr.is_leaf,
+                tr.leaf_val, tr.cat_node, tr.cat_mask,
             )
 
         for it, (bag_np, bag_changed, fm_np) in enumerate(schedule):
@@ -1370,6 +1569,23 @@ def train(
     def stack(field, dtype):
         return packed[_FIELDS.index(field)].astype(dtype)
 
+    # Categorical split arrays ride separate (small) transfers: the bool
+    # mask matrix does not fit the homogeneous f32 pack.
+    cat_nodes_np = cat_masks_np = None
+    if opts.categorical_slots:
+        if stacked_trees is not None:
+            cn_dev = stacked_trees.cat_node.reshape(t * num_classes, m)
+            cm_dev = stacked_trees.cat_mask.reshape(t * num_classes, m, -1)
+        else:
+            cn_dev = jnp.concatenate([tr.cat_node for tr in trees]).reshape(
+                t * num_classes, m
+            )
+            cm_dev = jnp.concatenate([tr.cat_mask for tr in trees], axis=0).reshape(
+                t * num_classes, m, -1
+            )
+        cat_nodes_np = np.asarray(cn_dev).astype(bool)
+        cat_masks_np = np.asarray(cm_dev.astype(jnp.uint8)).astype(bool)
+
     left = stack("left", np.int32)
     right = stack("right", np.int32)
     is_leaf = stack("is_leaf", bool)
@@ -1394,6 +1610,12 @@ def train(
         best_iteration=best_iter if (valid_state and opts.early_stopping_round > 0) else -1,
         feature_names=feature_names,
         bin_edges=None if mapper is None else mapper.edges,
+        cat_nodes=cat_nodes_np,
+        cat_masks=cat_masks_np,
+        cat_values=(
+            None if (mapper is None or not mapper.cat_values)
+            else {int(j): np.asarray(v) for j, v in mapper.cat_values.items()}
+        ),
     )
     return TrainResult(booster=booster, evals=evals, best_iteration=best_iter)
 

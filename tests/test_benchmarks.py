@@ -131,6 +131,23 @@ def test_golden_metrics(datasets):
     fvrmse = float(np.sqrt(np.mean((fvout.column("prediction") - yfr) ** 2)))
     suite.add("friedman_vw_rmse", fvrmse, 1.0, higher_is_better=False)
 
+    # Categorical-split golden (categoricalSlotIndexes; the reference's
+    # native engine exposes the same capability via LightGBMParams.scala:125)
+    rngc = np.random.default_rng(21)
+    nc = 3000
+    catf = rngc.integers(0, 10, size=nc)
+    ceff = rngc.normal(size=10) * 2.0
+    Xc = np.column_stack([catf.astype(np.float64), rngc.normal(size=(nc, 3))])
+    yc = ((ceff[catf] + Xc[:, 1]) > 0).astype(np.float64)
+    ct_train, (Xct, yct) = _split(Xc, yc, seed=4)
+    cclf = LightGBMClassifier(
+        numIterations=20, numLeaves=15, seed=0, parallelism="serial",
+        categoricalSlotIndexes=[0],
+    ).fit(ct_train)
+    suite.add(
+        "categorical_gbdt_auc", _auc(yct, cclf.booster.raw_margin(Xct)[:, 0]), 0.01
+    )
+
     # Multiclass golden (wine, 3 classes)
     Xw, yw = datasets["wine_test"]
     wclf = LightGBMClassifier(

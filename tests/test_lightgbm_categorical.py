@@ -1,0 +1,290 @@
+"""Categorical feature splits, end to end.
+
+The reference forwards ``categoricalSlotIndexes``/``categoricalSlotNames``
+to native LightGBM, which runs categorical split finding
+(``lightgbm/LightGBMParams.scala:125-133``, ``LightGBMBase.scala:148-156``).
+This suite pins the TPU re-implementation: value-identity binning, the
+sorted-prefix set search, set routing in both growth modes, predict/SHAP
+consistency, serde (JSON + LightGBM model text with cat bitsets), and
+import of a pinned LightGBM-format categorical model file."""
+
+import os
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.data.table import Table
+from mmlspark_tpu.lightgbm import LightGBMClassifier, LightGBMRegressor
+from mmlspark_tpu.lightgbm.binning import bin_dataset, cat_to_bins
+from mmlspark_tpu.lightgbm.booster import Booster
+from mmlspark_tpu.lightgbm.model_text import from_lightgbm_text, to_lightgbm_text
+from mmlspark_tpu.lightgbm.objectives import auc
+from mmlspark_tpu.lightgbm.train import TrainOptions, train
+
+FIXTURE = os.path.join(
+    os.path.dirname(__file__), "fixtures", "lightgbm_categorical_model.txt"
+)
+
+
+def _cat_data(n=5000, n_cat=12, seed=0):
+    rng = np.random.default_rng(seed)
+    cat = rng.integers(0, n_cat, size=n)
+    eff = rng.normal(size=n_cat) * 2.0
+    Xn = rng.normal(size=(n, 3))
+    logit = eff[cat] + Xn[:, 0] + 0.3 * rng.normal(size=n)
+    y = (logit > 0).astype(np.float64)
+    X = np.column_stack([cat.astype(np.float64), Xn])
+    return X, y
+
+
+class TestCatBinning:
+    def test_value_identity_bins(self):
+        vals = np.array([7.0, 3.0, 11.0])  # frequency order
+        col = np.array([3.0, 7.0, 11.0, 5.0, np.nan, 7.0])
+        bins = cat_to_bins(col, vals)
+        # 7 -> bin 1, 3 -> bin 2, 11 -> bin 3; unseen/NaN -> 0
+        np.testing.assert_array_equal(bins, [2, 1, 3, 0, 0, 1])
+
+    def test_mapper_orders_by_frequency(self):
+        X = np.array([[5.0], [5.0], [5.0], [2.0], [2.0], [9.0]])
+        _, mp = bin_dataset(X, max_bin=15, categorical_features=[0])
+        np.testing.assert_array_equal(mp.cat_values[0], [5.0, 2.0, 9.0])
+        assert mp.is_categorical(0) and mp.num_bins[0] == 4
+
+    def test_capacity_overflow_goes_missing(self):
+        X = np.arange(20, dtype=np.float64)[:, None]
+        bins, mp = bin_dataset(X, max_bin=8, categorical_features=[0])
+        assert len(mp.cat_values[0]) == 7  # max_bin - 1 value bins
+        assert (bins == 0).sum() == 13  # the rest -> missing bin
+
+    def test_csr_rejects_categorical(self):
+        from mmlspark_tpu.data.sparse import CSRMatrix
+
+        csr = CSRMatrix(
+            indptr=np.array([0, 1]), indices=np.array([0]),
+            data=np.array([1.0]), shape=(1, 2),
+        )
+        with pytest.raises(ValueError, match="categorical"):
+            bin_dataset(csr, max_bin=15, categorical_features=[0])
+
+
+class TestCatTraining:
+    def test_beats_numeric_coding_and_matches_sklearn(self):
+        X, y = _cat_data()
+        ones = np.ones(len(y))
+        base = dict(objective="binary", num_iterations=20, num_leaves=15, max_bin=63)
+        b0, m0 = bin_dataset(X, max_bin=63)
+        a_num = auc(y, train(b0, y, TrainOptions(**base), mapper=m0)
+                    .booster.raw_margin(X)[:, 0], ones)
+        b1, m1 = bin_dataset(X, max_bin=63, categorical_features=[0])
+        r = train(b1, y, TrainOptions(**base), mapper=m1)
+        a_cat = auc(y, r.booster.raw_margin(X)[:, 0], ones)
+        assert r.booster.has_categorical
+        assert a_cat > a_num  # set splits isolate categories a cut cannot
+
+        from sklearn.ensemble import HistGradientBoostingClassifier
+        from sklearn.metrics import roc_auc_score
+
+        clf = HistGradientBoostingClassifier(
+            max_iter=20, max_leaf_nodes=15, categorical_features=[0],
+            early_stopping=False,
+        )
+        clf.fit(X, y)
+        a_sk = roc_auc_score(y, clf.decision_function(X))
+        assert a_cat >= a_sk - 0.01, (a_cat, a_sk)
+
+    def test_depthwise_growth(self):
+        X, y = _cat_data(n=2500, n_cat=8, seed=2)
+        bins, mp = bin_dataset(X, max_bin=31, categorical_features=[0])
+        r = train(
+            bins, y,
+            TrainOptions(objective="binary", num_iterations=6, num_leaves=15,
+                         max_bin=31, growth="depthwise", max_depth=4),
+            mapper=mp,
+        )
+        assert r.booster.has_categorical
+        a = auc(y, r.booster.raw_margin(X)[:, 0], np.ones(len(y)))
+        assert a > 0.9, a
+
+    def test_u_histogram_path(self):
+        X, y = _cat_data(n=2500, n_cat=8, seed=3)
+        bins, mp = bin_dataset(X, max_bin=31, categorical_features=[0])
+        base = dict(objective="binary", num_iterations=6, num_leaves=15, max_bin=31)
+        r0 = train(bins, y, TrainOptions(**base), mapper=mp)
+        ru = train(bins, y, TrainOptions(**base, histogram_method="u"), mapper=mp)
+        a0 = auc(y, r0.booster.raw_margin(X)[:, 0], np.ones(len(y)))
+        au = auc(y, ru.booster.raw_margin(X)[:, 0], np.ones(len(y)))
+        assert abs(a0 - au) < 0.005, (a0, au)
+
+    def test_max_cat_threshold_caps_set_size(self):
+        X, y = _cat_data(n=4000, n_cat=40, seed=4)
+        bins, mp = bin_dataset(X, max_bin=63, categorical_features=[0])
+        r = train(
+            bins, y,
+            TrainOptions(objective="binary", num_iterations=5, num_leaves=15,
+                         max_bin=63, max_cat_threshold=3),
+            mapper=mp,
+        )
+        b = r.booster
+        sizes = b.cat_masks[b.cat_nodes].sum(axis=-1)
+        assert sizes.size and sizes.max() <= 3
+
+    def test_valid_set_and_early_stopping_route_cats(self):
+        X, y = _cat_data(n=3000, seed=5)
+        bins, mp = bin_dataset(X, max_bin=31, categorical_features=[0])
+        bv, _ = bin_dataset(X[:500], max_bin=31, mapper=mp)
+        r = train(
+            bins, y,
+            TrainOptions(objective="binary", num_iterations=10, num_leaves=7,
+                         max_bin=31, early_stopping_round=5),
+            mapper=mp,
+            valid_sets=[("v", bv, y[:500], None)],
+        )
+        scores = r.evals["v"]["auc"]
+        assert len(scores) >= 5 and scores[-1] > 0.9
+
+    def test_unseen_category_and_nan_route_right(self):
+        X, y = _cat_data(n=2000, seed=6)
+        bins, mp = bin_dataset(X, max_bin=31, categorical_features=[0])
+        b = train(bins, y, TrainOptions(objective="binary", num_iterations=5,
+                                        num_leaves=7, max_bin=31), mapper=mp).booster
+        Xu = X[:3].copy()
+        Xu[0, 0] = 999.0
+        Xu[1, 0] = np.nan
+        out = b.raw_margin(Xu)
+        assert np.isfinite(out).all()
+        # unseen and NaN take the same (right) path at every cat node
+        np.testing.assert_allclose(out[0], out[1], rtol=1e-6)
+
+    def test_shap_additivity_and_leaf_predict(self):
+        X, y = _cat_data(n=1500, seed=7)
+        bins, mp = bin_dataset(X, max_bin=31, categorical_features=[0])
+        b = train(bins, y, TrainOptions(objective="binary", num_iterations=4,
+                                        num_leaves=7, max_bin=31), mapper=mp).booster
+        sh = b.features_shap(X[:100]).sum(-1)[:, 0]
+        np.testing.assert_allclose(sh, b.raw_margin(X[:100])[:, 0],
+                                   rtol=1e-4, atol=1e-4)
+        leaves = b.predict_leaf(X[:100])
+        assert leaves.shape == (100, b.num_trees)
+        assert np.asarray(b.is_leaf)[0][leaves[:, 0]].all()
+
+
+class TestCatSerde:
+    def test_json_round_trip(self):
+        X, y = _cat_data(n=1500, seed=8)
+        bins, mp = bin_dataset(X, max_bin=31, categorical_features=[0])
+        b = train(bins, y, TrainOptions(objective="binary", num_iterations=4,
+                                        num_leaves=7, max_bin=31), mapper=mp).booster
+        b2 = Booster.from_string(b.to_json_string())
+        assert b2.has_categorical
+        np.testing.assert_allclose(b2.raw_margin(X[:300]), b.raw_margin(X[:300]),
+                                   rtol=1e-6)
+
+    def test_model_text_round_trip(self):
+        X, y = _cat_data(n=2000, seed=9)
+        bins, mp = bin_dataset(X, max_bin=31, categorical_features=[0])
+        b = train(bins, y, TrainOptions(objective="binary", num_iterations=6,
+                                        num_leaves=7, max_bin=31), mapper=mp).booster
+        text = to_lightgbm_text(b)
+        assert "cat_boundaries=" in text and "cat_threshold=" in text
+        b2 = from_lightgbm_text(text)
+        np.testing.assert_allclose(b2.raw_margin(X)[:, 0], b.raw_margin(X)[:, 0],
+                                   rtol=1e-5, atol=1e-5)
+        Xu = X[:4].copy()
+        Xu[0, 0] = 777.0
+        Xu[1, 0] = np.nan
+        np.testing.assert_allclose(b2.raw_margin(Xu), b.raw_margin(Xu),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_non_integer_categories_refuse_export(self):
+        X, y = _cat_data(n=1000, seed=10)
+        X[:, 0] = X[:, 0] + 0.5  # fractional category values
+        bins, mp = bin_dataset(X, max_bin=31, categorical_features=[0])
+        b = train(bins, y, TrainOptions(objective="binary", num_iterations=3,
+                                        num_leaves=7, max_bin=31), mapper=mp).booster
+        if b.has_categorical:
+            with pytest.raises(ValueError, match="non-negative integers"):
+                to_lightgbm_text(b)
+
+
+class TestPinnedLightGBMCatModel:
+    """The checked-in LightGBM-format categorical model file: hand-verified
+    bitsets (set {1, 3, 34} spans two uint32 words: 10 = 2^1+2^3, 4 = 2^2
+    at offset 32), so the interop path runs in every environment, pip
+    ``lightgbm`` or not."""
+
+    def test_import_and_hand_computed_predictions(self):
+        with open(FIXTURE) as f:
+            b = Booster.from_string(f.read())
+        assert b.has_categorical
+        np.testing.assert_array_equal(sorted(b.cat_values[0]), [1, 3, 34])
+        X = np.array([
+            [1.0, 0.0],    # in set -> 1.5 ; 0 <= 0.25 -> 0.2
+            [34.0, 1.0],   # in set -> 1.5 ; 1 > 0.25 -> -0.3
+            [2.0, 0.0],    # not in set -> -0.5 ; 0.2
+            [40.0, 0.0],   # unseen -> -0.5 ; 0.2
+            [np.nan, np.nan],  # NaN cat -> right -0.5; NaN num, missing none -> like 0.0 -> 0.2
+        ])
+        margins = b.raw_margin(X)[:, 0]
+        np.testing.assert_allclose(
+            margins, [1.7, 1.2, -0.3, -0.3, -0.3], rtol=1e-6, atol=1e-6
+        )
+
+    def test_reexport_preserves_bitsets(self):
+        with open(FIXTURE) as f:
+            b = Booster.from_string(f.read())
+        text = to_lightgbm_text(b)
+        assert "cat_threshold=10 4" in text
+        b2 = from_lightgbm_text(text)
+        X = np.array([[1.0, 0.0], [2.0, 1.0], [34.0, 0.3]])
+        np.testing.assert_allclose(b2.raw_margin(X), b.raw_margin(X), rtol=1e-6)
+
+
+class TestCatEstimatorAPI:
+    def test_classifier_slot_indexes_and_names(self):
+        X, y = _cat_data(n=2000, seed=11)
+        t = Table({"features": X, "label": y})
+        m1 = LightGBMClassifier(
+            numIterations=5, numLeaves=7, categoricalSlotIndexes=[0]
+        ).fit(t)
+        assert m1.booster.has_categorical
+        m2 = LightGBMClassifier(
+            numIterations=5, numLeaves=7, categoricalSlotNames=["f0"]
+        ).fit(t)
+        np.testing.assert_allclose(
+            m2.booster.raw_margin(X), m1.booster.raw_margin(X), rtol=1e-6
+        )
+        with pytest.raises(ValueError, match="unknown feature name"):
+            LightGBMClassifier(
+                numIterations=2, categoricalSlotNames=["nope"]
+            ).fit(t)
+
+    def test_regressor_with_cats_and_save_load(self, tmp_path):
+        X, y0 = _cat_data(n=1500, seed=12)
+        yr = y0 * 3.0 + X[:, 1]
+        t = Table({"features": X, "label": yr})
+        m = LightGBMRegressor(
+            numIterations=5, numLeaves=7, categoricalSlotIndexes=[0]
+        ).fit(t)
+        p = tmp_path / "cat_model"
+        m.save(str(p))
+        from mmlspark_tpu.core.serialize import load_stage
+
+        m2 = load_stage(str(p))
+        np.testing.assert_allclose(
+            m2.booster.raw_margin(X), m.booster.raw_margin(X), rtol=1e-6
+        )
+
+    def test_native_model_save_load_with_cats(self, tmp_path):
+        X, y = _cat_data(n=1500, seed=13)
+        t = Table({"features": X, "label": y})
+        m = LightGBMClassifier(
+            numIterations=4, numLeaves=7, categoricalSlotIndexes=[0]
+        ).fit(t)
+        p = tmp_path / "native.txt"
+        m.save_native_model(str(p))
+        m2 = type(m).load_native_model(str(p))
+        np.testing.assert_allclose(
+            m2.booster.raw_margin(X)[:, 0], m.booster.raw_margin(X)[:, 0],
+            rtol=1e-5, atol=1e-5,
+        )

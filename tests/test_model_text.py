@@ -201,8 +201,9 @@ shrinkage=1
         )
 
     @pytest.mark.parametrize("mutation,err", [
-        (("num_cat=0", "num_cat=1"), "categorical"),
-        (("decision_type=10 8", "decision_type=10 5"), "categorical"),
+        # a categorical decision_type bit without the cat bitset arrays is
+        # structurally invalid (well-formed cat models import since round 4)
+        (("decision_type=10 8", "decision_type=10 9"), "cat_boundaries"),
         (("decision_type=10 8", "decision_type=10 6"), "zero_as_missing"),
         (("is_linear=0", "is_linear=1"), "linear"),
     ])
