@@ -239,8 +239,7 @@ def _split_search(
         ratio = gsum / (hsum + opts.cat_smooth)
         l2c = l2 + opts.cat_l2
         big = jnp.float32(np.finfo(np.float32).max)
-        tgc = _soft_threshold(g_tot, l1)
-        parent_c = (tgc * tgc) / (h_tot + l2c)
+        parent_c = (tg * tg) / (h_tot + l2c)  # tg shared with the numeric branch
         fm_c = feature_mask[cat_idx]
         dir_data = []
         for sign in (1.0, -1.0):
@@ -464,7 +463,11 @@ def _build_tree_depthwise(
         s = _split_search(hist, totals, edges, feature_mask, opts, lr=lr)
 
         can_split = alive & jnp.isfinite(s.gain) & (s.gain > opts.min_gain_to_split)
-        value_cur = jnp.where(alive, s.value, inherited)
+        # A node's value-if-it-ends-here is what its PARENT's split assigned
+        # (``inherited`` — which carries the l2+cat_l2 output for children of
+        # categorical splits); recomputing from own totals would silently
+        # drop that regularization. The root has no parent: use its own.
+        value_cur = s.value if d == 0 else inherited
         cover_here = jnp.where(alive, s.cover, cover_cur)
 
         # Record this level (dead/non-split nodes: bin=b ⇒ every row left, thr=+inf).

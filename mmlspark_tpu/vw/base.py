@@ -80,40 +80,72 @@ class VowpalWabbitBaseParams(
     initialModel = Param("Warm-start weights", is_complex=True)
     interactions = Param("Namespace interaction pairs (handled by VowpalWabbitInteractions)", default=[], is_complex=False)
 
+    # flag -> (out key, converter); None converter = boolean switch
+    _ARG_SPEC = {
+        "--loss_function": ("loss", str),
+        "--learning_rate": ("learning_rate", float),
+        "-l": ("learning_rate", float),
+        "--passes": ("passes", int),
+        "--l1": ("l1", float),
+        "--l2": ("l2", float),
+        "--power_t": ("power_t", float),
+        "-b": ("num_bits", int),
+        "--bit_precision": ("num_bits", int),
+        "--quantile_tau": ("quantile_tau", float),
+        "--ftrl": ("ftrl", None),
+        "--ftrl_alpha": ("ftrl_alpha", float),
+        "--ftrl_beta": ("ftrl_beta", float),
+        "--link": ("link", str),
+        "--noconstant": ("noconstant", None),
+        # NOTE: hashing happens in the (separate) VowpalWabbitFeaturizer
+        # stage in this runtime, so --hash_seed here governs LEARNER-side
+        # hashing only (the constant feature / un-featurized spaces). To
+        # move the whole feature space, set hashSeed on the featurizer —
+        # unlike native VW, where the learner owns all hashing.
+        "--hash_seed": ("hash_seed", int),
+    }
+
     def _parse_args(self) -> dict:
-        """Parse the few VW CLI flags users commonly pass through
-        (``appendParamIfNotThere`` analogue, VowpalWabbitBase.scala:140-159)."""
+        """Parse the VW CLI flags this runtime implements
+        (``appendParamIfNotThere`` analogue, VowpalWabbitBase.scala:140-159).
+        Unknown flags RAISE: the reference hands the full string to native
+        VW where every reduction works — silently dropping a flag here would
+        train a different model than the user asked for."""
         out = {}
         toks = self.getPassThroughArgs().split()
         i = 0
         while i < len(toks):
             t = toks[i]
-
-            def val():
+            inline = None
+            if t.startswith("--") and "=" in t:
+                t, _, inline = t.partition("=")
+            if t not in self._ARG_SPEC:
+                raise ValueError(
+                    f"passThroughArgs: unsupported VW flag {t!r}. This "
+                    "runtime implements: "
+                    + " ".join(sorted(self._ARG_SPEC))
+                    + ". Other VW reductions/flags are not silently ignored "
+                    "— they would change the trained model."
+                )
+            key, conv = self._ARG_SPEC[t]
+            if conv is None:  # boolean switch
+                if inline is not None:
+                    raise ValueError(f"passThroughArgs flag {t!r} takes no value")
+                out[key] = True
+                i += 1
+                continue
+            if inline is None:
                 if i + 1 >= len(toks):
                     raise ValueError(f"passThroughArgs flag {t!r} expects a value")
-                return toks[i + 1]
-
-            if t in ("--loss_function",):
-                out["loss"] = val()
-                i += 2
-            elif t in ("--learning_rate", "-l"):
-                out["learning_rate"] = float(val())
-                i += 2
-            elif t == "--passes":
-                out["passes"] = int(val())
-                i += 2
-            elif t in ("--l1", "--l2", "--power_t"):
-                out[t[2:]] = float(val())
-                i += 2
-            elif t in ("-b", "--bit_precision"):
-                out["num_bits"] = int(val())
-                i += 2
-            elif t == "--quantile_tau":
-                out["quantile_tau"] = float(val())
+                inline = toks[i + 1]
                 i += 2
             else:
                 i += 1
+            out[key] = conv(inline)
+        if out.get("link") not in (None, "identity", "logistic"):
+            raise ValueError(
+                f"--link {out['link']!r} not supported (identity | logistic)"
+            )
         return out
 
 
@@ -123,13 +155,16 @@ class VowpalWabbitBase(VowpalWabbitBaseParams, Estimator):
     def _label_transform(self, y: np.ndarray) -> np.ndarray:
         return y.astype(np.float32)
 
-    def _get_batch(self, table: Table) -> Tuple[SparseBatch, bool]:
-        """Returns (batch, is_hashed_space)."""
+    def _get_batch(self, table: Table, num_bits=None) -> Tuple[SparseBatch, bool]:
+        """Returns (batch, is_hashed_space). ``num_bits`` overrides the
+        param (the ``-b``/``--bit_precision`` pass-through flag); a
+        pre-featurized column's ``sparse_dim`` metadata wins over both
+        (the space was fixed upstream by VowpalWabbitFeaturizer)."""
         col = table.column(self.getFeaturesCol())
         if col.dtype == object:
             dim = table.metadata(self.getFeaturesCol()).get("sparse_dim")
             if dim is None:
-                dim = 1 << self.getNumBits()
+                dim = 1 << (num_bits or self.getNumBits())
             return column_to_batch(col, dim), True
         # dense vector column: positions are the features; slot f is the bias
         dense = np.asarray(col, dtype=np.float32)
@@ -137,7 +172,7 @@ class VowpalWabbitBase(VowpalWabbitBaseParams, Estimator):
 
     def _fit(self, table: Table) -> "VowpalWabbitModelBase":
         args = self._parse_args()
-        batch, is_hashed = self._get_batch(table)
+        batch, is_hashed = self._get_batch(table, num_bits=args.get("num_bits"))
         y = self._label_transform(
             np.asarray(table.column(self.getLabelCol()), dtype=np.float64)
         )
@@ -146,11 +181,14 @@ class VowpalWabbitBase(VowpalWabbitBaseParams, Estimator):
             if self.isSet("weightCol")
             else np.ones(batch.num_rows, dtype=np.float32)
         )
-        if is_hashed:
+        hash_seed = args.get("hash_seed", self.getHashSeed())
+        if args.get("noconstant"):
+            const_idx = -1  # --noconstant: no bias feature anywhere
+        elif is_hashed:
             # hashed feature space: the constant feature hashes like any other
             const_idx = int(
                 mask_bits(
-                    np.asarray([murmur32_bytes(CONSTANT_FEATURE, self.getHashSeed())]),
+                    np.asarray([murmur32_bytes(CONSTANT_FEATURE, hash_seed)]),
                     int(np.log2(batch.dim)),
                 )[0]
             )
@@ -176,9 +214,14 @@ class VowpalWabbitBase(VowpalWabbitBaseParams, Estimator):
             constant_index=const_idx,
             initial_weights=init,
             quantile_tau=args.get("quantile_tau", 0.5),
+            optimizer="ftrl" if args.get("ftrl") else "adagrad",
+            ftrl_alpha=args.get("ftrl_alpha", 0.005),
+            ftrl_beta=args.get("ftrl_beta", 0.1),
             mesh=self._select_mesh(),
         )
+        self._link = args.get("link", "identity")
         model = self._make_model(result, batch.dim, const_idx)
+        model.set("linkFunction", self._link)
         model.parent = self
         return model
 
@@ -210,10 +253,14 @@ def train_linear(
     constant_index: int,
     initial_weights: Optional[np.ndarray] = None,
     quantile_tau: float = 0.5,
+    optimizer: str = "adagrad",
+    ftrl_alpha: float = 0.005,
+    ftrl_beta: float = 0.1,
     mesh: Optional[Any] = None,
 ) -> VWTrainResult:
-    """Adagrad SGD over padded minibatches; per-pass pmean weight averaging
-    across mesh shards (VW endPass allreduce)."""
+    """Adagrad SGD (or FTRL-Proximal, VW ``--ftrl``) over padded
+    minibatches; per-pass pmean state averaging across mesh shards (VW
+    endPass allreduce). ``constant_index < 0`` = ``--noconstant``."""
     import jax
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
@@ -222,12 +269,15 @@ def train_linear(
     n, k = batch.indices.shape
     dim = batch.dim
 
-    # append the constant feature to every row
-    idx = np.concatenate(
-        [batch.indices, np.full((n, 1), constant_index, dtype=np.int32)], axis=1
-    )
-    val = np.concatenate([batch.values, np.ones((n, 1), dtype=np.float32)], axis=1)
-    k += 1
+    if constant_index >= 0:
+        # append the constant feature to every row
+        idx = np.concatenate(
+            [batch.indices, np.full((n, 1), constant_index, dtype=np.int32)], axis=1
+        )
+        val = np.concatenate([batch.values, np.ones((n, 1), dtype=np.float32)], axis=1)
+        k += 1
+    else:
+        idx, val = batch.indices, batch.values
 
     n_shards = int(mesh.shape["data"]) if mesh is not None else 1
     rows_per_shard = -(-n // n_shards)  # ceil
@@ -278,6 +328,33 @@ def train_linear(
         )
         return weights, acc, t0
 
+    def ftrl_w(z, nacc):
+        """FTRL-Proximal closed-form weights from the (z, n) accumulators."""
+        w = -(z - jnp.sign(z) * l1) / (
+            (ftrl_beta + jnp.sqrt(nacc)) / ftrl_alpha + l2
+        )
+        return jnp.where(jnp.abs(z) > l1, w, 0.0)
+
+    def run_pass_ftrl(z, nacc, bidx, bval, by, bw, t0):
+        """FTRL-Proximal (VW --ftrl; McMahan et al.): per-coordinate (z, n)
+        state, weights materialized lazily on the touched coordinates."""
+
+        def step(carry, xs):
+            z, nacc, t = carry
+            bi, bv, yy, ww = xs
+            zi, ni = z[bi], nacc[bi]  # (B, K) gathers
+            wi = ftrl_w(zi, ni)
+            margin = jnp.sum(wi * bv, axis=1)
+            g = (_loss_grad(loss, margin, yy, quantile_tau) * ww)[:, None] * bv
+            sigma = (jnp.sqrt(ni + g * g) - jnp.sqrt(ni)) / ftrl_alpha
+            flat_i = bi.reshape(-1)
+            z = z.at[flat_i].add((g - sigma * wi).reshape(-1))
+            nacc = nacc.at[flat_i].add((g * g).reshape(-1))
+            return (z, nacc, t + 1.0), None
+
+        (z, nacc, t0), _ = jax.lax.scan(step, (z, nacc, t0), (bidx, bval, by, bw))
+        return z, nacc, t0
+
     def fit_fn(idx_s, val_s, y_s, w_s, weights, acc):
         # idx_s etc are this shard's rows: (num_batches*B, K)
         bidx = idx_s.reshape(num_batches, batch_size, k)
@@ -285,6 +362,17 @@ def train_linear(
         by = y_s.reshape(num_batches, batch_size)
         bw = w_s.reshape(num_batches, batch_size)
         t = jnp.zeros(())
+        if optimizer == "ftrl":
+            # warm start: invert the closed form at n=0 (ignoring l1)
+            z = -weights * (ftrl_beta / ftrl_alpha + l2)
+            nacc = acc
+            for _ in range(num_passes):
+                z, nacc, t = run_pass_ftrl(z, nacc, bidx, bval, by, bw, t)
+                if mesh is not None:
+                    z = jax.lax.pmean(z, "data")
+                    nacc = jax.lax.pmean(nacc, "data")
+            # l1 lives inside the closed form — no extra lazy shrink
+            return ftrl_w(z, nacc), nacc
         for _ in range(num_passes):
             weights, acc, t = run_pass(weights, acc, bidx, bval, by, bw, t)
             if mesh is not None:
@@ -332,8 +420,9 @@ class VowpalWabbitModelBase(HasFeaturesCol, HasPredictionCol, Model):
 
     modelWeights = Param("Fitted weight vector", is_complex=True)
     sparseDim = Param("Feature-space size", default=0, converter=to_int)
-    constantIndex = Param("Bias feature index", default=0, converter=to_int)
+    constantIndex = Param("Bias feature index (-1 = trained --noconstant)", default=0, converter=to_int)
     numBits = Param("log2 feature-space size for dense inputs", default=18, converter=to_int)
+    linkFunction = Param("Prediction link (--link): identity or logistic", default="identity", converter=to_str)
 
     def _margins(self, table: Table) -> np.ndarray:
         col = table.column(self.getFeaturesCol())
@@ -343,7 +432,13 @@ class VowpalWabbitModelBase(HasFeaturesCol, HasPredictionCol, Model):
         else:
             batch = dense_to_batch(np.asarray(col, dtype=np.float32), len(w))
         m = (w[batch.indices] * batch.values).sum(axis=1)
-        return m + w[self.getConstantIndex()]
+        ci = self.getConstantIndex()
+        return m if ci < 0 else m + w[ci]
+
+    def _apply_link(self, m: np.ndarray) -> np.ndarray:
+        if self.getLinkFunction() == "logistic":
+            return 1.0 / (1.0 + np.exp(-m))
+        return m
 
     def get_performance_statistics(self) -> Table:
         """Diagnostics DataFrame analogue (VowpalWabbitBase.scala:367-391)."""

@@ -32,5 +32,6 @@ class VowpalWabbitRegressor(VowpalWabbitBase):
 class VowpalWabbitRegressionModel(VowpalWabbitModelBase):
     def transform(self, table: Table) -> Table:
         return table.with_column(
-            self.getPredictionCol(), self._margins(table).astype(np.float64)
+            self.getPredictionCol(),
+            self._apply_link(self._margins(table)).astype(np.float64),
         )
