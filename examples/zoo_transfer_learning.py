@@ -1,0 +1,136 @@
+"""Example: trained-weight zoo artifact -> ModelDownloader -> ImageFeaturizer.
+
+    python examples/zoo_transfer_learning.py          # full (TPU-sized) run
+    ZOO_STEPS=40 python examples/zoo_transfer_learning.py   # CI-sized smoke
+
+The reference's flagship transfer-learning flow (a TRAINED model from the
+downloader repository feeding ImageFeaturizer, ``ModelDownloader.scala:125``
++ ``ImageFeaturizer.scala:40-86``) — with the weights genuinely LEARNED on
+this rig (zero egress, so no ImageNet download): a ResNet-18 is pretrained
+on five translation-randomized shape classes, published into a local model
+repository as a ModelSchema artifact, downloaded back (hash-verified), and
+its pooled features transferred to two UNSEEN shape classes, where they
+beat both logistic-on-pixels and random-init features by a wide margin
+(positions are random, so raw pixels carry little transferable signal —
+exactly the regime transfer learning exists for).
+
+Measured on the v5e (400 steps): transfer accuracy 0.86 with trained
+features vs 0.72 random-init vs 0.63 pixels (docs/zoo_transfer.md).
+"""
+
+import os
+import sys
+import warnings
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from mmlspark_tpu.data.table import Table
+from mmlspark_tpu.downloader.repository import LocalRepo, ModelDownloader
+from mmlspark_tpu.image import ImageFeaturizer
+from mmlspark_tpu.models import (
+    init_resnet,
+    load_zoo_params,
+    publish_model,
+    train_resnet_classifier,
+)
+
+STEPS = int(os.environ.get("ZOO_STEPS", 400))
+N_PER = int(os.environ.get("ZOO_N_PER", 240 if STEPS >= 300 else 60))
+SIZE = 32
+
+
+def draw(shape, rng, size=SIZE):
+    img = rng.normal(0, 0.15, size=(size, size)).astype(np.float32)
+    s = rng.integers(8, 13)
+    cy, cx = rng.integers(s // 2 + 2, size - s // 2 - 2, size=2)
+    yy, xx = np.mgrid[0:size, 0:size]
+    dy, dx = yy - cy, xx - cx
+    if shape == "square":
+        m = (abs(dy) <= s // 2) & (abs(dx) <= s // 2)
+    elif shape == "circle":
+        m = dy * dy + dx * dx <= (s // 2) ** 2
+    elif shape == "cross":
+        m = ((abs(dy) <= 1) | (abs(dx) <= 1)) & (abs(dy) <= s // 2) & (abs(dx) <= s // 2)
+    elif shape == "hstripes":
+        m = (abs(dy) <= s // 2) & (abs(dx) <= s // 2) & (dy % 3 == 0)
+    elif shape == "vstripes":
+        m = (abs(dy) <= s // 2) & (abs(dx) <= s // 2) & (dx % 3 == 0)
+    elif shape == "ring":
+        r2 = dy * dy + dx * dx
+        m = (r2 <= (s // 2) ** 2) & (r2 >= (s // 2 - 2) ** 2)
+    elif shape == "frame":
+        m = (abs(dy) <= s // 2) & (abs(dx) <= s // 2) & (
+            (abs(dy) >= s // 2 - 1) | (abs(dx) >= s // 2 - 1)
+        )
+    img[m] += 1.0
+    return np.clip(img, 0, 1.5)
+
+
+def make(shapes, n_per, seed):
+    rng = np.random.default_rng(seed)
+    X = np.stack([draw(s, rng) for s in np.repeat(shapes, n_per)])
+    y = np.repeat(np.arange(len(shapes)), n_per)
+    perm = rng.permutation(len(y))
+    return X[perm], y[perm]
+
+
+def main():
+    warnings.filterwarnings("ignore")
+    # 1. Pretrain on five shape classes (random positions/sizes).
+    Xp, yp = make(["square", "circle", "cross", "hstripes", "vstripes"], N_PER, 0)
+    params = init_resnet(variant="resnet18", num_classes=5, small_inputs=True,
+                         in_channels=1)
+    trained, acc = train_resnet_classifier(
+        params, Xp[:, None], yp, num_steps=STEPS, batch_size=64
+    )
+    print(f"pretrain accuracy: {acc:.3f} ({STEPS} steps)")
+
+    # 2. Publish the TRAINED weights as a repository artifact, then consume
+    #    it the way the reference does: downloader -> featurizer.
+    import tempfile
+
+    repo_dir = tempfile.mkdtemp(prefix="zoo_repo_")
+    cache_dir = tempfile.mkdtemp(prefix="zoo_cache_")
+    publish_model(repo_dir, "resnet18_shapes", trained, (SIZE, SIZE))
+    dl = ModelDownloader(cache_dir, LocalRepo(repo_dir))
+    print("repository models:", [s.name for s in dl.list_models()])
+    loaded = load_zoo_params(dl, "resnet18_shapes")
+
+    # 3. Transfer: features for two UNSEEN shape classes.
+    Xt, yt = make(["ring", "frame"], max(120, N_PER), 7)
+    imgs = np.empty(len(yt), dtype=object)
+    for i in range(len(yt)):
+        imgs[i] = Xt[i][:, :, None]  # HWC
+    t = Table({"image": imgs, "label": yt.astype(np.float64)})
+
+    def featurize(p):
+        return ImageFeaturizer(
+            inputCol="image", outputCol="features", modelParams=p,
+            inputHeight=SIZE, inputWidth=SIZE, scale=1.0, batchSize=64,
+        ).transform(t)["features"]
+
+    from sklearn.linear_model import LogisticRegression
+    from sklearn.model_selection import cross_val_score
+
+    def cv(X):
+        return cross_val_score(LogisticRegression(max_iter=500), X, yt, cv=3).mean()
+
+    acc_trained = cv(np.asarray(featurize(loaded)))
+    acc_random = cv(np.asarray(featurize(params)))
+    acc_pixels = cv(Xt.reshape(len(yt), -1))
+    print(f"transfer accuracy — trained zoo features: {acc_trained:.4f}, "
+          f"random-init features: {acc_random:.4f}, raw pixels: {acc_pixels:.4f}")
+
+    if STEPS >= 300:
+        assert acc_trained >= acc_pixels + 0.10, (acc_trained, acc_pixels)
+        assert acc_trained >= acc_random + 0.05, (acc_trained, acc_random)
+        print("OK: trained zoo features beat pixels by >=0.10 and "
+              "random-init by >=0.05")
+    else:
+        print("(smoke run: margin assertions need ZOO_STEPS >= 300)")
+
+
+if __name__ == "__main__":
+    main()

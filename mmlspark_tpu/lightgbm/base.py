@@ -57,7 +57,29 @@ class LightGBMParams(
     numLeaves = Param("Max leaves per tree", default=31, converter=to_int, validator=gt(1))
     maxDepth = Param("Max tree depth (-1 = derive from numLeaves)", default=-1, converter=to_int)
     maxBin = Param("Max number of feature bins", default=255, converter=to_int, validator=gt(1))
+    binSampleCount = Param(
+        "Rows sampled when computing histogram bin edges "
+        "(bin_construct_sample_cnt)",
+        default=200000, converter=to_int, validator=gt(0),
+    )
+    maxBinByFeature = Param(
+        "Per-feature max-bin override (empty = maxBin everywhere)",
+        default=[], converter=to_list_int,
+    )
+    slotNames = Param(
+        "Feature slot names (overrides the generated f0..fN; also the "
+        "namespace categoricalSlotNames resolves against)",
+        default=[], converter=to_list_str,
+    )
     baggingFraction = Param("Row subsample fraction", default=1.0, converter=to_float, validator=in_range(0, 1))
+    posBaggingFraction = Param(
+        "Positive-class bagging fraction (binary; 1.0 = off)",
+        default=1.0, converter=to_float, validator=in_range(0, 1),
+    )
+    negBaggingFraction = Param(
+        "Negative-class bagging fraction (binary; 1.0 = off)",
+        default=1.0, converter=to_float, validator=in_range(0, 1),
+    )
     baggingFreq = Param("Resample bagging mask every k iterations (0=off)", default=0, converter=to_int, validator=ge(0))
     baggingSeed = Param("Bagging seed", default=3, converter=to_int)
     featureFraction = Param("Feature subsample fraction per tree", default=1.0, converter=to_float, validator=in_range(0, 1))
@@ -128,6 +150,14 @@ class LightGBMParams(
         "Extra L2 applied to categorical split gains",
         default=10.0, converter=to_float, validator=ge(0),
     )
+    boostFromAverage = Param(
+        "Start boosting from the label average init score (false = from 0)",
+        default=True, converter=to_bool,
+    )
+    isProvideTrainingMetric = Param(
+        "Record the train-set metric each iteration (evals['training'])",
+        default=False, converter=to_bool,
+    )
     numBatches = Param("Split training into sequential batches (0=off)", default=0, converter=to_int, validator=ge(0))
     modelString = Param("Warm-start booster string", default="", converter=to_str)
     verbosity = Param("Verbosity", default=-1, converter=to_int)
@@ -157,6 +187,8 @@ class LightGBMParams(
             min_sum_hessian_in_leaf=self.getMinSumHessianInLeaf(),
             min_gain_to_split=self.getMinGainToSplit(),
             bagging_fraction=self.getBaggingFraction(),
+            pos_bagging_fraction=self.getPosBaggingFraction(),
+            neg_bagging_fraction=self.getNegBaggingFraction(),
             bagging_freq=self.getBaggingFreq(),
             feature_fraction=self.getFeatureFraction(),
             max_delta_step=self.getMaxDeltaStep(),
@@ -181,6 +213,8 @@ class LightGBMParams(
             max_cat_threshold=self.getMaxCatThreshold(),
             cat_smooth=self.getCatSmooth(),
             cat_l2=self.getCatL2(),
+            boost_from_average=self.getBoostFromAverage(),
+            provide_training_metric=self.getIsProvideTrainingMetric(),
         )
         kwargs.update(self._extra_train_options())
         return TrainOptions(**kwargs)
@@ -208,6 +242,10 @@ class LightGBMBase(LightGBMParams, Estimator):
 
     def _num_classes(self, y: np.ndarray) -> int:
         return 1
+
+    def _adjust_weights(self, y: np.ndarray, w):
+        """Label-dependent weight hook (isUnbalance lives in the classifier)."""
+        return w
 
     def _select_mesh(self):
         """Mesh selection = the ClusterUtil worker-count computation
@@ -264,14 +302,26 @@ class LightGBMBase(LightGBMParams, Estimator):
         X, y, w, init = self._prepare(
             table, num_features=prev.num_features if prev else 0
         )
+        w = self._adjust_weights(y, w)
         num_class = self._num_classes(y)
         opts = self._make_options(num_class)
 
+        # Feature slot names: slotNames overrides the generated f0..fN
+        # (LightGBMParams slotNames) and is the namespace categorical names
+        # resolve against.
+        num_features = X.shape[1] if hasattr(X, "shape") else X.num_features
+        slot_names = self.getSlotNames() or []
+        if slot_names and len(slot_names) != num_features:
+            raise ValueError(
+                f"slotNames has {len(slot_names)} entries for "
+                f"{num_features} features"
+            )
+        feature_names = list(slot_names) or [f"f{i}" for i in range(num_features)]
+
         # Categorical slot resolution (LightGBMBase.scala:148-156): indexes
-        # union names resolved against the assembled feature names f0..fN.
+        # union names resolved against the feature slot names.
         cat_slots = set(self.getCategoricalSlotIndexes() or [])
         names = self.getCategoricalSlotNames() or []
-        num_features = X.shape[1] if hasattr(X, "shape") else X.num_features
         bad = sorted(i for i in cat_slots if not (0 <= i < num_features))
         if bad:
             raise ValueError(
@@ -279,7 +329,7 @@ class LightGBMBase(LightGBMParams, Estimator):
                 f"features: {bad}"
             )
         if names:
-            name_to_idx = {f"f{i}": i for i in range(num_features)}
+            name_to_idx = {nm: i for i, nm in enumerate(feature_names)}
             for nm in names:
                 if nm not in name_to_idx:
                     raise ValueError(
@@ -290,6 +340,8 @@ class LightGBMBase(LightGBMParams, Estimator):
         bins, mapper = bin_dataset(
             X, max_bin=opts.max_bin,
             categorical_features=sorted(cat_slots) or None,
+            sample_cnt=self.getBinSampleCount(),
+            max_bin_by_feature=self.getMaxBinByFeature() or None,
         )
         valid_sets = []
         if valid_table is not None and valid_table.num_rows > 0:
@@ -307,7 +359,6 @@ class LightGBMBase(LightGBMParams, Estimator):
             init_margins = prev.raw_margin(X)
 
         num_batches = self.getNumBatches()
-        feature_names = [f"f{i}" for i in range(X.shape[1])]
         if num_batches and num_batches > 1:
             result = self._fit_batches(
                 bins, y, w, init_margins, opts, mapper, mesh, valid_sets, feature_names,
@@ -321,6 +372,10 @@ class LightGBMBase(LightGBMParams, Estimator):
             )
         model = self._make_model(result)
         model.parent = self
+        # per-iteration metric histories (valid sets + 'training' when
+        # isProvideTrainingMetric) — transient, like the reference's
+        # delegate-observed metrics
+        model._train_evals = result.evals
         return model
 
     def _fit_batches(
@@ -332,6 +387,7 @@ class LightGBMBase(LightGBMParams, Estimator):
         n = len(y)
         edges = np.linspace(0, n, num_batches + 1).astype(int)
         boosters: List[Booster] = []
+        merged_evals: dict = {}
         result = None
         for bi in range(num_batches):
             lo, hi = edges[bi], edges[bi + 1]
@@ -348,8 +404,14 @@ class LightGBMBase(LightGBMParams, Estimator):
                 feature_names=feature_names,
             )
             boosters.append(result.booster)
+            # metric histories concatenate across the chained batches (each
+            # batch's scores are its delta booster on its own rows)
+            for name, metrics in result.evals.items():
+                dst = merged_evals.setdefault(name, {})
+                for mname, scores in metrics.items():
+                    dst.setdefault(mname, []).extend(scores)
         merged = _merge_boosters(boosters)
-        return TrainResult(booster=merged, evals=result.evals, best_iteration=result.best_iteration)
+        return TrainResult(booster=merged, evals=merged_evals, best_iteration=result.best_iteration)
 
     def _make_model(self, result: TrainResult) -> "LightGBMModelBase":
         raise NotImplementedError

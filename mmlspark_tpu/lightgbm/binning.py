@@ -63,12 +63,20 @@ def fit_bin_mapper(
     sample_cnt: int = 200_000,
     seed: int = 0,
     categorical_features=None,
+    max_bin_by_feature=None,
 ) -> BinMapper:
     """Compute per-feature quantile edges (LightGBM ``bin_construct_sample_cnt``
-    defaults to 200k sampled rows). ``categorical_features``: indices binned
-    by value identity (one bin per frequent category)."""
+    defaults to 200k sampled rows; ``binSampleCount``). ``categorical_features``:
+    indices binned by value identity (one bin per frequent category).
+    ``max_bin_by_feature``: per-feature bin cap (LightGBM maxBinByFeature;
+    empty/None = the global ``max_bin`` everywhere)."""
     n, f = X.shape
     cat_set = set(int(c) for c in (categorical_features or []))
+    caps = list(max_bin_by_feature or [])
+    if caps and len(caps) != f:
+        raise ValueError(
+            f"maxBinByFeature has {len(caps)} entries for {f} features"
+        )
     if n > sample_cnt:
         rng = np.random.default_rng(seed)
         idx = rng.choice(n, size=sample_cnt, replace=False)
@@ -79,16 +87,17 @@ def fit_bin_mapper(
     edges = np.full((f, max_bin - 1), np.inf, dtype=np.float64)
     num_bins = np.zeros(f, dtype=np.int32)
     cat_values: dict = {}
-    qs = np.linspace(0, 1, max_bin)
     for j in range(f):
+        mb = min(max_bin, int(caps[j])) if caps else max_bin
+        mb = max(mb, 2)
         col = sample[:, j]
         col = col[~np.isnan(col)]
         if j in cat_set:
             u, counts = np.unique(col, return_counts=True)
             # most frequent first (ties by value — deterministic); capacity
-            # max_bin - 1 value bins; the rest fall to missing (-> right)
+            # mb - 1 value bins; the rest fall to missing (-> right)
             order = np.lexsort((u, -counts))
-            vals = u[order][: max_bin - 1]
+            vals = u[order][: mb - 1]
             cat_values[j] = np.asarray(vals, dtype=np.float64)
             num_bins[j] = len(vals) + 1  # + missing bin
             continue
@@ -96,7 +105,7 @@ def fit_bin_mapper(
             num_bins[j] = 1
             continue
         u, counts = np.unique(col, return_counts=True)
-        e = _edges_from_counts(u, counts, max_bin, qs)
+        e = _edges_from_counts(u, counts, mb, np.linspace(0, 1, mb))
         k = len(e)
         edges[j, :k] = e
         num_bins[j] = k + 2  # +1 missing bin, +1 overflow bin above last edge
@@ -198,7 +207,8 @@ def bin_dataset_to_device(
 
 def bin_dataset(
     X, max_bin: int = 255, mapper: Optional[BinMapper] = None,
-    categorical_features=None,
+    categorical_features=None, sample_cnt: int = 200_000,
+    max_bin_by_feature=None,
 ) -> Tuple[np.ndarray, BinMapper]:
     from mmlspark_tpu.data.sparse import CSRMatrix
 
@@ -208,13 +218,19 @@ def bin_dataset(
                 "categorical features are not supported on sparse (CSR) "
                 "input — densify the categorical columns first"
             )
+        if max_bin_by_feature:
+            raise ValueError(
+                "maxBinByFeature is not supported on sparse (CSR) input"
+            )
         if mapper is None:
-            mapper = fit_bin_mapper_csr(X, max_bin=max_bin)
+            mapper = fit_bin_mapper_csr(X, max_bin=max_bin, sample_cnt=sample_cnt)
         return apply_bins_csr(X, mapper), mapper
     X = np.asarray(X, dtype=np.float64)
     if mapper is None:
         mapper = fit_bin_mapper(
-            X, max_bin=max_bin, categorical_features=categorical_features
+            X, max_bin=max_bin, sample_cnt=sample_cnt,
+            categorical_features=categorical_features,
+            max_bin_by_feature=max_bin_by_feature,
         )
     return apply_bins(X, mapper), mapper
 

@@ -11,7 +11,7 @@ from typing import Optional
 
 import numpy as np
 
-from mmlspark_tpu.core.params import Param, to_int, to_str
+from mmlspark_tpu.core.params import Param, to_bool, to_int, to_str
 from mmlspark_tpu.data.table import Table
 from mmlspark_tpu.lightgbm.base import (
     LightGBMBase,
@@ -28,8 +28,29 @@ class LightGBMClassifier(LightGBMBase):
     )
     rawPredictionCol = Param("Raw margin output column", default="rawPrediction", converter=to_str)
     probabilityCol = Param("Probability output column", default="probability", converter=to_str)
+    isUnbalance = Param(
+        "Binary class weighting for unbalanced data: positive rows get "
+        "weight n_neg/n_pos (native is_unbalance, LightGBMClassifier.scala:32)",
+        default=False, converter=to_bool,
+    )
 
     _inferred_classes: int = 2
+
+    def _adjust_weights(self, y: np.ndarray, w):
+        if not self.getIsUnbalance():
+            return w
+        y = np.asarray(y)
+        if len(np.unique(y)) > 2:
+            # native LightGBM restricts is_unbalance to binary classification
+            raise ValueError(
+                "isUnbalance requires binary labels "
+                f"(got {len(np.unique(y))} classes)"
+            )
+        n_pos = max(1, int((y > 0.5).sum()))
+        n_neg = max(1, int((y <= 0.5).sum()))
+        base = np.ones(len(y), dtype=np.float64) if w is None else np.asarray(w, np.float64)
+        # native is_unbalance: scale the positive class so classes balance
+        return np.where(y > 0.5, base * (n_neg / n_pos), base)
 
     def _num_classes(self, y: np.ndarray) -> int:
         # actualNumClasses inference (LightGBMClassifier.scala:38-52)

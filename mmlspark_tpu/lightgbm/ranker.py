@@ -127,6 +127,11 @@ class LightGBMRanker(HasGroupCol, LightGBMBase):
     sigma = Param("LambdaRank sigmoid steepness", default=1.0, converter=to_float, validator=gt(0))
     evalAt = Param("NDCG truncation for eval", default=5, converter=to_int, validator=gt(0))
     maxPosition = Param("Accepted for parity (NDCG optimization position)", default=20, converter=to_int)
+    labelGain = Param(
+        "Accepted for parity (graded relevance gains; this runtime uses "
+        "LightGBM's default 2^i - 1 gain table)",
+        default=[],
+    )
 
     def _objective_name(self) -> str:
         return "lambdarank"

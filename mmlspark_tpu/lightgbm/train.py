@@ -73,6 +73,10 @@ class TrainOptions:
     min_sum_hessian_in_leaf: float = 1e-3
     min_gain_to_split: float = 0.0
     bagging_fraction: float = 1.0
+    # class-stratified bagging (LightGBM pos/neg_bagging_fraction; 1.0 = off,
+    # both must be set together with bagging_freq to take effect)
+    pos_bagging_fraction: float = 1.0
+    neg_bagging_fraction: float = 1.0
     bagging_freq: int = 0
     feature_fraction: float = 1.0
     max_delta_step: float = 0.0
@@ -101,6 +105,12 @@ class TrainOptions:
     max_cat_threshold: int = 32  # max categories in a split's left set
     cat_smooth: float = 10.0  # smoothing for the g/h category sort
     cat_l2: float = 10.0  # extra L2 applied to categorical split gains
+    # boost_from_average=False: margins start at 0 instead of the
+    # objective's average-based init score (LightGBMParams boostFromAverage)
+    boost_from_average: bool = True
+    # compute the train-set metric each iteration into evals["training"]
+    # (isProvideTrainingMetric; forces the per-iteration loop path)
+    provide_training_metric: bool = False
     verbosity: int = -1
 
     @property
@@ -1003,17 +1013,42 @@ def _make_scan_steps(step, per_iter_bag: bool, per_iter_lr: bool = False,
     return jax.jit(run, donate_argnums=(3,))
 
 
-def _mask_schedule(opts: "TrainOptions", rng, n, pad, num_bag, num_feat, f, presence):
+def _bagging_active(opts: "TrainOptions") -> bool:
+    return opts.bagging_freq > 0 and (
+        opts.bagging_fraction < 1.0
+        or opts.pos_bagging_fraction < 1.0
+        or opts.neg_bagging_fraction < 1.0
+    )
+
+
+def _mask_schedule(opts: "TrainOptions", rng, n, pad, num_bag, num_feat, f,
+                   presence, y=None):
     """Per-iteration (bag_mask, bag_changed, feature_mask_or_None) — the ONE
     definition of the bagging/feature-sampling schedule and its rng stream,
-    shared by the scan and loop paths so they cannot diverge."""
+    shared by the scan and loop paths so they cannot diverge. Class-
+    stratified bagging (pos/neg_bagging_fraction) samples each binary class
+    at its own rate, matching native LightGBM's goal-oriented sampling."""
     bag = presence
+    stratified = (
+        opts.pos_bagging_fraction < 1.0 or opts.neg_bagging_fraction < 1.0
+    ) and y is not None
+    if stratified:
+        pos_idx = np.nonzero(np.asarray(y[:n]) > 0.5)[0]
+        neg_idx = np.nonzero(np.asarray(y[:n]) <= 0.5)[0]
+        n_pos = max(1, int(round(len(pos_idx) * opts.pos_bagging_fraction)))
+        n_neg = max(1, int(round(len(neg_idx) * opts.neg_bagging_fraction)))
     for it in range(opts.num_iterations):
         changed = False
-        if opts.bagging_fraction < 1.0 and opts.bagging_freq > 0:
+        if _bagging_active(opts):
             if it % opts.bagging_freq == 0:
                 bag = np.zeros(n + pad, dtype=np.float32)
-                bag[rng.choice(n, size=num_bag, replace=False)] = 1.0
+                if stratified:
+                    if len(pos_idx):
+                        bag[rng.choice(pos_idx, size=n_pos, replace=False)] = 1.0
+                    if len(neg_idx):
+                        bag[rng.choice(neg_idx, size=n_neg, replace=False)] = 1.0
+                else:
+                    bag[rng.choice(n, size=num_bag, replace=False)] = 1.0
                 changed = True
         if opts.feature_fraction < 1.0:
             fm = np.zeros(f, dtype=np.float32)
@@ -1115,6 +1150,14 @@ def train(
     elif opts.boosting_type == "dart":
         if opts.early_stopping_round > 0:
             raise ValueError("early stopping is not available in dart mode")
+    if (
+        opts.pos_bagging_fraction < 1.0 or opts.neg_bagging_fraction < 1.0
+    ) and opts.objective != "binary":
+        # native LightGBM likewise restricts pos/neg bagging to binary
+        raise ValueError(
+            "posBaggingFraction/negBaggingFraction require the binary "
+            f"objective (got {opts.objective!r})"
+        )
     objective = get_objective(opts.objective)
     num_classes = objective.num_outputs_fn(opts.num_class)
     n, f = bins.shape
@@ -1131,7 +1174,10 @@ def train(
     y_np = np.asarray(y, dtype=np.float32)
 
     if init_margins is None:
-        init_score = objective.init_score(y_np, num_classes, w)
+        if opts.boost_from_average:
+            init_score = objective.init_score(y_np, num_classes, w)
+        else:
+            init_score = np.zeros(num_classes, dtype=np.float32)
         margins0 = np.broadcast_to(init_score[None, :], (n, num_classes)).copy()
     else:
         # Warm start from provided margins: the booster is a delta model
@@ -1306,6 +1352,8 @@ def train(
     evals: Dict[str, Dict[str, List[float]]] = {
         vs["name"]: {metric: []} for vs in valid_state
     }
+    if opts.provide_training_metric:
+        evals["training"] = {metric: []}
 
     rng = np.random.default_rng(opts.seed)
     num_bag = max(1, int(round(n * opts.bagging_fraction)))
@@ -1356,8 +1404,10 @@ def train(
     # _mask_schedule as the loop path, so semantics (bagging schedule,
     # feature sampling, rng stream order) are identical.
     stacked_trees = None
-    schedule = _mask_schedule(opts, rng, n, pad, num_bag, num_feat, f, presence)
-    bag_resampling = opts.bagging_fraction < 1.0 and opts.bagging_freq > 0
+    schedule = _mask_schedule(
+        opts, rng, n, pad, num_bag, num_feat, f, presence, y=y_np
+    )
+    bag_resampling = _bagging_active(opts)
     # The scan path materializes an (iterations, N) uint8 bagging-mask array
     # on device when bagging resamples; gate it so a huge fit (e.g. 10M rows
     # x 1000 iters = 10 GB) falls back to the loop path, which re-uploads
@@ -1372,6 +1422,7 @@ def train(
         and bag_stack_ok
         and opts.num_iterations > 0
         and opts.boosting_type != "dart"  # dart drops trees per host decision
+        and not opts.provide_training_metric  # needs per-iteration margins
     ):
         bag_list, fm_list = [], []
         for bag_np, _, fm_np in schedule:
@@ -1484,6 +1535,14 @@ def train(
             jax.block_until_ready(margins)
             # drop row_leaf, a (C, N) buffer per tree, before retaining
             trees.append(tree._replace(row_leaf=None))
+
+            if opts.provide_training_metric:
+                # isProvideTrainingMetric: train-set metric per iteration
+                # (a device fetch per round — opt-in, loop path only)
+                evals["training"][metric].append(_evaluate(
+                    metric, opts.objective, y_np[:n], np.asarray(margins)[:n],
+                    w[:n], opts.alpha,
+                ))
 
             improved_any = False
             for vs in valid_state:

@@ -7,5 +7,15 @@ weights are produced by training or loaded from checkpoints via
 """
 
 from mmlspark_tpu.models.resnet import init_resnet, resnet_apply
+from mmlspark_tpu.models.zoo import (
+    load_zoo_params,
+    params_from_bytes,
+    params_to_bytes,
+    publish_model,
+    train_resnet_classifier,
+)
 
-__all__ = ["init_resnet", "resnet_apply"]
+__all__ = [
+    "init_resnet", "resnet_apply", "publish_model", "load_zoo_params",
+    "params_to_bytes", "params_from_bytes", "train_resnet_classifier",
+]
