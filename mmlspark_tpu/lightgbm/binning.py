@@ -73,10 +73,20 @@ def fit_bin_mapper(
     n, f = X.shape
     cat_set = set(int(c) for c in (categorical_features or []))
     caps = list(max_bin_by_feature or [])
-    if caps and len(caps) != f:
-        raise ValueError(
-            f"maxBinByFeature has {len(caps)} entries for {f} features"
-        )
+    if caps:
+        if len(caps) != f:
+            raise ValueError(
+                f"maxBinByFeature has {len(caps)} entries for {f} features"
+            )
+        bad = [c for c in caps if not (2 <= int(c) <= max_bin)]
+        if bad:
+            # explicit diagnostic instead of a silent clamp: this runtime's
+            # uint8 bin layout caps per-feature bins at the global max_bin
+            # (unlike native LightGBM, whose per-feature bins may exceed it)
+            raise ValueError(
+                f"maxBinByFeature entries must be in [2, maxBin={max_bin}] "
+                f"(got {bad[:5]})"
+            )
     if n > sample_cnt:
         rng = np.random.default_rng(seed)
         idx = rng.choice(n, size=sample_cnt, replace=False)
@@ -88,8 +98,7 @@ def fit_bin_mapper(
     num_bins = np.zeros(f, dtype=np.int32)
     cat_values: dict = {}
     for j in range(f):
-        mb = min(max_bin, int(caps[j])) if caps else max_bin
-        mb = max(mb, 2)
+        mb = int(caps[j]) if caps else max_bin
         col = sample[:, j]
         col = col[~np.isnan(col)]
         if j in cat_set:

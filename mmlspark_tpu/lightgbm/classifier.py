@@ -40,11 +40,13 @@ class LightGBMClassifier(LightGBMBase):
         if not self.getIsUnbalance():
             return w
         y = np.asarray(y)
-        if len(np.unique(y)) > 2:
-            # native LightGBM restricts is_unbalance to binary classification
+        labels = set(np.unique(y).tolist())
+        if not labels <= {0.0, 1.0}:
+            # native LightGBM restricts is_unbalance to the binary objective;
+            # non-contiguous labels (e.g. {0, 2}) infer a multiclass fit
             raise ValueError(
-                "isUnbalance requires binary labels "
-                f"(got {len(np.unique(y))} classes)"
+                "isUnbalance requires binary 0/1 labels "
+                f"(got values {sorted(labels)[:5]})"
             )
         n_pos = max(1, int((y > 0.5).sum()))
         n_neg = max(1, int((y <= 0.5).sum()))

@@ -26,10 +26,17 @@ def _flatten(tree: Any, prefix: str = "") -> Dict[str, np.ndarray]:
     out: Dict[str, np.ndarray] = {}
     if isinstance(tree, dict):
         for k, v in tree.items():
+            # '#' marks LIST components below, '/' is the path separator —
+            # dict keys using either would make the round trip ambiguous
+            if "/" in str(k) or str(k).startswith("#"):
+                raise ValueError(
+                    f"zoo payload keys may not contain '/' or start with "
+                    f"'#': {k!r}"
+                )
             out.update(_flatten(v, f"{prefix}{k}/"))
     elif isinstance(tree, (list, tuple)):
         for i, v in enumerate(tree):
-            out.update(_flatten(v, f"{prefix}{i}/"))
+            out.update(_flatten(v, f"{prefix}#{i}/"))
     else:
         out[prefix[:-1]] = np.asarray(tree)
     return out
@@ -47,8 +54,10 @@ def _unflatten(flat: Dict[str, np.ndarray]) -> Any:
     def listify(node):
         if not isinstance(node, dict):
             return node
-        if node and all(k.isdigit() for k in node):
-            return [listify(node[str(i)]) for i in range(len(node))]
+        # only explicitly-marked '#i' components become lists, so dicts
+        # whose keys happen to be digit strings round-trip unchanged
+        if node and all(k.startswith("#") for k in node):
+            return [listify(node[f"#{i}"]) for i in range(len(node))]
         return {k: listify(v) for k, v in node.items()}
 
     return listify(root)

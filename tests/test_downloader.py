@@ -136,3 +136,18 @@ class TestZooArtifacts:
         dl = ModelDownloader(str(tmp_path / "cache2"), LocalRepo(str(tmp_path / "repo")))
         with pytest.raises(IOError, match="hash mismatch"):
             dl.download_by_name("tiny2")
+
+    def test_digit_keyed_dicts_round_trip(self):
+        from mmlspark_tpu.models import params_from_bytes, params_to_bytes
+
+        tree = {"blocks": {"0": np.ones(2), "2": np.zeros(3)},
+                "layers": [np.arange(2.0), {"w": np.eye(2)}]}
+        out = params_from_bytes(params_to_bytes(tree))
+        assert isinstance(out["blocks"], dict)  # digit keys stay a dict
+        np.testing.assert_array_equal(out["blocks"]["2"], np.zeros(3))
+        assert isinstance(out["layers"], list)
+        np.testing.assert_array_equal(out["layers"][1]["w"], np.eye(2))
+        import pytest as _pytest
+
+        with _pytest.raises(ValueError, match="may not contain"):
+            params_to_bytes({"a/b": np.ones(1)})

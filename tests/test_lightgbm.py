@@ -274,3 +274,17 @@ class TestParamSurfaceAudit:
             LightGBMRegressor(
                 numIterations=2, negBaggingFraction=0.3, baggingFreq=1
             ).fit(tr)
+
+    def test_max_bin_by_feature_rejects_out_of_range(self):
+        t, X, y = self._unbalanced(n=300)
+        with pytest.raises(ValueError, match="maxBinByFeature"):
+            LightGBMClassifier(numIterations=1, maxBinByFeature=[300] * 6).fit(t)
+
+    def test_is_unbalance_rejects_noncontiguous_labels(self):
+        rng = np.random.default_rng(2)
+        X = rng.normal(size=(200, 3))
+        y = np.where(X[:, 0] > 0, 2.0, 0.0)  # labels {0, 2}
+        with pytest.raises(ValueError, match="isUnbalance"):
+            LightGBMClassifier(numIterations=2, isUnbalance=True).fit(
+                Table({"features": X, "label": y})
+            )
