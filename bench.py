@@ -102,16 +102,16 @@ def _predict_throughput_tpu(booster, X, reps=10):
     )
 
     t = booster._used_trees(None)
-    feats, thrs, P, plen, lvals, _, nanl, _ = _paths_cache(booster, t)
+    pc = _paths_cache(booster, t)
     Xd = jnp.asarray(X, jnp.float32)
-    cargs = [jnp.asarray(a) for a in (feats, thrs, nanl, P, plen, lvals)]
+    cargs = [jnp.asarray(a) for a in (pc.feats, pc.thrs, pc.nanl, pc.zm, pc.P, pc.plen, pc.lvals)]
     isc = jnp.asarray(booster.init_score)
 
     @jax.jit
-    def loop(Xd, f, th, nl, Pm, pl, lv, isc):
+    def loop(Xd, f, th, nl, zm_, Pm, pl, lv, isc):
         def body(i, acc):
             m = _predict_margin_paths_jit(
-                Xd * (1 + i.astype(jnp.float32) * 1e-9), f, th, nl, Pm, pl, lv, isc, 1
+                Xd * (1 + i.astype(jnp.float32) * 1e-9), f, th, nl, zm_, Pm, pl, lv, isc, 1
             )
             return acc + m[0, 0]
 

@@ -35,7 +35,7 @@ from __future__ import annotations
 import dataclasses
 import json
 from functools import partial
-from typing import Any, Dict, Optional
+from typing import Any, Dict, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -83,6 +83,9 @@ class Booster:
     cat_nodes: Optional[np.ndarray] = None
     cat_masks: Optional[np.ndarray] = None
     cat_values: Optional[Dict[int, np.ndarray]] = None
+    # (T, M) bool: zero_as_missing nodes (imported LightGBM missing_type=
+    # Zero): a 0.0 or NaN feature value routes per nan_left there.
+    zero_missing: Optional[np.ndarray] = None
 
     @property
     def has_categorical(self) -> bool:
@@ -142,30 +145,31 @@ class Booster:
             return np.broadcast_to(
                 self.init_score[None, :], (X.shape[0], self.num_classes)
             ).copy()
-        feats, thrs, P, plen, lvals, _, nanl, _ = _paths_cache(self, t)
+        pc = _paths_cache(self, t)
         has_cat = self.has_categorical
         X32 = np.asarray(
             self._cat_binned(X) if has_cat else X, dtype=np.float32
         )
         if has_cat:
             iscat, catm = _cat_paths_cache(self, t)
-        chunk = _predict_chunk_rows(*feats.shape)
+        chunk = _predict_chunk_rows(*pc.feats.shape)
         outs = []
         for lo in range(0, max(len(X32), 1), chunk):
             xd = jnp.asarray(X32[lo : lo + chunk])
             cargs = (
-                jnp.asarray(feats), jnp.asarray(thrs), jnp.asarray(nanl),
-                jnp.asarray(P), jnp.asarray(plen),
+                jnp.asarray(pc.feats), jnp.asarray(pc.thrs),
+                jnp.asarray(pc.nanl), jnp.asarray(pc.zm),
+                jnp.asarray(pc.P), jnp.asarray(pc.plen),
             )
             if has_cat:
                 m = _predict_margin_paths_cat_jit(
                     xd, *cargs, jnp.asarray(iscat), jnp.asarray(catm),
-                    jnp.asarray(lvals), jnp.asarray(self.init_score),
+                    jnp.asarray(pc.lvals), jnp.asarray(self.init_score),
                     self.num_classes,
                 )
             else:
                 m = _predict_margin_paths_jit(
-                    xd, *cargs, jnp.asarray(lvals),
+                    xd, *cargs, jnp.asarray(pc.lvals),
                     jnp.asarray(self.init_score), self.num_classes,
                 )
             outs.append(np.asarray(m))
@@ -183,28 +187,29 @@ class Booster:
         t = self._used_trees(num_iteration)
         if t == 0:
             return np.zeros((np.shape(X)[0], 0), np.int32)
-        feats, thrs, P, plen, _, lslots, nanl, _ = _paths_cache(self, t)
+        pc = _paths_cache(self, t)
         has_cat = self.has_categorical
         X32 = np.asarray(
             self._cat_binned(X) if has_cat else X, dtype=np.float32
         )
         if has_cat:
             iscat, catm = _cat_paths_cache(self, t)
-        chunk = _predict_chunk_rows(*feats.shape)
+        chunk = _predict_chunk_rows(*pc.feats.shape)
         outs = []
         for lo in range(0, max(len(X32), 1), chunk):
             xd = jnp.asarray(X32[lo : lo + chunk])
             cargs = (
-                jnp.asarray(feats), jnp.asarray(thrs), jnp.asarray(nanl),
-                jnp.asarray(P), jnp.asarray(plen),
+                jnp.asarray(pc.feats), jnp.asarray(pc.thrs),
+                jnp.asarray(pc.nanl), jnp.asarray(pc.zm),
+                jnp.asarray(pc.P), jnp.asarray(pc.plen),
             )
             if has_cat:
                 leaves = _predict_leaf_paths_cat_jit(
                     xd, *cargs, jnp.asarray(iscat), jnp.asarray(catm),
-                    jnp.asarray(lslots),
+                    jnp.asarray(pc.lslots),
                 )
             else:
-                leaves = _predict_leaf_paths_jit(xd, *cargs, jnp.asarray(lslots))
+                leaves = _predict_leaf_paths_jit(xd, *cargs, jnp.asarray(pc.lslots))
             outs.append(np.asarray(leaves))
         return np.concatenate(outs, axis=0) if outs else np.zeros((0, t), np.int32)
 
@@ -246,7 +251,7 @@ class Booster:
         for k in ("cover", "split_gain"):
             if d.get(k) is not None:
                 d[k] = np.asarray(d[k], dtype=np.float32)
-        for k in ("nan_left", "cat_nodes", "cat_masks"):
+        for k in ("nan_left", "cat_nodes", "cat_masks", "zero_missing"):
             if d.get(k) is not None:
                 d[k] = np.asarray(d[k], dtype=bool)
         if d.get("bin_edges") is not None:
@@ -365,11 +370,24 @@ def _thr_f32(thr) -> np.ndarray:
     return t32
 
 
-def _leaf_paths(b: "Booster", t: int):
-    """Host precompute for trees[:t]: per-tree padded constants
-    (FEATS (T,I), THRS (T,I), P (T,I,L), PLEN (T,L), LVALS (T,L),
-    LSLOTS (T,L), NANL (T,I))."""
+class PathConsts(NamedTuple):
+    """Per-tree padded predict constants (one derivation for everything
+    the path-matrix kernels consume — _cat_paths aligns on `internals`)."""
+
+    feats: np.ndarray  # (T, I) int32 split features
+    thrs: np.ndarray  # (T, I) f32 thresholds (f64 snapped DOWN, _thr_f32)
+    P: np.ndarray  # (T, I, L) ±1/0 path signs
+    plen: np.ndarray  # (T, L) path lengths
+    lvals: np.ndarray  # (T, L) leaf values
+    lslots: np.ndarray  # (T, L) leaf slot ids
+    nanl: np.ndarray  # (T, I) bool NaN-goes-left
+    zm: np.ndarray  # (T, I) bool zero_as_missing
+    internals: list  # per-tree internal-slot ordering
+
+
+def _leaf_paths(b: "Booster", t: int) -> "PathConsts":
     feats_l, thrs_l, P_l, plen_l, lvals_l, lslots_l, nanl_l = [], [], [], [], [], [], []
+    zm_l = []
     max_i = max_l = 1
     per_tree = []
     for ti in range(t):
@@ -396,10 +414,13 @@ def _leaf_paths(b: "Booster", t: int):
         fe = np.zeros(max_i, np.int32)
         th = np.full(max_i, np.inf, np.float32)  # padding: always-left, off-path
         nl = np.ones(max_i, bool)  # padding: NaN goes left (off-path anyway)
+        zm = np.zeros(max_i, bool)  # padding: plain numeric comparison
         fe[: len(internal)] = b.split_feature[ti][internal]
         th[: len(internal)] = _thr_f32(b.split_threshold[ti][internal])
         if b.nan_left is not None:
             nl[: len(internal)] = b.nan_left[ti][internal]
+        if b.zero_missing is not None:
+            zm[: len(internal)] = b.zero_missing[ti][internal]
         P = np.zeros((max_i, max_l), np.float32)
         plen = np.full(max_l, np.float32(max_i + 1))  # unmatched sentinel
         lv = np.zeros(max_l, np.float32)
@@ -413,32 +434,34 @@ def _leaf_paths(b: "Booster", t: int):
         feats_l.append(fe)
         thrs_l.append(th)
         nanl_l.append(nl)
+        zm_l.append(zm)
         P_l.append(P)
         plen_l.append(plen)
         lvals_l.append(lv)
         lslots_l.append(ls)
-    return (
-        np.stack(feats_l),
-        np.stack(thrs_l),
-        np.stack(P_l),
-        np.stack(plen_l),
-        np.stack(lvals_l),
-        np.stack(lslots_l),
-        np.stack(nanl_l),
-        # per-tree internal-slot ordering — the ONE derivation that every
-        # row of the padded constants above follows; _cat_paths reuses it
-        [internal for _, internal in per_tree],
+    return PathConsts(
+        feats=np.stack(feats_l),
+        thrs=np.stack(thrs_l),
+        P=np.stack(P_l),
+        plen=np.stack(plen_l),
+        lvals=np.stack(lvals_l),
+        lslots=np.stack(lslots_l),
+        nanl=np.stack(nanl_l),
+        zm=np.stack(zm_l),
+        internals=[internal for _, internal in per_tree],
     )
 
 
-def _path_match(X, feats, thrs, nanl, P, plen):
+def _path_match(X, feats, thrs, nanl, zm, P, plen):
     """(N, T, L) one-hot leaf membership per tree."""
     x = jnp.take(X, feats.reshape(-1), axis=1)
     n = X.shape[0]
     t, i = feats.shape
     x = x.reshape(n, t, i)
-    # missing routes per the node's nan_left flag; pads are always-left
-    d = (jnp.isnan(x) & nanl[None]) | (x <= thrs[None])
+    # missing (NaN — and 0.0 at zero_as_missing nodes) routes per the
+    # node's nan_left flag; pads are always-left
+    miss = jnp.isnan(x) | (zm[None] & (x == 0.0))
+    d = jnp.where(miss, nanl[None], x <= thrs[None])
     D = 2.0 * d.astype(jnp.float32) - 1.0  # (N, T, I)
     score = jnp.einsum(
         "nti,til->ntl", D, P, preferred_element_type=jnp.float32,
@@ -449,8 +472,8 @@ def _path_match(X, feats, thrs, nanl, P, plen):
 
 
 @partial(jax.jit, static_argnames=("num_classes",))
-def _predict_margin_paths_jit(X, feats, thrs, nanl, P, plen, lvals, init_score, num_classes):
-    match = _path_match(X, feats, thrs, nanl, P, plen)
+def _predict_margin_paths_jit(X, feats, thrs, nanl, zm, P, plen, lvals, init_score, num_classes):
+    match = _path_match(X, feats, thrs, nanl, zm, P, plen)
     # match is one-hot over leaves: the contribution IS a matmul, no gather
     contrib = jnp.einsum(
         "ntl,tl->nt", match.astype(jnp.float32), lvals,
@@ -463,8 +486,8 @@ def _predict_margin_paths_jit(X, feats, thrs, nanl, P, plen, lvals, init_score, 
 
 
 @jax.jit
-def _predict_leaf_paths_jit(X, feats, thrs, nanl, P, plen, lslots):
-    match = _path_match(X, feats, thrs, nanl, P, plen)
+def _predict_leaf_paths_jit(X, feats, thrs, nanl, zm, P, plen, lslots):
+    match = _path_match(X, feats, thrs, nanl, zm, P, plen)
     # one-hot contraction again: slot id = sum_l match * slot_l
     return jnp.einsum(
         "ntl,tl->nt", match.astype(jnp.float32), lslots.astype(jnp.float32),
@@ -472,7 +495,7 @@ def _predict_leaf_paths_jit(X, feats, thrs, nanl, P, plen, lslots):
     ).astype(jnp.int32)
 
 
-def _path_match_cat(X, feats, thrs, nanl, P, plen, iscat, catm):
+def _path_match_cat(X, feats, thrs, nanl, zm, P, plen, iscat, catm):
     """(N, T, L) leaf membership with categorical decisions: categorical
     columns of ``X`` hold value-bin ids (``Booster._cat_binned``); at cat
     nodes d = mask[bin] (bin 0 = unseen/NaN => right)."""
@@ -480,7 +503,8 @@ def _path_match_cat(X, feats, thrs, nanl, P, plen, iscat, catm):
     n = X.shape[0]
     t, i = feats.shape
     x = x.reshape(n, t, i)
-    d_num = (jnp.isnan(x) & nanl[None]) | (x <= thrs[None])
+    miss = jnp.isnan(x) | (zm[None] & (x == 0.0))
+    d_num = jnp.where(miss, nanl[None], x <= thrs[None])
     xb = jnp.clip(x, 0, catm.shape[-1] - 1).astype(jnp.int32)
     d_cat = catm[
         jnp.arange(t)[None, :, None], jnp.arange(i)[None, None, :], xb
@@ -496,9 +520,9 @@ def _path_match_cat(X, feats, thrs, nanl, P, plen, iscat, catm):
 
 @partial(jax.jit, static_argnames=("num_classes",))
 def _predict_margin_paths_cat_jit(
-    X, feats, thrs, nanl, P, plen, iscat, catm, lvals, init_score, num_classes
+    X, feats, thrs, nanl, zm, P, plen, iscat, catm, lvals, init_score, num_classes
 ):
-    match = _path_match_cat(X, feats, thrs, nanl, P, plen, iscat, catm)
+    match = _path_match_cat(X, feats, thrs, nanl, zm, P, plen, iscat, catm)
     contrib = jnp.einsum(
         "ntl,tl->nt", match.astype(jnp.float32), lvals,
         preferred_element_type=jnp.float32, precision=lax.Precision.HIGHEST,
@@ -510,8 +534,8 @@ def _predict_margin_paths_cat_jit(
 
 
 @jax.jit
-def _predict_leaf_paths_cat_jit(X, feats, thrs, nanl, P, plen, iscat, catm, lslots):
-    match = _path_match_cat(X, feats, thrs, nanl, P, plen, iscat, catm)
+def _predict_leaf_paths_cat_jit(X, feats, thrs, nanl, zm, P, plen, iscat, catm, lslots):
+    match = _path_match_cat(X, feats, thrs, nanl, zm, P, plen, iscat, catm)
     return jnp.einsum(
         "ntl,tl->nt", match.astype(jnp.float32), lslots.astype(jnp.float32),
         precision=lax.Precision.HIGHEST,
@@ -532,8 +556,8 @@ def _cat_paths(b: "Booster", t: int):
     _leaf_paths' padded constants (it shares the internal-slot ordering
     _leaf_paths returns — no second derivation to drift)."""
     consts = _paths_cache(b, t)
-    max_i = consts[0].shape[1]
-    internals = consts[7]
+    max_i = consts.feats.shape[1]
+    internals = consts.internals
     bc = b.cat_masks.shape[-1]
     iscat = np.zeros((t, max_i), bool)
     catm = np.zeros((t, max_i, bc), bool)

@@ -28,11 +28,12 @@ Encoding notes (mirroring LightGBM's ``src/io/tree.cpp`` / ``gbdt_model_text.cpp
   ``init_score = 0`` (the margins come out identical).
 - Floats print with ``%.17g`` (round-trip exact for float64).
 
-Out of scope (explicit errors): linear trees (``is_linear=1``) and
-``missing_type=Zero``
-(``zero_as_missing=true`` models). ``missing_type=None`` imports with the
-LightGBM predictor's convention that a NaN at such a node behaves like 0.0,
-which resolves to a static per-node direction ``nan_left = (0.0 <= threshold)``.
+Out of scope (explicit error): linear trees (``is_linear=1``).
+``missing_type=None`` imports with the LightGBM predictor's convention that
+a NaN at such a node behaves like 0.0, which resolves to a static per-node
+direction ``nan_left = (0.0 <= threshold)``; ``missing_type=Zero``
+(``zero_as_missing=true``) imports as per-node ``zero_missing`` flags — a
+0.0 or NaN value routes per ``default_left`` there.
 """
 
 from __future__ import annotations
@@ -85,6 +86,7 @@ def to_lightgbm_text(booster, shrinkage: float = 1.0) -> str:
     cat_nodes_all = booster.cat_nodes
     cat_masks_all = booster.cat_masks
     cat_values_all = booster.cat_values or {}
+    zero_missing_all = booster.zero_missing
 
     tree_strs: List[str] = []
     for ti in range(t):
@@ -164,8 +166,12 @@ def to_lightgbm_text(booster, shrinkage: float = 1.0) -> str:
             sf[ii] = int(feat[slot])
             sg[ii] = max(gain[slot], 0.0)
             th[ii] = thr[slot]
-            # bit1 default_left per the node's NaN routing; bits2-3 = NaN(2)
-            dt[ii] = (2 if nl[slot] else 0) | (2 << 2)
+            # bit1 default_left per the node's NaN routing; bits2-3 =
+            # Zero(1) for zero_missing nodes, NaN(2) otherwise
+            zm_bit = (
+                zero_missing_all is not None and bool(zero_missing_all[ti][slot])
+            )
+            dt[ii] = (2 if nl[slot] else 0) | ((1 if zm_bit else 2) << 2)
             lc[ii] = child_ref(int(left[slot]))
             rc[ii] = child_ref(int(right[slot]))
             iw[ii] = cover[slot]
@@ -349,8 +355,8 @@ def from_lightgbm_text(s: str):
         if num_leaves == 1:
             trees.append(
                 dict(feat=[0], thr=[np.inf], left=[0], right=[0],
-                     is_leaf=[True], lval=[lv[0]], nanl=[True],
-                     cover=[0.0], gain=[0.0])
+                     is_leaf=[True], lval=[lv[0]], nanl=[True], zm=[False],
+                     cover=[0.0], gain=[0.0], cat={})
             )
             continue
         sf = np.fromstring(_block_value(blk, "split_feature"), sep=" ").astype(np.int64)
@@ -374,14 +380,12 @@ def from_lightgbm_text(s: str):
 
         is_cat_i = (dt & 1) != 0
         missing = (dt >> 2) & 3
-        if np.any((missing == 1) & ~is_cat_i):
-            raise ValueError(
-                f"tree {bi}: zero_as_missing models are not supported"
-            )
         default_left = (dt & 2) != 0
-        # missing_type None: LightGBM's predictor treats NaN like 0.0 there.
+        # missing_type None: LightGBM's predictor treats NaN like 0.0 there;
+        # missing_type Zero: 0.0 AND NaN route per default_left (zero_missing)
         nan_left_i = np.where(missing == 0, 0.0 <= th, default_left)
         nan_left_i = np.where(is_cat_i, False, nan_left_i)  # cat NaN -> right
+        zero_missing_i = (missing == 1) & ~is_cat_i
 
         # Categorical nodes: threshold = index into cat_boundaries /
         # cat_threshold; decode each node's bitset into raw value arrays.
@@ -428,6 +432,7 @@ def from_lightgbm_text(s: str):
         isl = np.zeros(m, bool)
         lval_s = np.zeros(m)
         nanl_s = np.ones(m, bool)
+        zm_s = np.zeros(m, bool)
         cover_s = np.zeros(m)
         gain_s = np.zeros(m)
         isl[ni:] = True
@@ -442,14 +447,15 @@ def from_lightgbm_text(s: str):
             left_s[ii] = slot_of(lc[ii])
             right_s[ii] = slot_of(rc[ii])
             nanl_s[ii] = bool(nan_left_i[ii])
+            zm_s[ii] = bool(zero_missing_i[ii])
             if len(gain) == ni:
                 gain_s[ii] = gain[ii]
             if len(icnt) == ni:
                 cover_s[ii] = icnt[ii]
         trees.append(
             dict(feat=feat, thr=thr_s, left=left_s, right=right_s,
-                 is_leaf=isl, lval=lval_s, nanl=nanl_s, cover=cover_s,
-                 gain=gain_s, cat=cat_sets)
+                 is_leaf=isl, lval=lval_s, nanl=nanl_s, zm=zm_s,
+                 cover=cover_s, gain=gain_s, cat=cat_sets)
         )
 
     t = len(trees)
@@ -509,6 +515,10 @@ def from_lightgbm_text(s: str):
         feature_names=feature_names
         or [f"Column_{j}" for j in range(max_feature_idx + 1)],
         nan_left=pad("nanl", True, bool),
+        zero_missing=(
+            pad("zm", False, bool)
+            if any(np.any(tr["zm"]) for tr in trees) else None
+        ),
         cat_nodes=cat_nodes,
         cat_masks=cat_masks,
         cat_values=cat_values,

@@ -46,6 +46,9 @@ def tree_shap(booster, X: np.ndarray, num_iteration: Optional[int] = None) -> np
             nan_left=None if booster.nan_left is None else booster.nan_left[t],
             cat_node=None if not has_cat else booster.cat_nodes[t],
             cat_mask=None if not has_cat else booster.cat_masks[t],
+            zero_missing=(
+                None if booster.zero_missing is None else booster.zero_missing[t]
+            ),
         )
         cls = t % c
         phi[:, cls, :f] += contrib
@@ -54,7 +57,8 @@ def tree_shap(booster, X: np.ndarray, num_iteration: Optional[int] = None) -> np
 
 
 def _shap_one_tree(feat, thr, left, right, is_leaf, leaf_val, cover, X,
-                   nan_left=None, cat_node=None, cat_mask=None):
+                   nan_left=None, cat_node=None, cat_mask=None,
+                   zero_missing=None):
     n, num_features = X.shape
     phi = np.zeros((n, num_features), dtype=np.float64)
 
@@ -67,7 +71,10 @@ def _shap_one_tree(feat, thr, left, right, is_leaf, leaf_val, cover, X,
 
     xv = X[:, feat].astype(np.float32)  # (N, M)
     nl = np.ones(len(feat), bool) if nan_left is None else np.asarray(nan_left, bool)
-    goes_left = (np.isnan(xv) & nl[None, :]) | (xv <= _thr_f32(thr)[None, :])
+    miss = np.isnan(xv)
+    if zero_missing is not None and np.any(zero_missing):
+        miss = miss | (np.asarray(zero_missing, bool)[None, :] & (xv == 0.0))
+    goes_left = np.where(miss, nl[None, :], xv <= _thr_f32(thr)[None, :])
     if cat_node is not None and np.any(cat_node):
         # categorical columns of X hold value-bin ids (tree_shap pre-bins);
         # left iff the node's set contains the bin — same rule as predict

@@ -10,8 +10,9 @@ verification — plus a small supervised trainer so artifacts carry REAL
 learned weights even on zero-egress rigs (train on local data, publish,
 transfer).
 
-Payload format: numpy ``.npz`` with ``/``-joined pytree paths as keys
-(lists encoded by integer components), lossless f32 round trip.
+Payload format: numpy ``.npz`` with ``/``-joined pytree paths as keys;
+LIST components are marked ``#i`` (so digit-keyed dicts round-trip
+unchanged); lossless f32 round trip.
 """
 
 from __future__ import annotations

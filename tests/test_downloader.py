@@ -147,7 +147,5 @@ class TestZooArtifacts:
         np.testing.assert_array_equal(out["blocks"]["2"], np.zeros(3))
         assert isinstance(out["layers"], list)
         np.testing.assert_array_equal(out["layers"][1]["w"], np.eye(2))
-        import pytest as _pytest
-
-        with _pytest.raises(ValueError, match="may not contain"):
+        with pytest.raises(ValueError, match="may not contain"):
             params_to_bytes({"a/b": np.ones(1)})

@@ -202,9 +202,10 @@ shrinkage=1
 
     @pytest.mark.parametrize("mutation,err", [
         # a categorical decision_type bit without the cat bitset arrays is
-        # structurally invalid (well-formed cat models import since round 4)
+        # structurally invalid (well-formed cat models import since round 4;
+        # zero_as_missing imports too — see
+        # test_zero_as_missing_import_and_round_trip)
         (("decision_type=10 8", "decision_type=10 9"), "cat_boundaries"),
-        (("decision_type=10 8", "decision_type=10 6"), "zero_as_missing"),
         (("is_linear=0", "is_linear=1"), "linear"),
     ])
     def test_unsupported_features_raise(self, mutation, err):
@@ -332,3 +333,38 @@ def test_imported_f64_thresholds_route_like_lightgbm():
     # TreeSHAP must use the same snapped comparison grid as predict, or
     # additivity breaks on exactly these straddling thresholds
     np.testing.assert_allclose(b.features_shap(X).sum(axis=-1)[:, 0], out)
+
+
+def test_zero_as_missing_import_and_round_trip():
+    """missing_type=Zero (zero_as_missing=true) imports: a 0.0 OR NaN value
+    routes per default_left at such nodes; the re-export preserves the
+    decision_type bits."""
+    # decision_type = bit1 (default_left) | 1 << 2 (missing Zero) = 6
+    text = "\n".join([
+        "tree", "version=v3", "num_class=1", "num_tree_per_iteration=1",
+        "label_index=0", "max_feature_idx=0", "objective=regression",
+        "feature_names=f0", "feature_infos=[-5:5]", "tree_sizes=0", "",
+        "Tree=0", "num_leaves=2", "num_cat=0", "split_feature=0",
+        "split_gain=1", "threshold=-1.5", "decision_type=6",
+        "left_child=-1", "right_child=-2", "leaf_value=1 -1",
+        "leaf_weight=1 1", "leaf_count=1 1", "internal_value=0",
+        "internal_weight=2", "internal_count=2", "is_linear=0",
+        "shrinkage=1", "", "", "end of trees", "",
+        "pandas_categorical:null", "",
+    ])
+    b = from_lightgbm_text(text)
+    assert b.zero_missing is not None and b.zero_missing.any()
+    X = np.array([[0.0], [np.nan], [-3.0], [2.0]])
+    out = b.raw_margin(X)[:, 0]
+    # 0.0 and NaN are missing -> default_left (set) -> left leaf (1);
+    # -3 <= -1.5 -> left; 2 > -1.5 -> right. NOTE without zero_missing,
+    # 0.0 would compare 0 <= -1.5 -> RIGHT, so row 0 pins the semantics.
+    np.testing.assert_allclose(out, [1.0, 1.0, 1.0, -1.0])
+    # SHAP additivity under zero_missing routing
+    np.testing.assert_allclose(b.features_shap(X).sum(-1)[:, 0], out,
+                               rtol=1e-6, atol=1e-6)
+    # round trip preserves the Zero missing-type bits
+    text2 = to_lightgbm_text(b)
+    assert "decision_type=6" in text2
+    b2 = from_lightgbm_text(text2)
+    np.testing.assert_allclose(b2.raw_margin(X)[:, 0], out)
