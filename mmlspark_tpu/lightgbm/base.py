@@ -484,6 +484,8 @@ def _merge_boosters(boosters: List[Booster]) -> Booster:
         best_iteration=-1,
         feature_names=first.feature_names,
         bin_edges=first.bin_edges,
+        nan_left=cat("nan_left"),
+        zero_missing=cat("zero_missing"),
         cat_nodes=cat("cat_nodes"),
         cat_masks=cat("cat_masks"),
         cat_values=first.cat_values,

@@ -460,7 +460,8 @@ def _path_match(X, feats, thrs, nanl, zm, P, plen):
     x = x.reshape(n, t, i)
     # missing (NaN — and 0.0 at zero_as_missing nodes) routes per the
     # node's nan_left flag; pads are always-left
-    miss = jnp.isnan(x) | (zm[None] & (x == 0.0))
+    # LightGBM's kZeroThreshold: |x| <= 1e-35 counts as zero/missing
+    miss = jnp.isnan(x) | (zm[None] & (jnp.abs(x) <= 1e-35))
     d = jnp.where(miss, nanl[None], x <= thrs[None])
     D = 2.0 * d.astype(jnp.float32) - 1.0  # (N, T, I)
     score = jnp.einsum(
@@ -503,7 +504,8 @@ def _path_match_cat(X, feats, thrs, nanl, zm, P, plen, iscat, catm):
     n = X.shape[0]
     t, i = feats.shape
     x = x.reshape(n, t, i)
-    miss = jnp.isnan(x) | (zm[None] & (x == 0.0))
+    # LightGBM's kZeroThreshold: |x| <= 1e-35 counts as zero/missing
+    miss = jnp.isnan(x) | (zm[None] & (jnp.abs(x) <= 1e-35))
     d_num = jnp.where(miss, nanl[None], x <= thrs[None])
     xb = jnp.clip(x, 0, catm.shape[-1] - 1).astype(jnp.int32)
     d_cat = catm[

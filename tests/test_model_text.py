@@ -368,3 +368,29 @@ def test_zero_as_missing_import_and_round_trip():
     assert "decision_type=6" in text2
     b2 = from_lightgbm_text(text2)
     np.testing.assert_allclose(b2.raw_margin(X)[:, 0], out)
+
+
+def test_zero_as_missing_k_zero_threshold():
+    """Values within LightGBM's kZeroThreshold (|x| <= 1e-35) count as zero
+    at zero_as_missing nodes — exact-zero-only comparison would misroute
+    denormal-small values vs the native runtime."""
+    text = "\n".join([
+        "tree", "version=v3", "num_class=1", "num_tree_per_iteration=1",
+        "label_index=0", "max_feature_idx=0", "objective=regression",
+        "feature_names=f0", "feature_infos=[-5:5]", "tree_sizes=0", "",
+        "Tree=0", "num_leaves=2", "num_cat=0", "split_feature=0",
+        "split_gain=1", "threshold=-1.5", "decision_type=6",
+        "left_child=-1", "right_child=-2", "leaf_value=1 -1",
+        "leaf_weight=1 1", "leaf_count=1 1", "internal_value=0",
+        "internal_weight=2", "internal_count=2", "is_linear=0",
+        "shrinkage=1", "", "", "end of trees", "",
+        "pandas_categorical:null", "",
+    ])
+    b = from_lightgbm_text(text)
+    X = np.array([[1e-36], [-1e-36], [1e-30]])
+    out = b.raw_margin(X)[:, 0]
+    # +-1e-36 are "zero" -> missing -> default_left -> 1; 1e-30 is a real
+    # value: 1e-30 > -1.5 -> right -> -1
+    np.testing.assert_allclose(out, [1.0, 1.0, -1.0])
+    np.testing.assert_allclose(b.features_shap(X).sum(-1)[:, 0], out,
+                               rtol=1e-6, atol=1e-6)
