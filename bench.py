@@ -84,8 +84,19 @@ def _fit_tpu(X, y, Xt):
         bins, mapper = bin_dataset_to_device(X, max_bin=MAX_BIN)
         result = train(bins, y, opts, mapper=mapper)
         times.append(time.perf_counter() - t0)
+    # Decomposition: the same fit with bins already device-resident (median
+    # of 3, like the other published numbers). On this rig the host->device
+    # wire is a remote-attach tunnel whose throughput swings ~5x run to run;
+    # production hosts pay ~1 ms for this transfer (PCIe), so the resident
+    # number is the hardware-limited fit time.
+    resident = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        result = train(bins, y, opts, mapper=mapper)
+        resident.append(time.perf_counter() - t0)
+    resident_secs = float(np.median(resident))
     margins = result.booster.raw_margin(Xt)[:, 0]
-    return float(np.median(times)), margins, result.booster
+    return float(np.median(times)), resident_secs, margins, result.booster
 
 
 def _predict_throughput_tpu(booster, X, reps=10):
@@ -163,7 +174,7 @@ def main():
     import jax
 
     backend = jax.default_backend()
-    tpu_secs, tpu_margins, booster = _fit_tpu(Xtr, ytr, Xte)
+    tpu_secs, resident_secs, tpu_margins, booster = _fit_tpu(Xtr, ytr, Xte)
     tpu_tput = N_ROWS * N_ITERS / tpu_secs
     auc_tpu = _auc(yte, tpu_margins)
     # throughput is per-row: cap the measurement batch so the one-dispatch
@@ -189,6 +200,10 @@ def main():
                 "unit": "rows*iters/sec",
                 "vs_baseline": round(vs, 3),
                 "tpu_fit_secs": round(tpu_secs, 3),
+                "tpu_fit_secs_device_resident": round(resident_secs, 3),
+                "vs_baseline_device_resident": (
+                    round(cpu_secs / resident_secs, 3) if cpu_secs else 0.0
+                ),
                 "cpu_fit_secs": round(cpu_secs, 3),
                 "auc_tpu": round(float(auc_tpu), 5),
                 "auc_cpu": round(float(auc_cpu), 5),

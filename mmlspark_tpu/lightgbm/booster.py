@@ -45,6 +45,9 @@ from jax import lax
 
 from mmlspark_tpu.lightgbm.binning import BinMapper
 
+#: LightGBM's kZeroThreshold: |x| <= this counts as zero (zero_as_missing).
+K_ZERO_THRESHOLD = 1e-35
+
 def _predict_chunk_rows(t: int, i: int, budget_bytes: int = 256 << 20) -> int:
     """Rows per predict dispatch. The budget covers the (N, T, I) decision
     tensor AND its same-shape temporaries (D, score, match ≈ 4x), so huge
@@ -460,8 +463,7 @@ def _path_match(X, feats, thrs, nanl, zm, P, plen):
     x = x.reshape(n, t, i)
     # missing (NaN — and 0.0 at zero_as_missing nodes) routes per the
     # node's nan_left flag; pads are always-left
-    # LightGBM's kZeroThreshold: |x| <= 1e-35 counts as zero/missing
-    miss = jnp.isnan(x) | (zm[None] & (jnp.abs(x) <= 1e-35))
+    miss = jnp.isnan(x) | (zm[None] & (jnp.abs(x) <= K_ZERO_THRESHOLD))
     d = jnp.where(miss, nanl[None], x <= thrs[None])
     D = 2.0 * d.astype(jnp.float32) - 1.0  # (N, T, I)
     score = jnp.einsum(
@@ -504,8 +506,7 @@ def _path_match_cat(X, feats, thrs, nanl, zm, P, plen, iscat, catm):
     n = X.shape[0]
     t, i = feats.shape
     x = x.reshape(n, t, i)
-    # LightGBM's kZeroThreshold: |x| <= 1e-35 counts as zero/missing
-    miss = jnp.isnan(x) | (zm[None] & (jnp.abs(x) <= 1e-35))
+    miss = jnp.isnan(x) | (zm[None] & (jnp.abs(x) <= K_ZERO_THRESHOLD))
     d_num = jnp.where(miss, nanl[None], x <= thrs[None])
     xb = jnp.clip(x, 0, catm.shape[-1] - 1).astype(jnp.int32)
     d_cat = catm[

@@ -73,8 +73,12 @@ def _shap_one_tree(feat, thr, left, right, is_leaf, leaf_val, cover, X,
     nl = np.ones(len(feat), bool) if nan_left is None else np.asarray(nan_left, bool)
     miss = np.isnan(xv)
     if zero_missing is not None and np.any(zero_missing):
-        # LightGBM's kZeroThreshold: |x| <= 1e-35 counts as zero/missing
-        miss = miss | (np.asarray(zero_missing, bool)[None, :] & (np.abs(xv) <= 1e-35))
+        from mmlspark_tpu.lightgbm.booster import K_ZERO_THRESHOLD
+
+        miss = miss | (
+            np.asarray(zero_missing, bool)[None, :]
+            & (np.abs(xv) <= K_ZERO_THRESHOLD)
+        )
     goes_left = np.where(miss, nl[None, :], xv <= _thr_f32(thr)[None, :])
     if cat_node is not None and np.any(cat_node):
         # categorical columns of X hold value-bin ids (tree_shap pre-bins);
