@@ -85,12 +85,12 @@ def _fit_tpu(X, y, Xt):
         result = train(bins, y, opts, mapper=mapper)
         times.append(time.perf_counter() - t0)
     # Decomposition: the same fit with bins already device-resident (median
-    # of 3, like the other published numbers). On this rig the host->device
+    # of TPU_RUNS, like the wire-inclusive number). On this rig the host->device
     # wire is a remote-attach tunnel whose throughput swings ~5x run to run;
     # production hosts pay ~1 ms for this transfer (PCIe), so the resident
     # number is the hardware-limited fit time.
     resident = []
-    for _ in range(3):
+    for _ in range(TPU_RUNS):
         t0 = time.perf_counter()
         result = train(bins, y, opts, mapper=mapper)
         resident.append(time.perf_counter() - t0)

@@ -11,7 +11,7 @@ handling (auc/ndcg/map maximize, losses minimize) matches
 from __future__ import annotations
 
 import dataclasses
-from typing import Callable, Dict, Tuple
+from typing import Any, Callable, Dict, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -27,6 +27,12 @@ class Objective:
     # (y, num_classes, w) -> init margin (C,)
     init_score: Callable[..., np.ndarray]
     default_metric: str
+    # Distinguishes data-specific objective INSTANCES sharing a name in the
+    # jitted-program cache (train._PROGRAM_CACHE keys on this): the registry
+    # singletons use None; per-fit objectives (lambdarank closes over the
+    # query-group structure) must carry a unique token or a later fit with
+    # identical TrainOptions silently reuses the first fit's closure.
+    cache_token: Any = None
 
 
 def _sigmoid(x):

@@ -1312,7 +1312,7 @@ def train(
                 u_bytes(n + pad, cand) / 1e9, budget / 1e9,
             )
 
-    okey = (_opts_key(opts), num_bins, mesh, u_spec)
+    okey = (_opts_key(opts), num_bins, mesh, u_spec, objective.cache_token)
     if opts.boosting_type == "goss":
         okey = okey + (n,)  # GOSS bakes the unpadded row count into the program
     step_raw = _cached_program(

@@ -288,3 +288,44 @@ class TestParamSurfaceAudit:
             LightGBMClassifier(numIterations=2, isUnbalance=True).fit(
                 Table({"features": X, "label": y})
             )
+
+
+class TestRankerLabelGain:
+    def _ltr(self, seed=9):
+        rng = np.random.default_rng(seed)
+        q, per = 30, 10
+        n = q * per
+        X = rng.normal(size=(n, 5))
+        rel = np.clip((X[:, 0] + rng.normal(scale=0.4, size=n)) * 1.5 + 1.5,
+                      0, 4).round()
+        group = np.repeat(np.arange(q), per)
+        return Table({"features": X, "label": rel.astype(np.float64),
+                      "query": group.astype(np.int64)}), X, rel, group
+
+    def test_custom_label_gain_trains_and_evaluates(self):
+        from mmlspark_tpu.lightgbm.ranker import ndcg_at_k
+
+        t, X, rel, group = self._ltr()
+        lg = [0.0, 1.0, 3.0, 7.0, 100.0]  # heavy top-relevance emphasis
+        m = LightGBMRanker(
+            numIterations=15, groupCol="query", minDataInLeaf=3,
+            labelGain=lg, seed=0, parallelism="serial",
+        ).fit(t)
+        score = m.transform(t)["prediction"]
+        nd = ndcg_at_k(rel, score, group, k=5, label_gain=lg)
+        base = ndcg_at_k(rel, np.random.default_rng(0).normal(size=len(rel)),
+                         group, k=5, label_gain=lg)
+        assert nd > base + 0.1, (nd, base)
+        # the custom table trains a DIFFERENT model than the default
+        m0 = LightGBMRanker(
+            numIterations=15, groupCol="query", minDataInLeaf=3, seed=0,
+            parallelism="serial",
+        ).fit(t)
+        assert not np.allclose(score, m0.transform(t)["prediction"])
+
+    def test_short_label_gain_raises(self):
+        t, *_ = self._ltr()
+        with pytest.raises(ValueError, match="labelGain"):
+            LightGBMRanker(
+                numIterations=2, groupCol="query", labelGain=[0.0, 1.0]
+            ).fit(t)
