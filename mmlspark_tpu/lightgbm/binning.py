@@ -103,12 +103,8 @@ def fit_bin_mapper(
         col = col[~np.isnan(col)]
         if j in cat_set:
             u, counts = np.unique(col, return_counts=True)
-            # most frequent first (ties by value — deterministic); capacity
-            # mb - 1 value bins; the rest fall to missing (-> right)
-            order = np.lexsort((u, -counts))
-            vals = u[order][: mb - 1]
-            cat_values[j] = np.asarray(vals, dtype=np.float64)
-            num_bins[j] = len(vals) + 1  # + missing bin
+            cat_values[j] = _cat_values_from_counts(u, counts, mb)
+            num_bins[j] = len(cat_values[j]) + 1  # + missing bin
             continue
         if col.size == 0:
             num_bins[j] = 1
@@ -121,6 +117,14 @@ def fit_bin_mapper(
     mapper = _snap_edges(edges, num_bins, max_bin)
     mapper.cat_values = cat_values or None
     return mapper
+
+
+def _cat_values_from_counts(u: np.ndarray, counts: np.ndarray, mb: int) -> np.ndarray:
+    """Value-identity bin list for one categorical feature: most frequent
+    first (ties by value), capacity ``mb - 1`` — the ONE rule shared by the
+    dense and CSR fits (they must stay bit-identical)."""
+    order = np.lexsort((u, -counts))
+    return np.asarray(u[order][: mb - 1], dtype=np.float64)
 
 
 def _edges_from_counts(
@@ -222,17 +226,15 @@ def bin_dataset(
     from mmlspark_tpu.data.sparse import CSRMatrix
 
     if isinstance(X, CSRMatrix):
-        if categorical_features:
-            raise ValueError(
-                "categorical features are not supported on sparse (CSR) "
-                "input — densify the categorical columns first"
-            )
         if max_bin_by_feature:
             raise ValueError(
                 "maxBinByFeature is not supported on sparse (CSR) input"
             )
         if mapper is None:
-            mapper = fit_bin_mapper_csr(X, max_bin=max_bin, sample_cnt=sample_cnt)
+            mapper = fit_bin_mapper_csr(
+                X, max_bin=max_bin, sample_cnt=sample_cnt,
+                categorical_features=categorical_features,
+            )
         return apply_bins_csr(X, mapper), mapper
     X = np.asarray(X, dtype=np.float64)
     if mapper is None:
@@ -272,10 +274,13 @@ def _weighted_quantile(u: np.ndarray, c: np.ndarray, qs: np.ndarray) -> np.ndarr
     return np.where(frac >= 0.5, a_hi - diff * (1 - frac), out)
 
 
-def fit_bin_mapper_csr(csr, max_bin: int = 255, sample_cnt: int = 200_000, seed: int = 0) -> BinMapper:
+def fit_bin_mapper_csr(csr, max_bin: int = 255, sample_cnt: int = 200_000,
+                       seed: int = 0, categorical_features=None) -> BinMapper:
     """Per-feature quantile edges from CSR without densifying. Matches
     :func:`fit_bin_mapper` on the equivalent dense matrix exactly (same
-    sampling rng, same quantile arithmetic with the implicit-zero mass)."""
+    sampling rng, same quantile arithmetic with the implicit-zero mass;
+    categorical features count the implicit zeros toward category 0.0's
+    frequency)."""
     n, f = csr.shape
     if n > sample_cnt:
         rng = np.random.default_rng(seed)
@@ -298,8 +303,10 @@ def fit_bin_mapper_csr(csr, max_bin: int = 255, sample_cnt: int = 200_000, seed:
     cols_s, vals_s = cols[order], vals[order]
     col_starts = np.searchsorted(cols_s, np.arange(f + 1))
 
+    cat_set = set(int(c) for c in (categorical_features or []))
     edges = np.full((f, max_bin - 1), np.inf, dtype=np.float64)
     num_bins = np.zeros(f, dtype=np.int32)
+    cat_values: dict = {}
     qs = np.linspace(0, 1, max_bin)
     for j in range(f):
         explicit = vals_s[col_starts[j] : col_starts[j + 1]]
@@ -318,11 +325,18 @@ def fit_bin_mapper_csr(csr, max_bin: int = 255, sample_cnt: int = 200_000, seed:
         elif n_zero > 0:
             u = np.insert(u, pos, 0.0)
             counts = np.insert(counts, pos, n_zero)
+        if j in cat_set:
+            # shared rule, with the implicit-zero mass already folded in
+            cat_values[j] = _cat_values_from_counts(u, counts, max_bin)
+            num_bins[j] = len(cat_values[j]) + 1
+            continue
         e = _edges_from_counts(u, counts, max_bin, qs)
         k = len(e)
         edges[j, :k] = e
         num_bins[j] = k + 2
-    return _snap_edges(edges, num_bins, max_bin)
+    mapper = _snap_edges(edges, num_bins, max_bin)
+    mapper.cat_values = cat_values or None
+    return mapper
 
 
 def apply_bins_csr(csr, mapper: BinMapper) -> np.ndarray:
@@ -336,12 +350,19 @@ def apply_bins_csr(csr, mapper: BinMapper) -> np.ndarray:
         0,
         mapper.max_bin,
     ).astype(np.uint8)
+    for j, vals in (mapper.cat_values or {}).items():
+        # categorical zero-fill: category 0.0's value bin (or missing)
+        zero_bins[j] = np.uint8(cat_to_bins(np.array([0.0]), vals)[0])
     out = np.broadcast_to(zero_bins[None, :], (n, f)).copy()
 
     col_indptr, row_ids, values = csr.to_csc()
     for j in range(f):
         lo, hi = col_indptr[j], col_indptr[j + 1]
         if hi == lo:
+            continue
+        if mapper.is_categorical(j):
+            b = cat_to_bins(values[lo:hi], mapper.cat_values[j])
+            out[row_ids[lo:hi], j] = b.astype(np.uint8)
             continue
         v = values[lo:hi].astype(np.float32)
         b = 1 + np.searchsorted(edges32[j], v, side="left")

@@ -119,7 +119,10 @@ def make_lambdarank_objective(
     # fits whose group structures / gain tables differ, but refits on the
     # SAME grouping (CV folds resampled elsewhere, param sweeps) must still
     # hit the cache — re-tracing is seconds per fit.
-    token = hashlib.sha1(np.ascontiguousarray(group_index).tobytes()).hexdigest()
+    gi = np.ascontiguousarray(group_index)
+    token = hashlib.sha1(
+        repr((gi.shape, str(gi.dtype))).encode() + gi.tobytes()
+    ).hexdigest()
     lg_key = None if label_gain is None else tuple(float(v) for v in label_gain)
     return Objective(
         "lambdarank", lambda c: 1, grad_hess, init_score, "ndcg@5",

@@ -329,3 +329,28 @@ class TestRankerLabelGain:
             LightGBMRanker(
                 numIterations=2, groupCol="query", labelGain=[0.0, 1.0]
             ).fit(t)
+
+    def test_regrouped_refit_uses_fresh_group_structure(self):
+        """Two ranker fits on the SAME rows with different uniform group
+        sizes have byte-identical group-index arrays of different shapes —
+        the program cache must not conflate them."""
+        from mmlspark_tpu.lightgbm.ranker import ndcg_at_k
+
+        rng = np.random.default_rng(3)
+        n = 600
+        X = rng.normal(size=(n, 4))
+        rel = np.clip(X[:, 0] * 1.5 + 1.5, 0, 4).round()
+
+        def fit(per):
+            group = np.repeat(np.arange(n // per), per)
+            t = Table({"features": X, "label": rel.astype(np.float64),
+                       "query": group.astype(np.int64)})
+            m = LightGBMRanker(numIterations=8, groupCol="query",
+                               minDataInLeaf=3, seed=0,
+                               parallelism="serial").fit(t)
+            return ndcg_at_k(rel, m.transform(t)["prediction"], group, k=5)
+
+        nd10 = fit(10)
+        nd20 = fit(20)  # reshape of the SAME arange — must not reuse closure
+        # both must be properly trained models, not one real + one garbage
+        assert nd10 > 0.85 and nd20 > 0.85, (nd10, nd20)

@@ -57,15 +57,31 @@ class TestCatBinning:
         assert len(mp.cat_values[0]) == 7  # max_bin - 1 value bins
         assert (bins == 0).sum() == 13  # the rest -> missing bin
 
-    def test_csr_rejects_categorical(self):
+    def test_csr_matches_dense(self):
+        """Categorical binning on CSR input is bit-identical to the dense
+        path (implicit zeros count toward category 0.0's frequency)."""
         from mmlspark_tpu.data.sparse import CSRMatrix
 
+        rng = np.random.default_rng(4)
+        n, f = 400, 3
+        X = np.zeros((n, f))
+        X[:, 0] = rng.integers(0, 6, size=n)  # categorical incl. many zeros
+        X[:, 1] = rng.normal(size=n)
+        X[:, 2] = np.where(rng.uniform(size=n) < 0.5, 0.0,
+                           rng.integers(1, 4, size=n))  # sparse categorical
+        mask = X != 0
+        indptr = np.concatenate([[0], np.cumsum(mask.sum(axis=1))])
         csr = CSRMatrix(
-            indptr=np.array([0, 1]), indices=np.array([0]),
-            data=np.array([1.0]), shape=(1, 2),
+            indptr=indptr.astype(np.int64),
+            indices=np.nonzero(mask)[1].astype(np.int64),
+            data=X[mask].astype(np.float64),
+            shape=(n, f),
         )
-        with pytest.raises(ValueError, match="categorical"):
-            bin_dataset(csr, max_bin=15, categorical_features=[0])
+        bd, md = bin_dataset(X, max_bin=15, categorical_features=[0, 2])
+        bs, ms = bin_dataset(csr, max_bin=15, categorical_features=[0, 2])
+        np.testing.assert_array_equal(bs, bd)
+        for j in (0, 2):
+            np.testing.assert_array_equal(ms.cat_values[j], md.cat_values[j])
 
 
 class TestCatTraining:
@@ -287,4 +303,32 @@ class TestCatEstimatorAPI:
         np.testing.assert_allclose(
             m2.booster.raw_margin(X)[:, 0], m.booster.raw_margin(X)[:, 0],
             rtol=1e-5, atol=1e-5,
+        )
+
+
+class TestCatSparseEstimator:
+    def test_sparse_column_fit_matches_dense(self):
+        """Sparse (indices, values) feature columns with categorical slots
+        train the same model as the densified table."""
+        rng = np.random.default_rng(6)
+        n = 1500
+        cat = rng.integers(0, 6, size=n)
+        eff = np.array([2.0, -2.0, 1.5, -1.5, 0.5, -0.5])
+        Xn = rng.normal(size=(n, 2))
+        y = ((eff[cat] + Xn[:, 0]) > 0).astype(np.float64)
+        X = np.column_stack([cat.astype(np.float64), Xn])
+        sparse_col = np.empty(n, dtype=object)
+        for i in range(n):
+            nz = np.nonzero(X[i])[0]
+            sparse_col[i] = (nz.astype(np.int64), X[i][nz])
+        td = Table({"features": X, "label": y})
+        ts = Table({"features": sparse_col, "label": y},
+                   metadata={"features": {"sparse_dim": 3}})
+        kw = dict(numIterations=5, numLeaves=7, categoricalSlotIndexes=[0],
+                  parallelism="serial", seed=0)
+        md = LightGBMClassifier(**kw).fit(td)
+        ms = LightGBMClassifier(**kw).fit(ts)
+        assert ms.booster.has_categorical
+        np.testing.assert_allclose(
+            ms.booster.leaf_values, md.booster.leaf_values, rtol=1e-6
         )
