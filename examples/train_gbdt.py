@@ -3,7 +3,8 @@
     python examples/train_gbdt.py
 
 Covers: table construction, fit with LightGBM-style params, prediction
-columns, SHAP explanations, native-model save/load, feature importances.
+columns, SHAP explanations, native-model save/load, feature importances,
+and the plot helpers (confusion matrix + ROC, saved as a PNG).
 """
 
 import os
@@ -45,6 +46,20 @@ def main():
 
     top = np.argsort(model.get_feature_importances("split"))[::-1][:5]
     print("top-5 features by split count:", [d.feature_names[i] for i in top])
+
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    from mmlspark_tpu import plot
+
+    fig, (ax_cm, ax_roc) = plt.subplots(1, 2, figsize=(12, 5))
+    scored = out.with_column("p1", probs)
+    plot.confusion_matrix(scored, "label", "prediction", labels=[0.0, 1.0], ax=ax_cm)
+    plot.roc(scored, "label", "p1", ax=ax_roc)
+    fig.savefig("/tmp/gbdt_eval.png", bbox_inches="tight")
+    print("saved confusion matrix + ROC to /tmp/gbdt_eval.png")
 
     path = "/tmp/gbdt_model.txt"
     model.save_native_model(path)

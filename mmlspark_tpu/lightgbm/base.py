@@ -150,6 +150,18 @@ class LightGBMParams(
         "Extra L2 applied to categorical split gains",
         default=10.0, converter=to_float, validator=ge(0),
     )
+    maxCatToOnehot = Param(
+        "Categorical features with at most this many seen categories use "
+        "the one-vs-rest split search instead of the sorted-set algorithm "
+        "(native LightGBM max_cat_to_onehot)",
+        default=4, converter=to_int, validator=gt(0),
+    )
+    minDataPerGroup = Param(
+        "Minimal rows a category needs to enter the sorted-set split "
+        "search (native LightGBM min_data_per_group; the one-vs-rest "
+        "path is exempt)",
+        default=100, converter=to_int, validator=gt(0),
+    )
     boostFromAverage = Param(
         "Start boosting from the label average init score (false = from 0)",
         default=True, converter=to_bool,
@@ -213,6 +225,8 @@ class LightGBMParams(
             max_cat_threshold=self.getMaxCatThreshold(),
             cat_smooth=self.getCatSmooth(),
             cat_l2=self.getCatL2(),
+            max_cat_to_onehot=self.getMaxCatToOnehot(),
+            min_data_per_group=self.getMinDataPerGroup(),
             boost_from_average=self.getBoostFromAverage(),
             provide_training_metric=self.getIsProvideTrainingMetric(),
         )

@@ -89,10 +89,23 @@ class Booster:
     # (T, M) bool: zero_as_missing nodes (imported LightGBM missing_type=
     # Zero): a 0.0 or NaN feature value routes per nan_left there.
     zero_missing: Optional[np.ndarray] = None
+    # Linear trees (imported ``linear_tree=true`` models; training here
+    # never produces them): at leaf slot m the output is
+    # ``leaf_const[t, m] + sum_l leaf_coeff[t, m, l] * x[leaf_feat[t, m, l]]``
+    # over valid entries (``leaf_feat >= 0``; -1 pads). If ANY feature used
+    # by the leaf's model is NaN, the plain ``leaf_values`` output applies —
+    # native LightGBM's missing fallback for linear leaves.
+    leaf_const: Optional[np.ndarray] = None  # (T, M) float64
+    leaf_coeff: Optional[np.ndarray] = None  # (T, M, L) float64
+    leaf_feat: Optional[np.ndarray] = None  # (T, M, L) int32, -1 pad
 
     @property
     def has_categorical(self) -> bool:
         return self.cat_nodes is not None and bool(np.any(self.cat_nodes))
+
+    @property
+    def has_linear(self) -> bool:
+        return self.leaf_const is not None
 
     def _cat_binned(self, X: np.ndarray) -> np.ndarray:
         """Replace categorical columns of a raw batch with their value-bin
@@ -138,7 +151,12 @@ class Booster:
     ) -> np.ndarray:
         """(N, C) raw margins (init_score + sum of tree outputs). ``X`` may be
         dense (N, F) or a CSRMatrix (densified in bounded row chunks)."""
-        chunks = _csr_chunks(X)
+        chunks = _csr_chunks(
+            X,
+            dtype=np.float64
+            if (self.has_categorical or self.has_linear)
+            else np.float32,
+        )
         if chunks is not None:
             return np.concatenate(
                 [self.raw_margin(c, num_iteration) for c in chunks], axis=0
@@ -148,6 +166,8 @@ class Booster:
             return np.broadcast_to(
                 self.init_score[None, :], (X.shape[0], self.num_classes)
             ).copy()
+        if self.has_linear:
+            return self._raw_margin_linear(X, num_iteration)
         pc = _paths_cache(self, t)
         has_cat = self.has_categorical
         X32 = np.asarray(
@@ -178,11 +198,48 @@ class Booster:
             outs.append(np.asarray(m))
         return np.concatenate(outs, axis=0) if outs else np.zeros((0, self.num_classes), np.float32)
 
+    def _raw_margin_linear(
+        self, X, num_iteration: Optional[int] = None
+    ) -> np.ndarray:
+        """Margins for linear-tree models: leaf ROUTING stays on device (the
+        jitted path-matrix leaf predict), the per-leaf linear models run in
+        float64 on host — native LightGBM evaluates linear leaves in double,
+        and an f32 detour would visibly drift coefficient-heavy leaves.
+        A leaf whose model touches a NaN feature falls back to the plain
+        constant output (native behavior for linear leaves + missing)."""
+        slots = self.predict_leaf(X, num_iteration)  # (N, T) leaf slots
+        t = slots.shape[1]
+        Xd = np.asarray(X, np.float64)
+        n = Xd.shape[0]
+        tt = np.arange(t)[None, :]
+        lmax = self.leaf_feat.shape[-1]
+        out = np.empty((n, t), np.float64)
+        chunk = max(1, (64 << 20) // max(8 * t * lmax, 1))
+        for lo in range(0, max(n, 1), chunk):
+            sl = slots[lo : lo + chunk]
+            const = self.leaf_const[tt, sl]  # (n, T)
+            coeff = self.leaf_coeff[tt, sl]  # (n, T, L)
+            fidx = self.leaf_feat[tt, sl]  # (n, T, L)
+            valid = fidx >= 0
+            rows = np.arange(sl.shape[0])[:, None, None]
+            xv = Xd[lo : lo + chunk][rows, np.maximum(fidx, 0)]
+            nanf = np.any(valid & np.isnan(xv), axis=-1)
+            lin = const + np.where(
+                valid & ~np.isnan(xv), coeff * xv, 0.0
+            ).sum(axis=-1)
+            plain = self.leaf_values[tt, sl].astype(np.float64)
+            out[lo : lo + chunk] = np.where(nanf, plain, lin)
+        rounds = t // self.num_classes
+        margins = out.reshape(n, rounds, self.num_classes).sum(axis=1)
+        return margins + np.asarray(self.init_score, np.float64)[None, :]
+
     def predict_leaf(
         self, X, num_iteration: Optional[int] = None
     ) -> np.ndarray:
         """(N, T) leaf slot per tree (``predictLeaf``, LightGBMBooster.scala:240+)."""
-        chunks = _csr_chunks(X)
+        chunks = _csr_chunks(
+            X, dtype=np.float64 if self.has_categorical else np.float32
+        )
         if chunks is not None:
             return np.concatenate(
                 [self.predict_leaf(c, num_iteration) for c in chunks], axis=0
@@ -225,7 +282,13 @@ class Booster:
         training covers recorded per node."""
         from mmlspark_tpu.lightgbm.shap import tree_shap
 
-        chunks = _csr_chunks(X)
+        if self.has_linear:
+            raise NotImplementedError(
+                "SHAP values are not implemented for linear-tree models "
+                "(leaf outputs are per-leaf linear functions, outside "
+                "TreeSHAP's piecewise-constant contract)"
+            )
+        chunks = _csr_chunks(X, dtype=np.float64)
         if chunks is not None:
             return np.concatenate(
                 [self.features_shap(c, num_iteration) for c in chunks], axis=0
@@ -264,6 +327,13 @@ class Booster:
                 int(k): np.asarray(v, dtype=np.float64)
                 for k, v in d["cat_values"].items()
             }
+        for k, dt in (
+            ("leaf_const", np.float64),
+            ("leaf_coeff", np.float64),
+            ("leaf_feat", np.int32),
+        ):
+            if d.get(k) is not None:
+                d[k] = np.asarray(d[k], dtype=dt)
         return Booster(**d)
 
     def model_to_string(self) -> str:
@@ -326,17 +396,25 @@ class Booster:
         return np.bincount(feats.ravel(), minlength=num_features).astype(np.float64)
 
 
-def _csr_chunks(X, target_bytes: int = 256 << 20):
-    """None for dense inputs; for CSRMatrix, an iterator of densified float32
-    row chunks sized so each chunk stays under ``target_bytes`` regardless of
-    feature count (wide sparse data shrinks the row window)."""
+def _csr_chunks(X, target_bytes: int = 256 << 20, dtype=np.float32):
+    """None for dense inputs; for CSRMatrix, an iterator of densified row
+    chunks sized so each chunk stays under ``target_bytes`` regardless of
+    feature count (wide sparse data shrinks the row window).
+
+    Categorical boosters must densify in float64: training bins CSR
+    categorical values in f64 (``apply_bins_csr``), and a float32 detour
+    would round category ids above 2**24 before ``_cat_binned``'s
+    value-identity match, silently routing them as 'unseen'."""
     from mmlspark_tpu.data.sparse import CSRMatrix
 
     if not isinstance(X, CSRMatrix):
         return None
-    chunk_rows = min(65536, max(1, target_bytes // (4 * max(X.num_features, 1))))
+    itemsize = np.dtype(dtype).itemsize
+    chunk_rows = min(
+        65536, max(1, target_bytes // (itemsize * max(X.num_features, 1)))
+    )
     return (
-        X.row_slice(lo, min(lo + chunk_rows, X.num_rows)).to_dense(np.float32)
+        X.row_slice(lo, min(lo + chunk_rows, X.num_rows)).to_dense(dtype)
         for lo in range(0, max(X.num_rows, 1), chunk_rows)
     )
 

@@ -28,7 +28,12 @@ Encoding notes (mirroring LightGBM's ``src/io/tree.cpp`` / ``gbdt_model_text.cpp
   ``init_score = 0`` (the margins come out identical).
 - Floats print with ``%.17g`` (round-trip exact for float64).
 
-Out of scope (explicit error): linear trees (``is_linear=1``).
+- Linear trees (``is_linear=1``, LightGBM's ``linear_tree=true``): per-leaf
+  linear models import/export via ``leaf_const`` / ``num_features`` /
+  ``leaf_features`` / ``leaf_coeff`` (concatenated in leaf order); predict
+  evaluates them in float64 with native LightGBM's NaN fallback to the
+  plain leaf output. SHAP on such models raises.
+
 ``missing_type=None`` imports with the LightGBM predictor's convention that
 a NaN at such a node behaves like 0.0, which resolves to a static per-node
 direction ``nan_left = (0.0 <= threshold)``; ``missing_type=Zero``
@@ -227,19 +232,38 @@ def to_lightgbm_text(booster, shrinkage: float = 1.0) -> str:
                 f"cat_boundaries={_fmt_int(cat_boundaries)}",
                 f"cat_threshold={_fmt_int(cat_words)}",
             ]
-        fields += [
-            "is_linear=0",
-            f"shrinkage={_G % shrinkage}",
-        ]
+
+        # Linear leaves (imported linear_tree models being re-exported):
+        # concatenate per-leaf models in leaf-id order; the iteration-0 bias
+        # folds into BOTH the intercepts and the fallback leaf values.
+        lin_fields: List[str] = []
+        if getattr(booster, "leaf_const", None) is not None:
+            lconst = np.zeros(max(num_leaves, 1), np.float64)
+            per: List[tuple] = [((), ())] * max(num_leaves, 1)
+            for slot, li in leaf_ids.items():
+                lconst[li] = float(booster.leaf_const[ti][slot]) + bias
+                fi = np.asarray(booster.leaf_feat[ti][slot])
+                co = np.asarray(booster.leaf_coeff[ti][slot])
+                v = fi >= 0
+                per[li] = (fi[v].tolist(), co[v].tolist())
+            lin_fields = [
+                "is_linear=1",
+                f"leaf_const={_fmt(lconst)}",
+                f"num_features={_fmt_int([len(p[0]) for p in per])}",
+                f"leaf_features={_fmt_int([x for p in per for x in p[0]])}",
+                f"leaf_coeff={_fmt([x for p in per for x in p[1]])}",
+            ]
+        else:
+            lin_fields = ["is_linear=0"]
+
+        fields += lin_fields + [f"shrinkage={_G % shrinkage}"]
         if ni == 0:
             # single-leaf tree: LightGBM omits the internal-node arrays
             fields = [
                 f"num_leaves={num_leaves}",
                 "num_cat=0",
                 f"leaf_value={_fmt(lv)}",
-                "is_linear=0",
-                f"shrinkage={_G % shrinkage}",
-            ]
+            ] + lin_fields + [f"shrinkage={_G % shrinkage}"]
         tree_strs.append(f"Tree={ti}\n" + "\n".join(fields) + "\n\n\n")
 
     names = booster.feature_names or [f"Column_{j}" for j in range(f)]
@@ -287,6 +311,42 @@ def to_lightgbm_text(booster, shrinkage: float = 1.0) -> str:
     )
 
 
+def _parse_linear_block(blk: dict, num_leaves: int, bi: int):
+    """Per-leaf linear models of an ``is_linear=1`` tree block
+    (LightGBM's ``linear_tree=true`` serialization): ``leaf_const`` is the
+    intercept per leaf, ``num_features`` the per-leaf model width, and
+    ``leaf_features``/``leaf_coeff`` the concatenated feature ids /
+    coefficients in leaf order. Returns (const, [feat_ids...], [coefs...])."""
+    const = np.fromstring(_block_value(blk, "leaf_const"), sep=" ")
+    if const.size != num_leaves:
+        raise ValueError(
+            f"tree {bi}: leaf_const has {const.size} entries for "
+            f"{num_leaves} leaves"
+        )
+    counts = np.fromstring(blk.get("num_features", ""), sep=" ").astype(np.int64)
+    if counts.size == 0:
+        counts = np.zeros(num_leaves, np.int64)
+    if counts.size != num_leaves:
+        raise ValueError(
+            f"tree {bi}: num_features has {counts.size} entries for "
+            f"{num_leaves} leaves"
+        )
+    feats = np.fromstring(blk.get("leaf_features", ""), sep=" ").astype(np.int64)
+    coefs = np.fromstring(blk.get("leaf_coeff", ""), sep=" ")
+    total = int(counts.sum())
+    if feats.size != total or coefs.size != total:
+        raise ValueError(
+            f"tree {bi}: leaf_features/leaf_coeff lengths "
+            f"({feats.size}/{coefs.size}) do not match num_features sum {total}"
+        )
+    offs = np.concatenate([[0], np.cumsum(counts)]).astype(np.int64)
+    return (
+        const,
+        [feats[offs[j] : offs[j + 1]] for j in range(num_leaves)],
+        [coefs[offs[j] : offs[j + 1]] for j in range(num_leaves)],
+    )
+
+
 def _block_value(block: dict, key: str, default=None):
     if key not in block:
         if default is not None:
@@ -296,9 +356,9 @@ def _block_value(block: dict, key: str, default=None):
 
 
 def from_lightgbm_text(s: str):
-    """Parse LightGBM model text into a Booster. Raises ``ValueError`` for
-    capabilities outside this runtime (categorical splits, linear trees,
-    ``zero_as_missing`` models)."""
+    """Parse LightGBM model text into a Booster (categorical splits,
+    ``zero_as_missing``, and linear trees included). Raises ``ValueError``
+    on structurally invalid files."""
     from mmlspark_tpu.lightgbm.booster import Booster
 
     lines = s.splitlines()
@@ -349,15 +409,18 @@ def from_lightgbm_text(s: str):
     for bi, blk in enumerate(blocks):
         num_leaves = int(_block_value(blk, "num_leaves"))
         num_cat = int(blk.get("num_cat", "0"))
-        if blk.get("is_linear", "0").strip() not in ("0", ""):
-            raise ValueError(f"tree {bi}: linear trees are not supported")
+        is_lin = blk.get("is_linear", "0").strip() not in ("0", "")
+        lin_fields = (
+            _parse_linear_block(blk, num_leaves, bi) if is_lin else None
+        )
         lv = np.fromstring(_block_value(blk, "leaf_value"), sep=" ")
         if num_leaves == 1:
-            trees.append(
-                dict(feat=[0], thr=[np.inf], left=[0], right=[0],
-                     is_leaf=[True], lval=[lv[0]], nanl=[True], zm=[False],
-                     cover=[0.0], gain=[0.0], cat={})
-            )
+            tr = dict(feat=[0], thr=[np.inf], left=[0], right=[0],
+                      is_leaf=[True], lval=[lv[0]], nanl=[True], zm=[False],
+                      cover=[0.0], gain=[0.0], cat={})
+            if lin_fields is not None:
+                tr["lin"] = lin_fields
+            trees.append(tr)
             continue
         sf = np.fromstring(_block_value(blk, "split_feature"), sep=" ").astype(np.int64)
         th = np.fromstring(_block_value(blk, "threshold"), sep=" ")
@@ -452,11 +515,12 @@ def from_lightgbm_text(s: str):
                 gain_s[ii] = gain[ii]
             if len(icnt) == ni:
                 cover_s[ii] = icnt[ii]
-        trees.append(
-            dict(feat=feat, thr=thr_s, left=left_s, right=right_s,
-                 is_leaf=isl, lval=lval_s, nanl=nanl_s, zm=zm_s,
-                 cover=cover_s, gain=gain_s, cat=cat_sets)
-        )
+        tr = dict(feat=feat, thr=thr_s, left=left_s, right=right_s,
+                  is_leaf=isl, lval=lval_s, nanl=nanl_s, zm=zm_s,
+                  cover=cover_s, gain=gain_s, cat=cat_sets)
+        if lin_fields is not None:
+            tr["lin"] = lin_fields
+        trees.append(tr)
 
     t = len(trees)
     m = max((len(tr["feat"]) for tr in trees), default=1)
@@ -466,6 +530,37 @@ def from_lightgbm_text(s: str):
         for ti, tr in enumerate(trees):
             out[ti, : len(tr[key])] = tr[key]
         return out
+
+    # Linear-tree state: per-LEAF linear models land at their leaf SLOTS
+    # (leaf j of a tree with ni internal nodes sits at slot ni + j). Trees
+    # without a model (mixed files — LightGBM itself writes all-or-nothing)
+    # fall back to const = plain leaf value with zero features, which makes
+    # the linear predict path exact for them too.
+    leaf_const = leaf_coeff = leaf_feat = None
+    if any("lin" in tr for tr in trees):
+        lmax = max(
+            (
+                max((len(a) for a in tr["lin"][1]), default=0)
+                for tr in trees if "lin" in tr
+            ),
+            default=0,
+        )
+        lmax = max(lmax, 1)
+        leaf_const = pad("lval", 0.0, np.float64)
+        leaf_coeff = np.zeros((t, m, lmax), np.float64)
+        leaf_feat = np.full((t, m, lmax), -1, np.int32)
+        for ti, tr in enumerate(trees):
+            if "lin" not in tr:
+                continue
+            m_t = len(tr["feat"])
+            nl_t = (m_t + 1) // 2
+            ni_t = m_t - nl_t
+            const, lfeats, lcoefs = tr["lin"]
+            leaf_const[ti, ni_t : ni_t + nl_t] = const[:nl_t]
+            for j in range(nl_t):
+                w = len(lfeats[j])
+                leaf_feat[ti, ni_t + j, :w] = lfeats[j]
+                leaf_coeff[ti, ni_t + j, :w] = lcoefs[j]
 
     # Booster-level categorical state: per-feature sorted value lists (the
     # union of every node's bitset on that feature) and per-node masks over
@@ -522,6 +617,9 @@ def from_lightgbm_text(s: str):
         cat_nodes=cat_nodes,
         cat_masks=cat_masks,
         cat_values=cat_values,
+        leaf_const=leaf_const,
+        leaf_coeff=leaf_coeff,
+        leaf_feat=leaf_feat,
     )
     return booster
 

@@ -105,6 +105,17 @@ class TrainOptions:
     max_cat_threshold: int = 32  # max categories in a split's left set
     cat_smooth: float = 10.0  # smoothing for the g/h category sort
     cat_l2: float = 10.0  # extra L2 applied to categorical split gains
+    # one-vs-rest split search for categorical features with at most this
+    # many seen categories (native LightGBM's max_cat_to_onehot; the engine
+    # the reference forwards to switches algorithms on this boundary)
+    max_cat_to_onehot: int = 4
+    # sorted-path candidate gate: categories with fewer rows than this never
+    # enter the g/h-ratio sort (native min_data_per_group; the one-vs-rest
+    # path is exempt, as in the native engine)
+    min_data_per_group: int = 100
+    # derived from the mapper at fit time: the categorical_slots subset that
+    # uses the one-vs-rest search (static => part of the program cache key)
+    onehot_slots: tuple = ()
     # boost_from_average=False: margins start at 0 instead of the
     # objective's average-based init score (LightGBMParams boostFromAverage)
     boost_from_average: bool = True
@@ -245,7 +256,10 @@ def _split_search(
         hist_c = hist[:, cat_idx]  # (k, Fc, B, 3)
         gsum, hsum, cnt = hist_c[..., 0], hist_c[..., 1], hist_c[..., 2]
         jpos = jnp.arange(b)[None, None, :]
-        nonempty = (cnt > 0) & (jpos > 0)
+        # min_data_per_group gates the SORTED candidates (native builds its
+        # sorted_idx list only from categories with enough rows; the
+        # one-vs-rest path below is exempt, also as in native)
+        nonempty = (cnt >= max(1, opts.min_data_per_group)) & (jpos > 0)
         ratio = gsum / (hsum + opts.cat_smooth)
         l2c = l2 + opts.cat_l2
         big = jnp.float32(np.finfo(np.float32).max)
@@ -276,6 +290,36 @@ def _split_search(
             dir_data.append((jnp.where(valid_c, gain_c, -jnp.inf), order, sg, sh, sc))
         gain_cat = jnp.maximum(dir_data[0][0], dir_data[1][0])
         use_desc = dir_data[1][0] > dir_data[0][0]  # (k, Fc, B)
+
+        # One-vs-rest search (native use_onehot, max_cat_to_onehot): for
+        # small-cardinality features the candidates are the SINGLE-category
+        # left sets {bin j} — position j in the gain plane IS bin j (no sort
+        # order involved). Same lambda_l2 + cat_l2 regularization; no
+        # cat_smooth, no min_data_per_group (native's one-hot loop applies
+        # neither). Bin 0 (unseen/NaN) never splits left.
+        oh_np = np.isin(cat_idx_np, np.asarray(opts.onehot_slots, np.int32))
+        if oh_np.any():
+            gr_oh = g_tot[:, None, None] - gsum
+            hr_oh = h_tot[:, None, None] - hsum
+            cr_oh = c_tot[:, None, None] - cnt
+            tl_oh = _soft_threshold(gsum, l1)
+            tr_oh = _soft_threshold(gr_oh, l1)
+            gain_oh = (
+                tl_oh * tl_oh / (hsum + l2c)
+                + tr_oh * tr_oh / (hr_oh + l2c)
+                - parent_c[:, None, None]
+            )
+            valid_oh = (
+                (jpos > 0)
+                & (cnt >= opts.min_data_in_leaf)
+                & (cr_oh >= opts.min_data_in_leaf)
+                & (hsum >= opts.min_sum_hessian_in_leaf)
+                & (hr_oh >= opts.min_sum_hessian_in_leaf)
+                & (fm_c[None, :, None] > 0)
+            )
+            gain_oh = jnp.where(valid_oh, gain_oh, -jnp.inf)
+            oh_mask = jnp.asarray(oh_np)  # (Fc,) static
+            gain_cat = jnp.where(oh_mask[None, :, None], gain_oh, gain_cat)
         gain = gain.at[:, cat_idx, :].set(gain_cat)
 
     flat = gain.reshape(k, f * b)
@@ -323,6 +367,16 @@ def _split_search(
         glb_c = _at_best(dir_data[0][2], dir_data[1][2])
         hlb_c = _at_best(dir_data[0][3], dir_data[1][3])
         clb_c = _at_best(dir_data[0][4], dir_data[1][4])
+        # One-vs-rest winners read their left stats STRAIGHT from the
+        # histogram at bin best_b (no cumulative sort prefix involved).
+        is_oh_best = (
+            jnp.asarray(oh_np)[cpos] & is_cat_best
+            if oh_np.any() else jnp.zeros(k, bool)
+        )
+        if oh_np.any():
+            glb_c = jnp.where(is_oh_best, gsum[iota, cpos, best_b], glb_c)
+            hlb_c = jnp.where(is_oh_best, hsum[iota, cpos, best_b], hlb_c)
+            clb_c = jnp.where(is_oh_best, cnt[iota, cpos, best_b], clb_c)
         glb = jnp.where(is_cat_best, glb_c, glb)
         hlb = jnp.where(is_cat_best, hlb_c, hlb)
         clb = jnp.where(is_cat_best, clb_c, clb)
@@ -341,6 +395,13 @@ def _split_search(
             .set(in_prefix)
             & is_cat_best[:, None]
         )
+        if oh_np.any():
+            # one-vs-rest left set = exactly {best_b}
+            cat_mask = jnp.where(
+                is_oh_best[:, None],
+                jnp.arange(b)[None, :] == best_b[:, None],
+                cat_mask,
+            )
         lval = jnp.where(
             is_cat_best, leaf_value_cat(glb, hlb), leaf_value(glb, hlb)
         )
@@ -1166,7 +1227,15 @@ def train(
     # (LightGBMBase.scala:148-156 likewise resolves slots before training).
     if mapper is not None and mapper.cat_values:
         opts = dataclasses.replace(
-            opts, categorical_slots=tuple(sorted(mapper.cat_values))
+            opts,
+            categorical_slots=tuple(sorted(mapper.cat_values)),
+            # native max_cat_to_onehot boundary: features whose SEEN category
+            # count is small use the one-vs-rest search instead of the sort
+            onehot_slots=tuple(
+                f_
+                for f_ in sorted(mapper.cat_values)
+                if len(mapper.cat_values[f_]) <= opts.max_cat_to_onehot
+            ),
         )
 
     w_is_default = w is None
@@ -1298,7 +1367,17 @@ def train(
 
         per_feature = None if mapper is None else [int(x) for x in mapper.num_bins]
         cand = make_u_spec(num_bins, f, per_feature)
-        budget = int(_os.environ.get("MMLSPARK_TPU_U_BUDGET", str(8 << 30)))
+        try:
+            budget = int(_os.environ.get("MMLSPARK_TPU_U_BUDGET", str(8 << 30)))
+        except ValueError:
+            from mmlspark_tpu.core.profiling import get_logger
+
+            get_logger("mmlspark_tpu.lightgbm").warning(
+                "MMLSPARK_TPU_U_BUDGET=%r is not an integer byte count; "
+                "using the default 8 GB budget",
+                _os.environ["MMLSPARK_TPU_U_BUDGET"],
+            )
+            budget = 8 << 30
         if u_bytes(n + pad, cand) <= budget:
             u_spec = cand
         elif opts.histogram_method == "u":

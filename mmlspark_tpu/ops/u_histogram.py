@@ -92,30 +92,38 @@ def u_bytes(n_rows: int, spec: USpec) -> int:
     return n_pad * spec.k_pad
 
 
+@functools.lru_cache(maxsize=64)
+def _col_maps_cached(spec: USpec) -> Tuple[np.ndarray, np.ndarray]:
+    """Static per-spec column maps: ``feat_of_col[c]`` = feature owning
+    packed row c, ``local_of_col[c]`` = c's bin id within that feature
+    (-1 on the k..k_pad tail so tail rows match nothing)."""
+    feat = np.zeros(spec.k_pad, np.int32)
+    local = np.full(spec.k_pad, -1, np.int32)
+    for j, (o, w) in enumerate(zip(spec.offsets, spec.widths)):
+        feat[o : o + w] = j
+        local[o : o + w] = np.arange(w)
+    return feat, local
+
+
 def build_u(bins: jax.Array, spec: USpec, dtype=jnp.int8) -> jax.Array:
     """(K_pad, N_pad) TRANSPOSED one-hot of the packed bin ids — ONE compare
     pass's worth of VPU work (~120 ms at 400k x 28 x 256), paid once per
-    fit. The bin axis leads so (a) the build concatenates feature blocks on
-    the MAJOR axis (contiguous; the (N, K) layout's minor-axis concat
-    measured ~10x slower) and (b) the pass contraction is lane-on-lane.
-    Pad rows carry bin id -1 (match no U row, contribute nothing)."""
+    fit. The bin axis leads so the pass contraction is lane-on-lane. Built
+    in ONE traced op regardless of F (a wide dataset must not inflate
+    trace/compile time linearly): gather the (F, N_pad) transposed ids by
+    the static col->feature map, then compare against each packed row's
+    local bin id. Pad rows carry bin id -1 and the k..k_pad tail carries
+    local id -1, so both contribute nothing. The int32 gather fuses into
+    the int8 compare (no (K_pad, N_pad) int32 materialization)."""
     n, f = bins.shape
     pad = (-n) % _N_ALIGN
     ids = bins.astype(jnp.int32)
     if pad:
         ids = jnp.pad(ids, ((0, pad), (0, 0)), constant_values=-1)
     ids_t = ids.T  # (F, N_pad)
-    rows = []
-    for j in range(f):
-        w = spec.widths[j]
-        oh = (
-            jnp.arange(w, dtype=jnp.int32)[:, None] == ids_t[j][None, :]
-        ).astype(dtype)
-        rows.append(oh)
-    tail = spec.k_pad - spec.k
-    if tail:
-        rows.append(jnp.zeros((tail, n + pad), dtype))
-    return jnp.concatenate(rows, axis=0)
+    feat_of_col, local_of_col = _col_maps_cached(spec)
+    col_ids = jnp.take(ids_t, jnp.asarray(feat_of_col), axis=0)  # (K_pad, N_pad)
+    return (col_ids == jnp.asarray(local_of_col)[:, None]).astype(dtype)
 
 
 def _dense_maps(spec: USpec) -> Tuple[np.ndarray, np.ndarray]:

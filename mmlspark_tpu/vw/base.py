@@ -105,12 +105,42 @@ class VowpalWabbitBaseParams(
         "--hash_seed": ("hash_seed", int),
     }
 
+    #: Diagnostic / IO flags that do not change the trained model: accepted
+    #: for pipeline compatibility (the reference forwards them to native VW
+    #: where they are no-ops for training math) and skipped with a warning.
+    #: Maps flag -> True if it consumes a value token.
+    _NOOP_ARGS = {
+        "--quiet": False,
+        "--no_stdin": False,
+        "--holdout_off": False,
+        "-p": True,
+        "--predictions": True,
+        "--progress": True,
+        "-P": True,
+        "--cache": False,
+        "-c": False,
+        "--cache_file": True,
+        "-k": False,
+        "--kill_cache": False,
+        "--save_resume": False,
+        "--preserve_performance_counters": False,
+        "--readable_model": True,
+        "--invert_hash": True,
+        "--audit": False,
+        "-a": False,
+    }
+
     def _parse_args(self) -> dict:
         """Parse the VW CLI flags this runtime implements
         (``appendParamIfNotThere`` analogue, VowpalWabbitBase.scala:140-159).
-        Unknown flags RAISE: the reference hands the full string to native
-        VW where every reduction works — silently dropping a flag here would
-        train a different model than the user asked for."""
+        Unknown MODEL-CHANGING flags RAISE: the reference hands the full
+        string to native VW where every reduction works — silently dropping
+        one here would train a different model than the user asked for.
+        Known diagnostic/IO flags (``_NOOP_ARGS``) are skipped with a
+        warning so existing pipelines that pass e.g. ``--quiet`` keep
+        working."""
+        from mmlspark_tpu.core.profiling import get_logger
+
         out = {}
         toks = self.getPassThroughArgs().split()
         i = 0
@@ -119,6 +149,13 @@ class VowpalWabbitBaseParams(
             inline = None
             if t.startswith("--") and "=" in t:
                 t, _, inline = t.partition("=")
+            if t in self._NOOP_ARGS:
+                get_logger("mmlspark_tpu.vw").warning(
+                    "passThroughArgs: ignoring diagnostic VW flag %r "
+                    "(no effect on the trained model in this runtime)", t
+                )
+                i += 1 + (1 if self._NOOP_ARGS[t] and inline is None else 0)
+                continue
             if t not in self._ARG_SPEC:
                 raise ValueError(
                     f"passThroughArgs: unsupported VW flag {t!r}. This "

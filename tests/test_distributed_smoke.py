@@ -1,0 +1,119 @@
+"""Two-process ``jax.distributed`` bootstrap smoke.
+
+Executes :func:`mmlspark_tpu.parallel.mesh.distributed_init` for REAL: a
+coordinator and a worker process rendezvous over localhost (the surviving
+driver-rendezvous role of the reference's ``LightGBMUtils.scala:117-186``
+socket collect/broadcast), then run one cross-process ``psum`` and check
+both sides observe the global sum. Everything else in the distributed
+stack is exercised on the in-process 8-device mesh; this is the one path
+that needs actual separate processes.
+"""
+
+import os
+import subprocess
+import socket
+import sys
+import textwrap
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WORKER = textwrap.dedent(
+    """
+    import os, sys
+
+    sys.path.insert(0, sys.argv[3])
+
+    pid, port = int(sys.argv[1]), sys.argv[2]
+
+    # The container sitecustomize may pre-create a client at interpreter
+    # startup; the process group must form BEFORE any backend exists, so
+    # tear down whatever got built (the same hazard tests/conftest.py
+    # handles with force_platform).
+    from jax._src import xla_bridge
+
+    if getattr(xla_bridge, "_backends", None):
+        xla_bridge._clear_backends()
+
+    from mmlspark_tpu.parallel.mesh import distributed_init
+
+    # executor-keyed convention: process ids derive from the sorted
+    # executor list, exactly how a driver would number its workers.
+    topo = distributed_init(
+        coordinator_address=f"127.0.0.1:{port}",
+        executor_ids=["exec-b", "exec-a"],
+        local_executor_id=["exec-a", "exec-b"][pid],
+    )
+    import jax
+    import jax.numpy as jnp
+
+    assert jax.process_count() == 2, jax.process_count()
+    assert jax.process_index() == pid, (jax.process_index(), pid)
+    assert topo.num_devices == 2, topo.num_devices
+
+    # one real cross-process collective: psum of (pid + 1) over both
+    # processes' devices must be 3 on BOTH sides
+    local = jnp.full((jax.local_device_count(), 1), float(pid + 1))
+    total = jax.pmap(lambda v: jax.lax.psum(v, "i"), axis_name="i")(local)
+    assert float(total[0, 0]) == 3.0, total
+    print(f"OK {pid}", flush=True)
+    """
+)
+
+
+def _run_pair(script, port, env):
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(script), str(pid), str(port), REPO],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+            env=env,
+        )
+        for pid in (0, 1)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=300)
+            outs.append(out)
+    finally:
+        for p in procs:
+            p.kill()
+    return procs, outs
+
+
+def test_two_process_psum(tmp_path):
+    script = tmp_path / "worker.py"
+    script.write_text(WORKER)
+
+    # Scrub the child env: no XLA_FLAGS (one CPU device per process) and —
+    # critically — no PALLAS_AXON*/AXON* vars, or the container
+    # sitecustomize dials the TPU relay from BOTH children at interpreter
+    # start (one TPU client at a time; a second wedges the relay) and
+    # pre-creates a backend before the process group can form.
+    env = {
+        k: v
+        for k, v in os.environ.items()
+        if k != "XLA_FLAGS" and not k.startswith(("PALLAS_AXON", "AXON", "TPU_"))
+    }
+    env["JAX_PLATFORMS"] = "cpu"
+
+    # The ephemeral port is probed then released before the coordinator
+    # child rebinds it — a TOCTOU window another process can steal. Retry
+    # on a fresh port rather than flaking.
+    for attempt in range(3):
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        procs, outs = _run_pair(script, port, env)
+        if all(p.returncode == 0 for p in procs):
+            break
+        bind_lost = any(
+            "Failed to bind" in out or "address already in use" in out.lower()
+            for out in outs
+        )
+        if not (bind_lost and attempt < 2):
+            break
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {pid} failed:\n{out}"
+        assert f"OK {pid}" in out, out

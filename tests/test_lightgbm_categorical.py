@@ -145,6 +145,63 @@ class TestCatTraining:
         sizes = b.cat_masks[b.cat_nodes].sum(axis=-1)
         assert sizes.size and sizes.max() <= 3
 
+    def test_one_vs_rest_singleton_left_sets(self):
+        """Native max_cat_to_onehot semantics: cardinality <= the bound
+        switches to one-vs-rest search, so every categorical left set is a
+        SINGLE category; lowering the bound restores sorted-set splits with
+        multi-category sets. Pins the OVR-vs-sorted divergence."""
+        X, y = _cat_data(n=4000, n_cat=4, seed=11)
+        bins, mp = bin_dataset(X, max_bin=31, categorical_features=[0])
+        base = dict(objective="binary", num_iterations=8, num_leaves=15,
+                    max_bin=31, min_data_per_group=1)
+        r_ovr = train(
+            bins, y, TrainOptions(**base, max_cat_to_onehot=4), mapper=mp
+        )
+        b = r_ovr.booster
+        sizes = b.cat_masks[b.cat_nodes].sum(axis=-1)
+        assert sizes.size and sizes.max() == 1  # one-vs-rest: singletons only
+
+        r_sorted = train(
+            bins, y, TrainOptions(**base, max_cat_to_onehot=1), mapper=mp
+        )
+        bs = r_sorted.booster
+        sizes_s = bs.cat_masks[bs.cat_nodes].sum(axis=-1)
+        assert sizes_s.size and sizes_s.max() > 1  # sorted prefixes group cats
+        # the two algorithms genuinely diverge on the same data
+        assert not np.allclose(
+            b.raw_margin(X[:200]), bs.raw_margin(X[:200])
+        )
+
+    def test_min_data_per_group_gates_sorted_candidates(self):
+        """A category below min_data_per_group cannot enter a sorted-set
+        left split (native gate); shrinking the gate re-admits it."""
+        rng = np.random.default_rng(13)
+        n = 2000
+        # category 7 is rare (~40 rows) but perfectly predictive
+        cat = rng.integers(0, 7, size=n).astype(np.float64)
+        rare = rng.random(n) < 0.02
+        cat[rare] = 7.0
+        y = ((cat == 7.0) | (rng.random(n) < 0.2)).astype(np.float64)
+        X = np.column_stack([cat, rng.normal(size=(n, 2))])
+        bins, mp = bin_dataset(X, max_bin=31, categorical_features=[0])
+        base = dict(objective="binary", num_iterations=4, num_leaves=7,
+                    max_bin=31, max_cat_to_onehot=1, min_data_in_leaf=5)
+
+        def rare_bin_used_left(booster):
+            # cat_values is frequency-ordered; value v sits at bin index+1
+            rare_bin = mp.cat_values[0].tolist().index(7.0) + 1
+            used = booster.cat_masks[booster.cat_nodes]
+            return used.size and bool(used[:, rare_bin].any())
+
+        r_gated = train(
+            bins, y, TrainOptions(**base, min_data_per_group=100), mapper=mp
+        )
+        r_open = train(
+            bins, y, TrainOptions(**base, min_data_per_group=1), mapper=mp
+        )
+        assert not rare_bin_used_left(r_gated.booster)
+        assert rare_bin_used_left(r_open.booster)
+
     def test_valid_set_and_early_stopping_route_cats(self):
         X, y = _cat_data(n=3000, seed=5)
         bins, mp = bin_dataset(X, max_bin=31, categorical_features=[0])

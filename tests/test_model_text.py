@@ -206,11 +206,113 @@ shrinkage=1
         # zero_as_missing imports too — see
         # test_zero_as_missing_import_and_round_trip)
         (("decision_type=10 8", "decision_type=10 9"), "cat_boundaries"),
-        (("is_linear=0", "is_linear=1"), "linear"),
+        # is_linear=1 without its leaf_const array is malformed
+        (("is_linear=0", "is_linear=1"), "leaf_const"),
     ])
     def test_unsupported_features_raise(self, mutation, err):
         with pytest.raises(ValueError, match=err):
             from_lightgbm_text(self.MODEL.replace(*mutation))
+
+
+class TestLinearTrees:
+    """linear_tree=true models (per-leaf linear outputs): import, f64
+    evaluation with the native NaN fallback, round-trips, SHAP contract."""
+
+    # Same routing as TestImportedSemantics.MODEL; leaf0 = 1 + 0.5*f0,
+    # leaf1 = 2 + 1*f0 - 1*f1, leaf2 = 3 (empty model).
+    LINEAR_FIELDS = (
+        "is_linear=1\n"
+        "leaf_const=1 2 3\n"
+        "num_features=1 2 0\n"
+        "leaf_features=0 0 1\n"
+        "leaf_coeff=0.5 1 -1"
+    )
+
+    def _model(self):
+        return TestImportedSemantics.MODEL.replace("is_linear=0", self.LINEAR_FIELDS)
+
+    def test_linear_leaf_outputs(self):
+        b = from_lightgbm_text(self._model())
+        assert b.has_linear
+        X = np.array([
+            [0.0, -2.0],    # leaf0: 1 + 0.5*0
+            [4.0, -2.0],    # f0=4 routes RIGHT at root -> leaf2
+            [0.25, 4.0],    # leaf1: 2 + 0.25 - 4
+            [1.0, 0.0],     # leaf2: const 3, no features
+        ])
+        np.testing.assert_allclose(
+            b.raw_margin(X)[:, 0], [1.0, 3.0, -1.75, 3.0], atol=1e-12
+        )
+
+    def test_nan_in_leaf_model_falls_back_to_plain_output(self):
+        b = from_lightgbm_text(self._model())
+        # NaN at root routes per default_left (LEFT), then f1 > -1 -> leaf1;
+        # leaf1's model uses f0 = NaN -> plain leaf_value 20, NOT the
+        # linear expression.
+        X = np.array([[np.nan, 0.0], [0.25, np.nan]])
+        # second row: leaf1 via routing (f1 NaN routes right at inner node
+        # -> leaf1); model uses f1 = NaN -> fallback 20
+        np.testing.assert_allclose(b.raw_margin(X)[:, 0], [20.0, 20.0])
+
+    def test_model_text_round_trip(self):
+        b = from_lightgbm_text(self._model())
+        s = b.model_to_string()
+        assert "is_linear=1" in s
+        b2 = from_lightgbm_text(s)
+        X = np.array([[0.0, -2.0], [0.25, 4.0], [1.0, 0.0], [np.nan, 0.0]])
+        np.testing.assert_allclose(b2.raw_margin(X), b.raw_margin(X), atol=1e-12)
+
+    def test_json_round_trip(self):
+        from mmlspark_tpu.lightgbm.booster import Booster
+
+        b = from_lightgbm_text(self._model())
+        b2 = Booster.from_string(b.to_json_string())
+        assert b2.has_linear
+        X = np.array([[0.25, 4.0], [np.nan, 0.0]])
+        np.testing.assert_allclose(b2.raw_margin(X), b.raw_margin(X), atol=1e-12)
+
+    def test_single_leaf_linear_tree(self):
+        model = TestImportedSemantics.MODEL
+        block = (
+            "Tree=0\nnum_leaves=1\nnum_cat=0\nleaf_value=7.5\n"
+            "is_linear=1\nleaf_const=5\nnum_features=0\n"
+            "leaf_features=\nleaf_coeff=\nshrinkage=1\n"
+        )
+        start = model.index("Tree=0")
+        end = model.index("end of trees")
+        model = model[:start] + block + "\n\n" + model[end:]
+        b = from_lightgbm_text(model)
+        # empty model: output is the CONST (5), not the plain value (7.5)
+        np.testing.assert_allclose(b.raw_margin(np.zeros((2, 2)))[:, 0], [5.0, 5.0])
+
+    def test_shap_raises_with_clear_message(self):
+        b = from_lightgbm_text(self._model())
+        with pytest.raises(NotImplementedError, match="linear-tree"):
+            b.features_shap(np.zeros((2, 2)))
+
+    def test_malformed_linear_block_raises(self):
+        bad = self._model().replace("leaf_coeff=0.5 1 -1", "leaf_coeff=0.5 1")
+        with pytest.raises(ValueError, match="leaf_features/leaf_coeff"):
+            from_lightgbm_text(bad)
+
+    def test_real_lightgbm_linear_round_trip(self):
+        lgb = pytest.importorskip("lightgbm")
+        rng = np.random.default_rng(5)
+        X = rng.normal(size=(1500, 6))
+        y = X[:, 0] * 2.0 + np.sin(X[:, 1]) + 0.1 * rng.normal(size=1500)
+        reg = lgb.LGBMRegressor(
+            n_estimators=8, num_leaves=7, linear_tree=True
+        ).fit(X, y)
+        s = reg.booster_.model_to_string()
+        b = from_lightgbm_text(s)
+        theirs = reg.booster_.predict(X[:300], raw_score=True)
+        ours = b.raw_margin(X[:300])[:, 0]
+        np.testing.assert_allclose(ours, theirs, rtol=1e-6, atol=1e-8)
+        # our re-export loads back into the native runtime
+        b2 = lgb.Booster(model_str=b.model_to_string())
+        np.testing.assert_allclose(
+            b2.predict(X[:300], raw_score=True), theirs, rtol=1e-6, atol=1e-8
+        )
 
 
 class TestAgainstRealLightGBM:
@@ -236,6 +338,54 @@ class TestAgainstRealLightGBM:
         theirs = their_booster.predict(X[:200], raw_score=True)
         ours = b.raw_margin(X[:200])[:, 0]
         np.testing.assert_allclose(theirs, ours, rtol=1e-5, atol=1e-6)
+
+    def test_their_categorical_zero_as_missing_model_scores_here(self):
+        """Native model with BOTH categorical splits and zero_as_missing —
+        the two import semantics the hand fixtures pin, exercised against
+        the real engine in one model."""
+        lgb = pytest.importorskip("lightgbm")
+        rng = np.random.default_rng(7)
+        n = 3000
+        cat = rng.integers(0, 6, size=n).astype(np.float64)
+        num = rng.normal(size=(n, 3))
+        num[rng.random((n, 3)) < 0.3] = 0.0  # zeros => missing
+        eff = np.array([1.5, -2.0, 0.5, 3.0, -1.0, 0.0])
+        y = (eff[cat.astype(int)] + num[:, 0] > 0).astype(int)
+        X = np.column_stack([cat, num])
+        m = lgb.LGBMClassifier(
+            n_estimators=12, num_leaves=15, zero_as_missing=True,
+            use_missing=True,
+        ).fit(X, y, categorical_feature=[0])
+        b = from_lightgbm_text(m.booster_.model_to_string())
+        assert b.has_categorical
+        Xt = X[:400].copy()
+        Xt[::7, 1] = np.nan  # NaN and 0.0 must route identically here
+        theirs = m.booster_.predict(Xt, raw_score=True)
+        ours = b.raw_margin(Xt)[:, 0]
+        np.testing.assert_allclose(ours, theirs, rtol=1e-5, atol=1e-6)
+
+    def test_multiclass_round_trip_both_ways(self):
+        lgb = pytest.importorskip("lightgbm")
+        rng = np.random.default_rng(9)
+        X = rng.normal(size=(2400, 5))
+        y = (X[:, 0] > 0).astype(int) + (X[:, 1] > 0.5).astype(int)  # 3 classes
+        m = lgb.LGBMClassifier(
+            objective="multiclass", num_class=3, n_estimators=8, num_leaves=7
+        ).fit(X, y)
+        b = from_lightgbm_text(m.booster_.model_to_string())
+        assert b.num_classes == 3
+        theirs = m.booster_.predict(X[:200], raw_score=True)
+        np.testing.assert_allclose(
+            b.raw_margin(X[:200]), theirs, rtol=1e-5, atol=1e-6
+        )
+        # and OUR multiclass booster loads into their runtime
+        b2, X2 = _fit("multiclass", num_class=3)
+        their_booster = lgb.Booster(model_str=to_lightgbm_text(b2))
+        np.testing.assert_allclose(
+            their_booster.predict(X2[:200], raw_score=True),
+            b2.raw_margin(X2[:200]),
+            rtol=1e-5, atol=1e-6,
+        )
 
 
 class TestWarmStartFromText:

@@ -1,0 +1,85 @@
+"""Plot helpers: parity with the reference's plot/plot.py surface.
+
+The math (confusion counts, ROC sweep) is checked against sklearn — the
+very library the reference delegates to — and the rendering is smoke-run
+headless on the Agg backend.
+"""
+
+import matplotlib
+
+matplotlib.use("Agg")
+
+import matplotlib.pyplot as plt
+import numpy as np
+import pytest
+
+from mmlspark_tpu import plot
+from mmlspark_tpu.data.table import Table
+
+
+@pytest.fixture(autouse=True)
+def _close_figures():
+    yield
+    plt.close("all")
+
+
+def test_roc_points_matches_sklearn():
+    from sklearn.metrics import roc_curve
+
+    rng = np.random.default_rng(3)
+    y = (rng.random(200) > 0.6).astype(np.int64)
+    scores = np.clip(y * 0.4 + rng.random(200) * 0.6, 0, 1)
+    fpr, tpr, thr = plot.roc_points(y, scores)
+    fpr_sk, tpr_sk, thr_sk = roc_curve(y, scores, drop_intermediate=False)
+    np.testing.assert_allclose(fpr, fpr_sk, atol=1e-12)
+    np.testing.assert_allclose(tpr, tpr_sk, atol=1e-12)
+    np.testing.assert_allclose(thr[1:], thr_sk[1:], atol=1e-12)
+
+
+def test_roc_points_degenerate_single_class():
+    fpr, tpr, _ = plot.roc_points(np.zeros(5), np.linspace(0, 1, 5))
+    assert np.all(tpr == 0.0)
+    assert fpr[-1] == pytest.approx(1.0)
+
+
+def test_confusion_matrix_counts_match_sklearn():
+    from sklearn.metrics import confusion_matrix as sk_cm
+
+    rng = np.random.default_rng(7)
+    y = rng.integers(0, 3, size=120)
+    y_hat = np.where(rng.random(120) < 0.7, y, rng.integers(0, 3, size=120))
+    cm = plot._confusion_counts(np.asarray(y), np.asarray(y_hat), [0, 1, 2])
+    np.testing.assert_array_equal(cm, sk_cm(y, y_hat, labels=[0, 1, 2]))
+
+
+def test_confusion_matrix_renders_from_table():
+    t = Table(
+        {
+            "label": np.array([0.0, 0.0, 1.0, 1.0, 1.0]),
+            "prediction": np.array([0.0, 1.0, 1.0, 1.0, 0.0]),
+        }
+    )
+    ax = plot.confusion_matrix(t, "label", "prediction")
+    # Heatmap image present, accuracy banner present, cell texts present.
+    assert len(ax.images) == 1
+    texts = [txt.get_text() for txt in ax.texts]
+    assert any("Accuracy" in s for s in texts)
+    assert {"1", "2"} <= set(texts)  # counts of the 2x2 cells
+    # camelCase parity alias.
+    assert plot.confusionMatrix is plot.confusion_matrix
+
+
+def test_roc_renders_and_binarizes_labels():
+    t = Table(
+        {
+            "label": np.array([0.1, 0.2, 0.9, 0.8]),  # binarized at thresh=0.5
+            "score": np.array([0.3, 0.1, 0.7, 0.9]),
+        }
+    )
+    ax = plot.roc(t, "label", "score")
+    (line,) = ax.lines
+    xs, ys = line.get_data()
+    assert xs[0] == 0.0 and ys[0] == 0.0
+    assert xs[-1] == 1.0 and ys[-1] == 1.0
+    # Perfect separation here: TPR hits 1.0 while FPR is still 0.
+    assert 1.0 in ys[xs == 0.0]

@@ -172,6 +172,27 @@ class TestPassThroughArgs:
             with pytest.raises(ValueError, match="unsupported VW flag"):
                 VowpalWabbitClassifier(numPasses=1, passThroughArgs=bad).fit(t)
 
+    def test_noop_diagnostic_flags_are_skipped_with_warning(self, caplog):
+        """Benign diagnostic/IO flags (no effect on the model in this
+        runtime) must not fail fits that worked when args passed straight
+        through to native VW."""
+        import logging
+
+        t, _, _ = self._data(100)
+        with caplog.at_level(logging.WARNING, logger="mmlspark_tpu.vw"):
+            m = VowpalWabbitClassifier(
+                numPasses=2,
+                passThroughArgs=(
+                    "--quiet --holdout_off --cache_file /tmp/x.cache "
+                    "--passes 4 -P 1000"
+                ),
+            ).fit(t)
+        assert m is not None
+        skipped = [r.message for r in caplog.records if "ignoring diagnostic" in r.message]
+        assert len(skipped) == 4  # --quiet --holdout_off --cache_file -P
+        # The model-changing flag in the same string still applied.
+        assert m.getTrainingStats()["passes"] == 4
+
     def test_equals_form_and_known_flags(self):
         t, X, y = self._data()
         m = VowpalWabbitClassifier(
