@@ -48,6 +48,31 @@ from mmlspark_tpu.core.pipeline import (
 )
 from mmlspark_tpu.data.table import Table
 
+
+def clear_compiled_caches() -> None:
+    """Release every compiled-program cache the package (and JAX) holds.
+
+    Long-lived processes that fit many differently-shaped models — test
+    harnesses, notebook sessions, serving workers cycling models —
+    accumulate compiled XLA executables: the boosting-step cache
+    (``lightgbm.train._PROGRAM_CACHE``), module-level jitted predict
+    kernels, and JAX's own pjit caches. XLA:CPU tolerates only so much of
+    this in one process (an upstream compiler crash reproduces after
+    several hundred accumulated compilations — see
+    ``tests/conftest.py``); calling this between workloads bounds the
+    footprint. Safe at any point: every cache refills on demand.
+    """
+    import gc
+
+    import jax
+
+    from mmlspark_tpu.lightgbm import train as _train
+
+    _train._PROGRAM_CACHE.clear()
+    jax.clear_caches()
+    gc.collect()
+
+
 __all__ = [
     "Param",
     "Params",
@@ -59,5 +84,6 @@ __all__ = [
     "PipelineModel",
     "Evaluator",
     "Table",
+    "clear_compiled_caches",
     "__version__",
 ]

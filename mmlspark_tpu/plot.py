@@ -32,18 +32,26 @@ def _columns(df: Any, y_col: str, y_hat_col: str):
 def _confusion_counts(y: np.ndarray, y_hat: np.ndarray, labels: Sequence[Any]):
     """Count matrix with rows = true label, cols = predicted label. Rows
     whose true OR predicted value is outside ``labels`` are dropped, the
-    sklearn ``confusion_matrix(..., labels=...)`` behavior."""
-    index = {lab: i for i, lab in enumerate(labels)}
-    k = len(labels)
+    sklearn ``confusion_matrix(..., labels=...)`` behavior. Vectorized via
+    sorted-label searchsorted (the np.add.at pattern of
+    ``train/statistics.py``), so million-row tables stay out of the
+    interpreter loop."""
+    labels_arr = np.asarray(labels)
+    k = len(labels_arr)
+    order = np.argsort(labels_arr, kind="stable")
+    slabels = labels_arr[order]
+
+    def to_index(vals):
+        pos = np.searchsorted(slabels, vals)
+        pos = np.clip(pos, 0, k - 1)
+        ok = slabels[pos] == vals
+        return order[pos], ok
+
+    yi, ok_y = to_index(y)
+    pi, ok_p = to_index(y_hat)
+    keep = ok_y & ok_p
     cm = np.zeros((k, k), dtype=np.int64)
-    pairs = [
-        (index[t], index[p])
-        for t, p in zip(y.tolist(), y_hat.tolist())
-        if t in index and p in index
-    ]
-    if pairs:
-        yi, pi = np.array(pairs).T
-        np.add.at(cm, (yi, pi), 1)
+    np.add.at(cm, (yi[keep], pi[keep]), 1)
     return cm
 
 

@@ -3,8 +3,12 @@ to this runtime (``benchmarks_VerifyLightGBMClassifier.csv`` is 31
 dataset x boosting rows; ``benchmarks_VerifyTrainClassifier.csv`` is a
 111-row learner matrix). Every row here is a pinned metric asserted in CI:
 classifier x 4 datasets x 4 boosting types, regressor x 4 datasets x 4
-boosting types, multiclass, categorical, VW per-loss (adagrad AND ftrl),
+boosting types, the TrainClassifier/TrainRegressor CROSS-LEARNER matrices
+(7 classification + 6 regression learner families through the wrapper +
+ComputeModelStatistics flow — 80 rows, the VerifyTrainClassifier
+analogue), multiclass, categorical, VW per-loss (adagrad AND ftrl),
 ragged-group LTR ndcg at several cutoffs, and the train/tune wrappers.
+151 pinned rows total across the golden_matrix_* CSVs.
 
 Promote intended changes by copying the corresponding
 ``golden_matrix_*.csv.new.csv`` over its golden (the harness writes them
@@ -177,6 +181,93 @@ def test_golden_matrix_multiclass_and_categorical(class_sets):
     suite.add("unbalanced_isunbalance_recall",
               float(pred[pos].mean()) if pos.any() else 0.0, 0.06)
     suite.verify(_golden("multiclass"))
+
+
+def test_golden_matrix_cross_learner_classifiers(class_sets):
+    """The TrainClassifier x learner matrix — the reference's
+    ``benchmarks_VerifyTrainClassifier.csv`` shape (111 rows of learner x
+    dataset metrics through the SAME wrapper): every classification learner
+    family runs through TrainClassifier + ComputeModelStatistics, with
+    accuracy AND AUC pinned per dataset."""
+    from mmlspark_tpu.lightgbm import LightGBMClassifier
+    from mmlspark_tpu.train import ComputeModelStatistics, TrainClassifier
+    from mmlspark_tpu.vw import VowpalWabbitClassifier
+
+    def lgbm(**kw):
+        return LightGBMClassifier(
+            numIterations=25, numLeaves=15, seed=0, parallelism="serial", **kw
+        )
+
+    LEARNERS = (
+        ("lgbm_gbdt", lambda: lgbm()),
+        ("lgbm_goss", lambda: lgbm(boostingType="goss")),
+        ("lgbm_dart", lambda: lgbm(boostingType="dart", dropRate=0.2)),
+        ("lgbm_rf", lambda: lgbm(
+            boostingType="rf", baggingFraction=0.6, baggingFreq=1)),
+        ("vw_logistic", lambda: VowpalWabbitClassifier(numPasses=8)),
+        ("vw_ftrl", lambda: VowpalWabbitClassifier(
+            numPasses=8, passThroughArgs="--ftrl --ftrl_alpha 0.1")),
+        ("vw_hinge", lambda: VowpalWabbitClassifier(
+            numPasses=8, passThroughArgs="--loss_function hinge")),
+    )
+    suite = BenchmarkSuite("matrix_trainclassifier")
+    for dname, ((Xtr, ytr), (Xte, yte)) in class_sets.items():
+        # one normalization for every learner (VW is scale-sensitive; trees
+        # are invariant to it, so the comparison stays apples-to-apples)
+        mu, sd = Xtr.mean(0), Xtr.std(0) + 1e-9
+        Xtr_n, Xte_n = (Xtr - mu) / sd, (Xte - mu) / sd
+        for lname, make in LEARNERS:
+            m = TrainClassifier(model=make(), labelCol="label").fit(
+                _table(Xtr_n, ytr)
+            )
+            stats = ComputeModelStatistics(labelCol="label").transform(
+                m.transform(_table(Xte_n, yte))
+            )
+            suite.add(f"{dname}_{lname}_acc", float(stats["accuracy"][0]), 0.03)
+            suite.add(f"{dname}_{lname}_auc", float(stats["AUC"][0]), 0.03)
+    suite.verify(_golden("trainclassifier"))
+
+
+def test_golden_matrix_cross_learner_regressors(reg_sets):
+    """TrainRegressor x learner matrix (the regression half of the
+    reference's cross-learner benchmarks): scale-normalized RMSE through
+    TrainRegressor + ComputeModelStatistics per learner family."""
+    from mmlspark_tpu.lightgbm import LightGBMRegressor
+    from mmlspark_tpu.train import ComputeModelStatistics, TrainRegressor
+    from mmlspark_tpu.vw import VowpalWabbitRegressor
+
+    def lgbm(**kw):
+        return LightGBMRegressor(
+            numIterations=35, numLeaves=15, seed=0, parallelism="serial", **kw
+        )
+
+    LEARNERS = (
+        ("lgbm_gbdt", lambda: lgbm()),
+        ("lgbm_goss", lambda: lgbm(boostingType="goss")),
+        ("lgbm_dart", lambda: lgbm(boostingType="dart", dropRate=0.2)),
+        ("lgbm_rf", lambda: lgbm(
+            boostingType="rf", baggingFraction=0.6, baggingFreq=1)),
+        ("vw_squared", lambda: VowpalWabbitRegressor(numPasses=10)),
+        ("vw_ftrl", lambda: VowpalWabbitRegressor(
+            numPasses=10, passThroughArgs="--ftrl --ftrl_alpha 0.1")),
+    )
+    suite = BenchmarkSuite("matrix_trainregressor")
+    for dname, ((Xtr, ytr), (Xte, yte)) in reg_sets.items():
+        mu, sd = Xtr.mean(0), Xtr.std(0) + 1e-9
+        Xtr_n, Xte_n = (Xtr - mu) / sd, (Xte - mu) / sd
+        scale = float(np.std(ytr)) or 1.0
+        for lname, make in LEARNERS:
+            m = TrainRegressor(model=make(), labelCol="label").fit(
+                _table(Xtr_n, ytr)
+            )
+            stats = ComputeModelStatistics(
+                labelCol="label", evaluationMetric="regression"
+            ).transform(m.transform(_table(Xte_n, yte)))
+            suite.add(
+                f"{dname}_{lname}_rmse", float(stats["root_mean_squared_error"][0]) / scale,
+                0.08, higher_is_better=False,
+            )
+    suite.verify(_golden("trainregressor"))
 
 
 def test_golden_matrix_vw(class_sets, reg_sets):
