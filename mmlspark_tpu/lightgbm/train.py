@@ -482,7 +482,8 @@ def _hist_fn(opts: TrainOptions, mesh=None, u_spec=None):
             )
         else:
             h = build_histograms(
-                bins, grad, hess, count, node, num_nodes, num_bins, method=method,
+                bins, grad, hess, count, node, num_nodes, num_bins,
+                method=method, chunk_rows=(mesh is None),
             )
         return h, h[:, 0, :, :].sum(axis=1)  # feature 0 covers all rows
 
@@ -630,6 +631,7 @@ def _build_tree_leafwise(
     histf,
     lr=None,
     u=None,
+    u_spec=None,
 ) -> TreeArrays:
     """Best-first growth, ``leaf_batch`` frontier leaves per histogram pass.
 
@@ -777,6 +779,15 @@ def _build_tree_leafwise(
         node = st["node"]
         new_node = node
         key = jnp.full(n, 2 * k, jnp.int32)
+        in_set = None
+        if has_cat and u is not None and u_spec is not None:
+            # Categorical membership for ALL k leaves as one MXU matmul
+            # against the fit-resident one-hot U (re-streams U once per
+            # pass — ~the histogram pass's own HBM cost); the per-leaf
+            # gather fallback below serves the no-U paths (mesh, CPU).
+            from mmlspark_tpu.ops.u_histogram import membership_matmul
+
+            in_set = membership_matmul(u, u_spec, sf, scm, n)
         for jj in range(k):
             colj = lax.dynamic_slice_in_dim(bins, sf[jj], 1, axis=1)[:, 0]
             in_j = (node == top_l[jj]) & can[jj]
@@ -784,7 +795,11 @@ def _build_tree_leafwise(
             if has_cat:
                 # categorical: LEFT iff the row's bin is in the split set
                 right_j = jnp.where(
-                    sic[jj], ~scm[jj][colj.astype(jnp.int32)], right_j
+                    sic[jj],
+                    ~in_set[jj]
+                    if in_set is not None
+                    else ~scm[jj][colj.astype(jnp.int32)],
+                    right_j,
                 )
             new_node = jnp.where(
                 in_j, jnp.where(right_j, rslot[jj], lslot[jj]), new_node
@@ -984,9 +999,10 @@ def _make_step(
         count = (bag_mask > 0).astype(grad.dtype)
 
         def per_class(g, h):
+            kw = {"u_spec": u_spec} if opts.growth == "leafwise" else {}
             return build(
                 bins, g, h, count, edges, feature_mask,
-                num_bins=num_bins, opts=opts, histf=histf, lr=lr, u=u,
+                num_bins=num_bins, opts=opts, histf=histf, lr=lr, u=u, **kw,
             )
 
         tree = jax.vmap(per_class, in_axes=(1, 1))(grad, hess)  # (C, ...) arrays
@@ -1047,7 +1063,7 @@ def _make_scan_steps(step, per_iter_bag: bool, per_iter_lr: bool = False,
     ``u_builder`` (U histogram path): builds the fit-resident one-hot ONCE
     before the scan; every pass inside then contracts against it."""
 
-    def run(bins, y, w, margins, edges, bag, fm_all, lr_all):
+    def run(bins, y, w, margins, edges, bag, fm_all, lr_all, it0):
         iters = fm_all.shape[0]
         u = u_builder(bins) if u_builder is not None else None
 
@@ -1061,7 +1077,9 @@ def _make_scan_steps(step, per_iter_bag: bool, per_iter_lr: bool = False,
             )
             return m2, tree._replace(row_leaf=jnp.zeros((), jnp.int32))
 
-        idx = jnp.arange(iters, dtype=jnp.int32)
+        # global iteration ids (it0 > 0 on segmented fits): GOSS's per-
+        # iteration rng folds on these, so segments never repeat a stream
+        idx = jnp.arange(iters, dtype=jnp.int32) + it0
         xs = [idx]
         if per_iter_bag:
             xs.append(bag)
@@ -1523,8 +1541,37 @@ def train(
                 u_builder=u_builder,
             ),
         )
-        margins, stacked_trees = runner(
-            bins_dev, y_dev, w_dev, margins, edges_dev, bag_arg, fm_all, lr_arg
+        # Segment the one-dispatch fit when a single device program would
+        # run long enough to trip the remote-attach relay's worker watchdog:
+        # a 4M-row x 100-iteration scan (~90 s on-device) reproducibly kills
+        # the TPU worker, while 4M x 50 and 2M x 100 (~50 s) run fine.
+        # Equal-length segments share one compiled program; margins thread
+        # between dispatches, so results are identical to the single scan.
+        row_iters = n * max(1, opts.num_iterations) * max(1, num_classes)
+        budget = int(_os.environ.get("MMLSPARK_TPU_SCAN_ROW_ITERS", 200_000_000))
+        nseg = max(1, -(-row_iters // budget))
+        # prefer a divisor of the iteration count close to nseg: equal
+        # segment lengths mean ONE compiled shape instead of two
+        for cand in range(nseg, min(nseg + 3, max(1, opts.num_iterations)) + 1):
+            if opts.num_iterations % cand == 0:
+                nseg = cand
+                break
+        seg = -(-opts.num_iterations // nseg)
+        parts = []
+        for s0 in range(0, opts.num_iterations, seg):
+            s1 = min(s0 + seg, opts.num_iterations)
+            margins, part = runner(
+                bins_dev, y_dev, w_dev, margins, edges_dev,
+                bag_arg[s0:s1] if bag_resampling else bag_arg,
+                fm_all[s0:s1],
+                lr_arg[s0:s1] if per_iter_lr else lr_arg,
+                jnp.int32(s0),
+            )
+            parts.append(part)
+        stacked_trees = (
+            parts[0]
+            if len(parts) == 1
+            else jax.tree.map(lambda *xs: jnp.concatenate(xs, axis=0), *parts)
         )
     else:
         dart_rng = np.random.default_rng(opts.seed + 7919)

@@ -46,8 +46,15 @@ def build_histograms(
     num_nodes: int,
     num_bins: int,
     method: Optional[str] = None,
+    chunk_rows: bool = True,
 ) -> jax.Array:
-    """Returns (num_nodes, F, num_bins, 3) float32: per-cell [sum_g, sum_h, count]."""
+    """Returns (num_nodes, F, num_bins, 3) float32: per-cell [sum_g, sum_h, count].
+
+    ``chunk_rows=False`` disables the bounded-transient row chunking of the
+    onehot/panel formulations — required under a mesh, where padding and
+    scan-slicing the ROW-SHARDED dimension would force GSPMD to all-gather
+    the full matrix per pass (each device's shard is 1/devices of N there,
+    so the unchunked transient is already bounded)."""
     method = method or _default_method()
     n, f = bins.shape
     bins = bins.astype(jnp.int32)
@@ -96,28 +103,73 @@ def build_histograms(
         # row-shard it and insert the allreduce): bin-only one-hot against a
         # node-keyed (N, 3k) data panel. Rows with node outside [0, k) get a
         # zero panel row, which callers use as the in-leaf mask.
+        # The one-hot is built in bounded ROW CHUNKS: an (N, B) f32 one-hot
+        # at multi-million rows is gigabytes of transient per scan step and
+        # crashes the TPU worker (this is the >1M fallback path — the
+        # precomputed-U formulation gates off on its own HBM budget there).
         from mmlspark_tpu.ops.pallas_histogram import build_node_panel
 
         k = num_nodes
         panel = build_node_panel(grad, hess, count, node, k)
+        if not chunk_rows:
+            def per_feature_whole(_, feat_col):
+                oh = jax.nn.one_hot(feat_col, num_bins, dtype=panel.dtype)
+                return None, oh.T @ panel  # (B, 3k)
 
-        def per_feature_panel(_, feat_col):
-            oh = jax.nn.one_hot(feat_col, num_bins, dtype=panel.dtype)  # (N, B)
-            return None, oh.T @ panel  # (B, 3k)
+            _, hists = lax.scan(per_feature_whole, None, bins.T)
+            return hists.reshape(f, num_bins, 3, k).transpose(3, 0, 1, 2)
+        chunk = max(1, min(n, (64 << 20) // max(4 * num_bins, 1)))
+        pad = (-n) % chunk
+        bins_p = jnp.pad(bins, ((0, pad), (0, 0))) if pad else bins
+        panel_p = jnp.pad(panel, ((0, pad), (0, 0))) if pad else panel
+        r = (n + pad) // chunk
+        bins_r = bins_p.reshape(r, chunk, f).transpose(2, 0, 1)  # (F, R, chunk)
+        panel_r = panel_p.reshape(r, chunk, 3 * k)
 
-        _, hists = lax.scan(per_feature_panel, None, bins.T)  # (F, B, 3k)
+        def per_feature_panel(_, feat_rows):  # (R, chunk)
+            def per_chunk(acc, rc):
+                fc, pl = rc  # padded rows carry zero panel rows => no-op
+                oh = jax.nn.one_hot(fc, num_bins, dtype=panel.dtype)
+                return acc + oh.T @ pl, None
+
+            h0 = jnp.zeros((num_bins, 3 * k), panel.dtype)
+            h, _ = lax.scan(per_chunk, h0, (feat_rows, panel_r))
+            return None, h
+
+        _, hists = lax.scan(per_feature_panel, None, bins_r)  # (F, B, 3k)
         return hists.reshape(f, num_bins, 3, k).transpose(3, 0, 1, 2)
 
     if method == "onehot":
         k = num_nodes * num_bins
         base = node * num_bins  # (N,)
+        if not chunk_rows:
+            def per_feature_whole(_, feat_col):
+                oh = jax.nn.one_hot(base + feat_col, k, dtype=jnp.float32)
+                return None, oh.T @ data  # (K, 3) — MXU matmul
 
-        def per_feature(_, feat_col):
-            # feat_col: (N,) bins of one feature
-            oh = jax.nn.one_hot(base + feat_col, k, dtype=jnp.float32)  # (N, K)
-            return None, oh.T @ data  # (K, 3) — MXU matmul
+            _, hists = lax.scan(per_feature_whole, None, bins.T)
+            return hists.reshape(f, num_nodes, num_bins, 3).transpose(1, 0, 2, 3)
+        chunk = max(1, min(n, (64 << 20) // max(4 * k, 1)))
+        pad = (-n) % chunk
+        bins_p = jnp.pad(bins, ((0, pad), (0, 0))) if pad else bins
+        base_p = jnp.pad(base, (0, pad)) if pad else base
+        data_p = jnp.pad(data, ((0, pad), (0, 0))) if pad else data
+        r = (n + pad) // chunk
+        bins_r = bins_p.reshape(r, chunk, f).transpose(2, 0, 1)  # (F, R, chunk)
+        base_r = base_p.reshape(r, chunk)
+        data_r = data_p.reshape(r, chunk, 3)
 
-        _, hists = lax.scan(per_feature, None, bins.T)  # (F, K, 3)
+        def per_feature(_, feat_rows):  # (R, chunk)
+            def per_chunk(acc, rc):
+                fc, bc, dc = rc  # padded rows carry zero data rows => no-op
+                oh = jax.nn.one_hot(bc + fc, k, dtype=jnp.float32)
+                return acc + oh.T @ dc, None
+
+            h0 = jnp.zeros((k, 3), jnp.float32)
+            h, _ = lax.scan(per_chunk, h0, (feat_rows, base_r, data_r))
+            return None, h
+
+        _, hists = lax.scan(per_feature, None, bins_r)  # (F, K, 3)
         return hists.reshape(f, num_nodes, num_bins, 3).transpose(1, 0, 2, 3)
 
     raise ValueError(f"unknown histogram method {method!r}")

@@ -401,3 +401,72 @@ class TestLeafBatchRatio:
             mapper=mapper,
         )
         assert r.booster.num_trees == 2
+
+
+class TestScanSegmentation:
+    """The one-dispatch scanned fit splits into equal segments when a single
+    device program would run past the remote-attach watchdog
+    (MMLSPARK_TPU_SCAN_ROW_ITERS); margins thread between dispatches, so
+    results must be BIT-identical to the unsegmented scan — including GOSS,
+    whose per-iteration rng folds on the GLOBAL iteration id."""
+
+    @pytest.mark.parametrize("boosting", ["gbdt", "goss"])
+    def test_segmented_scan_is_bit_identical(self, boosting, monkeypatch):
+        X, y = _make_binary(n=3000, f=8, seed=17)
+        bins, mapper = bin_dataset(X, max_bin=31)
+        opts = TrainOptions(
+            objective="binary", num_iterations=9, num_leaves=15, max_bin=31,
+            boosting_type=boosting,
+        )
+        single = train(bins, y, opts, mapper=mapper)
+        monkeypatch.setenv("MMLSPARK_TPU_SCAN_ROW_ITERS", "9000")  # 3 segments
+        segmented = train(bins, y, opts, mapper=mapper)
+        np.testing.assert_array_equal(
+            np.asarray(segmented.booster.leaf_values),
+            np.asarray(single.booster.leaf_values),
+        )
+        np.testing.assert_array_equal(
+            np.asarray(segmented.booster.split_feature),
+            np.asarray(single.booster.split_feature),
+        )
+
+
+class TestCategoricalURouting:
+    """Row routing through categorical splits has two formulations: the
+    matmul against the fit-resident one-hot U (TPU hot path) and the
+    per-leaf mask gather (no-U fallback, what the mesh/CPU paths use).
+    This pins the membership MATH of the matmul formulation — exactly the
+    expression the leafwise builder traces — against the direct gather.
+    (Comparing whole fits would conflate routing with the histogram
+    pass's different fp summation order.)"""
+
+    def test_membership_matmul_matches_gather(self):
+        import jax.numpy as jnp
+
+        from mmlspark_tpu.ops.u_histogram import (
+            build_u, make_u_spec, membership_matmul,
+        )
+
+        rng = np.random.default_rng(23)
+        n, k, b = 1000, 8, 16
+        widths = [5, 16, 9, 3]  # ragged per-feature bin counts
+        f = len(widths)
+        bins_np = np.column_stack(
+            [rng.integers(0, w, size=n) for w in widths]
+        ).astype(np.int32)
+        spec = make_u_spec(b, f, widths)
+        u = build_u(jnp.asarray(bins_np), spec)
+
+        sf = jnp.asarray(rng.integers(0, f, size=k), jnp.int32)
+        scm = jnp.asarray(rng.random((k, b)) < 0.4)
+
+        # the SAME helper the leafwise builder traces
+        in_set = np.asarray(membership_matmul(u, spec, sf, scm, n))
+
+        # the gather fallback, row by row
+        scm_np = np.asarray(scm)
+        sf_np = np.asarray(sf)
+        expected = np.stack(
+            [scm_np[jj][bins_np[:, sf_np[jj]]] for jj in range(k)]
+        )
+        np.testing.assert_array_equal(in_set, expected)
