@@ -174,26 +174,30 @@ class Booster:
             self._cat_binned(X) if has_cat else X, dtype=np.float32
         )
         if has_cat:
-            iscat, catm = _cat_paths_cache(self, t)
+            iscat, cfeats, cm = _cat_paths_cache(self, t)
         chunk = _predict_chunk_rows(*pc.feats.shape)
         outs = []
+        # device-resident constants built ONCE — a jnp.asarray per chunk
+        # would re-upload every tree table each iteration (transfers are
+        # the fixed cost on remote-attached chips)
+        cargs = (
+            jnp.asarray(pc.feats), jnp.asarray(pc.thrs),
+            jnp.asarray(pc.nanl), jnp.asarray(pc.zm),
+            jnp.asarray(pc.P), jnp.asarray(pc.plen),
+        )
+        lvals_d = jnp.asarray(pc.lvals)
+        isc_d = jnp.asarray(self.init_score)
+        if has_cat:
+            catargs = (jnp.asarray(iscat), jnp.asarray(cfeats), jnp.asarray(cm))
         for lo in range(0, max(len(X32), 1), chunk):
             xd = jnp.asarray(X32[lo : lo + chunk])
-            cargs = (
-                jnp.asarray(pc.feats), jnp.asarray(pc.thrs),
-                jnp.asarray(pc.nanl), jnp.asarray(pc.zm),
-                jnp.asarray(pc.P), jnp.asarray(pc.plen),
-            )
             if has_cat:
                 m = _predict_margin_paths_cat_jit(
-                    xd, *cargs, jnp.asarray(iscat), jnp.asarray(catm),
-                    jnp.asarray(pc.lvals), jnp.asarray(self.init_score),
-                    self.num_classes,
+                    xd, *cargs, *catargs, lvals_d, isc_d, self.num_classes,
                 )
             else:
                 m = _predict_margin_paths_jit(
-                    xd, *cargs, jnp.asarray(pc.lvals),
-                    jnp.asarray(self.init_score), self.num_classes,
+                    xd, *cargs, lvals_d, isc_d, self.num_classes,
                 )
             outs.append(np.asarray(m))
         return np.concatenate(outs, axis=0) if outs else np.zeros((0, self.num_classes), np.float32)
@@ -253,23 +257,25 @@ class Booster:
             self._cat_binned(X) if has_cat else X, dtype=np.float32
         )
         if has_cat:
-            iscat, catm = _cat_paths_cache(self, t)
+            iscat, cfeats, cm = _cat_paths_cache(self, t)
         chunk = _predict_chunk_rows(*pc.feats.shape)
         outs = []
+        cargs = (
+            jnp.asarray(pc.feats), jnp.asarray(pc.thrs),
+            jnp.asarray(pc.nanl), jnp.asarray(pc.zm),
+            jnp.asarray(pc.P), jnp.asarray(pc.plen),
+        )
+        lslots_d = jnp.asarray(pc.lslots)
+        if has_cat:
+            catargs = (jnp.asarray(iscat), jnp.asarray(cfeats), jnp.asarray(cm))
         for lo in range(0, max(len(X32), 1), chunk):
             xd = jnp.asarray(X32[lo : lo + chunk])
-            cargs = (
-                jnp.asarray(pc.feats), jnp.asarray(pc.thrs),
-                jnp.asarray(pc.nanl), jnp.asarray(pc.zm),
-                jnp.asarray(pc.P), jnp.asarray(pc.plen),
-            )
             if has_cat:
                 leaves = _predict_leaf_paths_cat_jit(
-                    xd, *cargs, jnp.asarray(iscat), jnp.asarray(catm),
-                    jnp.asarray(pc.lslots),
+                    xd, *cargs, *catargs, lslots_d,
                 )
             else:
-                leaves = _predict_leaf_paths_jit(xd, *cargs, jnp.asarray(pc.lslots))
+                leaves = _predict_leaf_paths_jit(xd, *cargs, lslots_d)
             outs.append(np.asarray(leaves))
         return np.concatenate(outs, axis=0) if outs else np.zeros((0, t), np.int32)
 
@@ -576,20 +582,34 @@ def _predict_leaf_paths_jit(X, feats, thrs, nanl, zm, P, plen, lslots):
     ).astype(jnp.int32)
 
 
-def _path_match_cat(X, feats, thrs, nanl, zm, P, plen, iscat, catm):
+def _path_match_cat(X, feats, thrs, nanl, zm, P, plen, iscat, cfeats, cm):
     """(N, T, L) leaf membership with categorical decisions: categorical
     columns of ``X`` hold value-bin ids (``Booster._cat_binned``); at cat
-    nodes d = mask[bin] (bin 0 = unseen/NaN => right)."""
+    nodes d = mask[bin] (bin 0 = unseen/NaN => right).
+
+    Categorical decisions for EVERY node come from one MXU matmul: stacked
+    per-feature bin one-hots (Fc*Bc, N) against the per-node mask matrix
+    ``cm`` (T*I, Fc*Bc) built by ``_cat_paths``. Gather formulations of
+    this lookup (3-axis batched or flattened) measured 300-450x slower
+    than the numeric compare path on TPU (r5)."""
     x = jnp.take(X, feats.reshape(-1), axis=1)
     n = X.shape[0]
     t, i = feats.shape
     x = x.reshape(n, t, i)
     miss = jnp.isnan(x) | (zm[None] & (jnp.abs(x) <= K_ZERO_THRESHOLD))
     d_num = jnp.where(miss, nanl[None], x <= thrs[None])
-    xb = jnp.clip(x, 0, catm.shape[-1] - 1).astype(jnp.int32)
-    d_cat = catm[
-        jnp.arange(t)[None, :, None], jnp.arange(i)[None, None, :], xb
-    ]  # (N, T, I)
+    fc = cfeats.shape[0]
+    bc = cm.shape[1] // max(fc, 1)
+    xc = jnp.take(X, cfeats, axis=1)  # (N, Fc) value-bin ids
+    xct = jnp.clip(xc, 0, bc - 1).astype(jnp.int32).T  # (Fc, N)
+    oh = (
+        jnp.arange(bc, dtype=jnp.int32)[None, :, None] == xct[:, None, :]
+    ).reshape(fc * bc, n)  # stacked per-feature one-hots
+    D_cat = lax.dot_general(
+        cm.astype(jnp.bfloat16), oh.astype(jnp.bfloat16),
+        (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32,
+    )  # (T*I, N); exact: both operands 0/1
+    d_cat = (D_cat > 0).T.reshape(n, t, i)
     d = jnp.where(iscat[None], d_cat, d_num)
     D = 2.0 * d.astype(jnp.float32) - 1.0
     score = jnp.einsum(
@@ -601,9 +621,9 @@ def _path_match_cat(X, feats, thrs, nanl, zm, P, plen, iscat, catm):
 
 @partial(jax.jit, static_argnames=("num_classes",))
 def _predict_margin_paths_cat_jit(
-    X, feats, thrs, nanl, zm, P, plen, iscat, catm, lvals, init_score, num_classes
+    X, feats, thrs, nanl, zm, P, plen, iscat, cfeats, cm, lvals, init_score, num_classes
 ):
-    match = _path_match_cat(X, feats, thrs, nanl, zm, P, plen, iscat, catm)
+    match = _path_match_cat(X, feats, thrs, nanl, zm, P, plen, iscat, cfeats, cm)
     contrib = jnp.einsum(
         "ntl,tl->nt", match.astype(jnp.float32), lvals,
         preferred_element_type=jnp.float32, precision=lax.Precision.HIGHEST,
@@ -615,8 +635,8 @@ def _predict_margin_paths_cat_jit(
 
 
 @jax.jit
-def _predict_leaf_paths_cat_jit(X, feats, thrs, nanl, zm, P, plen, iscat, catm, lslots):
-    match = _path_match_cat(X, feats, thrs, nanl, zm, P, plen, iscat, catm)
+def _predict_leaf_paths_cat_jit(X, feats, thrs, nanl, zm, P, plen, iscat, cfeats, cm, lslots):
+    match = _path_match_cat(X, feats, thrs, nanl, zm, P, plen, iscat, cfeats, cm)
     return jnp.einsum(
         "ntl,tl->nt", match.astype(jnp.float32), lslots.astype(jnp.float32),
         precision=lax.Precision.HIGHEST,
@@ -633,9 +653,16 @@ def _paths_cache(b: "Booster", t: int):
 
 
 def _cat_paths(b: "Booster", t: int):
-    """(ISCAT (T, I), CATM (T, I, Bc)) aligned by construction with
-    _leaf_paths' padded constants (it shares the internal-slot ordering
-    _leaf_paths returns — no second derivation to drift)."""
+    """(ISCAT (T, I), CFEATS (Fc,), CM (T*I, Fc*Bc)) aligned by construction
+    with _leaf_paths' padded constants (it shares the internal-slot ordering
+    _leaf_paths returns — no second derivation to drift).
+
+    CM is the matmul form of the per-node left-set masks: row ti*I+ii of a
+    categorical node carries its (Bc,) mask at the column block of its
+    feature, so the whole batch's categorical decisions are ONE
+    (T*I, Fc*Bc) x (Fc*Bc, N) contraction against stacked per-feature
+    one-hots — the 3-axis batched gather this replaces ran ~450x slower
+    than the numeric compare path (39k rows/s, r5)."""
     consts = _paths_cache(b, t)
     max_i = consts.feats.shape[1]
     internals = consts.internals
@@ -646,7 +673,14 @@ def _cat_paths(b: "Booster", t: int):
         internal = internals[ti]
         iscat[ti, : len(internal)] = b.cat_nodes[ti][internal]
         catm[ti, : len(internal)] = b.cat_masks[ti][internal]
-    return iscat, catm
+    cfeats = np.asarray(sorted(b.cat_values or {}), np.int32)
+    cpos = {int(f_): j for j, f_ in enumerate(cfeats)}
+    cm = np.zeros((t * max_i, len(cfeats) * bc), np.uint8)
+    for ti in range(t):
+        for ii in np.nonzero(iscat[ti])[0]:
+            j = cpos[int(consts.feats[ti, ii])]
+            cm[ti * max_i + ii, j * bc : (j + 1) * bc] = catm[ti, ii]
+    return iscat, cfeats, cm
 
 
 def _cat_paths_cache(b: "Booster", t: int):

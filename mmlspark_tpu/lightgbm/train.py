@@ -1047,7 +1047,7 @@ def _opts_key(opts: "TrainOptions"):
 
 
 def _make_scan_steps(step, per_iter_bag: bool, per_iter_lr: bool = False,
-                     u_builder=None):
+                     with_u: bool = False):
     """All boosting iterations in ONE device program: ``lax.scan`` over the
     per-tree step, per-iteration bagging/feature masks as scanned inputs,
     stacked tree arrays as the scan output. One dispatch and one bulk fetch
@@ -1060,12 +1060,14 @@ def _make_scan_steps(step, per_iter_bag: bool, per_iter_lr: bool = False,
     schedule (``per_iter_lr``) rides as one more scanned (iterations,)
     input — schedule callbacks keep the one-dispatch fast path.
 
-    ``u_builder`` (U histogram path): builds the fit-resident one-hot ONCE
-    before the scan; every pass inside then contracts against it."""
+    ``with_u`` (U histogram path): the caller builds the fit-resident
+    one-hot ONCE per fit and passes it in — building it inside this program
+    would redo the multi-GB materialization once per SEGMENT when the fit
+    is split for the dispatch watchdog."""
 
-    def run(bins, y, w, margins, edges, bag, fm_all, lr_all, it0):
+    def run(bins, y, w, margins, edges, bag, fm_all, lr_all, it0, u_arg):
         iters = fm_all.shape[0]
-        u = u_builder(bins) if u_builder is not None else None
+        u = u_arg if with_u else None
 
         def body(m, per_iter):
             it, fmv = per_iter[0], per_iter[-1 if not per_iter_lr else -2]
@@ -1538,9 +1540,16 @@ def train(
             ("scan", okey, bag_resampling, per_iter_lr),
             lambda: _make_scan_steps(
                 step_raw, per_iter_bag=bag_resampling, per_iter_lr=per_iter_lr,
-                u_builder=u_builder,
+                with_u=u_builder is not None,
             ),
         )
+        # fit-resident U: built ONCE here, shared by every segment below
+        u_dev_scan = jnp.int32(0)  # unused placeholder when no U path
+        if u_builder is not None:
+            u_jit = _cached_program(
+                ("u_build_jit", u_spec), lambda: jax.jit(u_builder)
+            )
+            u_dev_scan = u_jit(bins_dev)
         # Segment the one-dispatch fit when a single device program would
         # run long enough to trip the remote-attach relay's worker watchdog:
         # a 4M-row x 100-iteration scan (~90 s on-device) reproducibly kills
@@ -1566,6 +1575,7 @@ def train(
                 fm_all[s0:s1],
                 lr_arg[s0:s1] if per_iter_lr else lr_arg,
                 jnp.int32(s0),
+                u_dev_scan,
             )
             parts.append(part)
         stacked_trees = (

@@ -72,7 +72,11 @@ def build_histograms_voting(
             # partitioning rule — keep the shardable XLA formulations.
             m = "onehot" if jax.default_backend() in ("tpu", "axon") else "segment"
         hist = build_histograms(
-            bins, grad, hess, count, node, num_nodes, num_bins, method=m
+            bins, grad, hess, count, node, num_nodes, num_bins, method=m,
+            # row chunking must stay off when the N axis is GSPMD-sharded
+            # (see build_histograms); the shard_map branch below chunks its
+            # LOCAL shards safely
+            chunk_rows=not meshed,
         )
         return hist, hist[:, 0, :, :].sum(axis=1)
 
