@@ -48,11 +48,34 @@ from mmlspark_tpu.lightgbm.binning import BinMapper
 #: LightGBM's kZeroThreshold: |x| <= this counts as zero (zero_as_missing).
 K_ZERO_THRESHOLD = 1e-35
 
-def _predict_chunk_rows(t: int, i: int, budget_bytes: int = 256 << 20) -> int:
+#: Size gate for the dense (T*I, Fc*Bc) categorical mask matrix: above
+#: this, predict uses the memory-bounded gather kernel instead.
+_CM_BYTES_CAP = 128 << 20
+
+def _predict_chunk_rows(
+    t: int, i: int, budget_bytes: int = 256 << 20, extra_row_bytes: int = 0
+) -> int:
     """Rows per predict dispatch. The budget covers the (N, T, I) decision
-    tensor AND its same-shape temporaries (D, score, match ≈ 4x), so huge
+    tensor AND its same-shape temporaries (D, score, match ≈ 4x), plus any
+    caller-declared per-row transients (``extra_row_bytes`` — the
+    categorical path's stacked one-hot and decision matrices), so huge
     forests shrink the chunk rather than OOM; no floor overrides it."""
-    return max(1, min(131072, budget_bytes // (16 * max(t * i, 1))))
+    per_row = 16 * max(t * i, 1) + max(extra_row_bytes, 0)
+    return max(1, min(131072, budget_bytes // per_row))
+
+
+def _cat_row_bytes(cat) -> int:
+    """Per-row transient bytes of the categorical predict kernels, for the
+    chunk budget: the matmul path materializes a bf16 (Fc*Bc, N) one-hot +
+    an f32 (T*I, N) decision matrix; the gather path an int32 (N, T, I)
+    index tensor."""
+    if cat[0] == "matmul":
+        _, iscat, cfeats, cm = cat
+        t, i = iscat.shape
+        return 2 * cm.shape[1] + 4 * t * i
+    _, iscat, catm = cat
+    t, i = iscat.shape
+    return 4 * t * i
 
 
 @dataclasses.dataclass
@@ -174,8 +197,9 @@ class Booster:
             self._cat_binned(X) if has_cat else X, dtype=np.float32
         )
         if has_cat:
-            iscat, cfeats, cm = _cat_paths_cache(self, t)
-        chunk = _predict_chunk_rows(*pc.feats.shape)
+            cat = _cat_paths_cache(self, t)
+        extra = _cat_row_bytes(cat) if has_cat else 0
+        chunk = _predict_chunk_rows(*pc.feats.shape, extra_row_bytes=extra)
         outs = []
         # device-resident constants built ONCE — a jnp.asarray per chunk
         # would re-upload every tree table each iteration (transfers are
@@ -188,11 +212,16 @@ class Booster:
         lvals_d = jnp.asarray(pc.lvals)
         isc_d = jnp.asarray(self.init_score)
         if has_cat:
-            catargs = (jnp.asarray(iscat), jnp.asarray(cfeats), jnp.asarray(cm))
+            cat_kernel = (
+                _predict_margin_paths_cat_jit
+                if cat[0] == "matmul"
+                else _predict_margin_paths_catgather_jit
+            )
+            catargs = tuple(jnp.asarray(a) for a in cat[1:])
         for lo in range(0, max(len(X32), 1), chunk):
             xd = jnp.asarray(X32[lo : lo + chunk])
             if has_cat:
-                m = _predict_margin_paths_cat_jit(
+                m = cat_kernel(
                     xd, *cargs, *catargs, lvals_d, isc_d, self.num_classes,
                 )
             else:
@@ -257,8 +286,9 @@ class Booster:
             self._cat_binned(X) if has_cat else X, dtype=np.float32
         )
         if has_cat:
-            iscat, cfeats, cm = _cat_paths_cache(self, t)
-        chunk = _predict_chunk_rows(*pc.feats.shape)
+            cat = _cat_paths_cache(self, t)
+        extra = _cat_row_bytes(cat) if has_cat else 0
+        chunk = _predict_chunk_rows(*pc.feats.shape, extra_row_bytes=extra)
         outs = []
         cargs = (
             jnp.asarray(pc.feats), jnp.asarray(pc.thrs),
@@ -267,13 +297,16 @@ class Booster:
         )
         lslots_d = jnp.asarray(pc.lslots)
         if has_cat:
-            catargs = (jnp.asarray(iscat), jnp.asarray(cfeats), jnp.asarray(cm))
+            cat_kernel = (
+                _predict_leaf_paths_cat_jit
+                if cat[0] == "matmul"
+                else _predict_leaf_paths_catgather_jit
+            )
+            catargs = tuple(jnp.asarray(a) for a in cat[1:])
         for lo in range(0, max(len(X32), 1), chunk):
             xd = jnp.asarray(X32[lo : lo + chunk])
             if has_cat:
-                leaves = _predict_leaf_paths_cat_jit(
-                    xd, *cargs, *catargs, lslots_d,
-                )
+                leaves = cat_kernel(xd, *cargs, *catargs, lslots_d)
             else:
                 leaves = _predict_leaf_paths_jit(xd, *cargs, lslots_d)
             outs.append(np.asarray(leaves))
@@ -582,6 +615,59 @@ def _predict_leaf_paths_jit(X, feats, thrs, nanl, zm, P, plen, lslots):
     ).astype(jnp.int32)
 
 
+def _path_match_cat_gather(X, feats, thrs, nanl, zm, P, plen, iscat, catm):
+    """Memory-bounded categorical path match: flat 1-D gather over the
+    (T, I, Bc) mask tables. ~Two orders of magnitude slower than the
+    matmul kernel below (docs/perf_histogram.md round 5) — used only when
+    the dense (T*I, Fc*Bc) mask matrix would exceed its size gate."""
+    x = jnp.take(X, feats.reshape(-1), axis=1)
+    n = X.shape[0]
+    t, i = feats.shape
+    x = x.reshape(n, t, i)
+    miss = jnp.isnan(x) | (zm[None] & (jnp.abs(x) <= K_ZERO_THRESHOLD))
+    d_num = jnp.where(miss, nanl[None], x <= thrs[None])
+    bc = catm.shape[-1]
+    xb = jnp.clip(x, 0, bc - 1).astype(jnp.int32)
+    lin = (
+        jnp.arange(t, dtype=jnp.int32)[None, :, None] * (i * bc)
+        + jnp.arange(i, dtype=jnp.int32)[None, None, :] * bc
+        + xb
+    )
+    d = jnp.where(iscat[None], catm.reshape(-1)[lin], d_num)
+    D = 2.0 * d.astype(jnp.float32) - 1.0
+    score = jnp.einsum(
+        "nti,til->ntl", D, P, preferred_element_type=jnp.float32,
+        precision=lax.Precision.HIGHEST,
+    )
+    return score >= plen[None]
+
+
+@partial(jax.jit, static_argnames=("num_classes",))
+def _predict_margin_paths_catgather_jit(
+    X, feats, thrs, nanl, zm, P, plen, iscat, catm, lvals, init_score, num_classes
+):
+    match = _path_match_cat_gather(X, feats, thrs, nanl, zm, P, plen, iscat, catm)
+    contrib = jnp.einsum(
+        "ntl,tl->nt", match.astype(jnp.float32), lvals,
+        preferred_element_type=jnp.float32, precision=lax.Precision.HIGHEST,
+    )
+    n, t = contrib.shape
+    rounds = t // num_classes
+    margins = contrib.reshape(n, rounds, num_classes).sum(axis=1)
+    return margins + init_score[None, :]
+
+
+@jax.jit
+def _predict_leaf_paths_catgather_jit(
+    X, feats, thrs, nanl, zm, P, plen, iscat, catm, lslots
+):
+    match = _path_match_cat_gather(X, feats, thrs, nanl, zm, P, plen, iscat, catm)
+    return jnp.einsum(
+        "ntl,tl->nt", match.astype(jnp.float32), lslots.astype(jnp.float32),
+        precision=lax.Precision.HIGHEST,
+    ).astype(jnp.int32)
+
+
 def _path_match_cat(X, feats, thrs, nanl, zm, P, plen, iscat, cfeats, cm):
     """(N, T, L) leaf membership with categorical decisions: categorical
     columns of ``X`` hold value-bin ids (``Booster._cat_binned``); at cat
@@ -674,13 +760,19 @@ def _cat_paths(b: "Booster", t: int):
         iscat[ti, : len(internal)] = b.cat_nodes[ti][internal]
         catm[ti, : len(internal)] = b.cat_masks[ti][internal]
     cfeats = np.asarray(sorted(b.cat_values or {}), np.int32)
-    cpos = {int(f_): j for j, f_ in enumerate(cfeats)}
-    cm = np.zeros((t * max_i, len(cfeats) * bc), np.uint8)
-    for ti in range(t):
-        for ii in np.nonzero(iscat[ti])[0]:
-            j = cpos[int(consts.feats[ti, ii])]
-            cm[ti * max_i + ii, j * bc : (j + 1) * bc] = catm[ti, ii]
-    return iscat, cfeats, cm
+    # cm is block-sparse stored dense ((T*I, Fc*Bc), one Bc block per cat
+    # node): Fc-times the old (T, I, Bc) tables. Gate it — a huge imported
+    # forest with many high-cardinality features must fall back to the
+    # (slow but memory-bounded) gather kernel rather than OOM.
+    if t * max_i * len(cfeats) * bc <= _CM_BYTES_CAP:
+        cpos = {int(f_): j for j, f_ in enumerate(cfeats)}
+        cm = np.zeros((t * max_i, len(cfeats) * bc), np.uint8)
+        for ti in range(t):
+            for ii in np.nonzero(iscat[ti])[0]:
+                j = cpos[int(consts.feats[ti, ii])]
+                cm[ti * max_i + ii, j * bc : (j + 1) * bc] = catm[ti, ii]
+        return ("matmul", iscat, cfeats, cm)
+    return ("gather", iscat, catm)
 
 
 def _cat_paths_cache(b: "Booster", t: int):

@@ -144,33 +144,51 @@ def _dense_maps_cached(spec: USpec):
     return _dense_maps(spec)
 
 
+def cat_row_maps(spec: USpec, cat_slots) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Static maps for the CATEGORICAL subset of U's packed rows:
+    (row ids into U, feature id per row, local bin per row). Restricting
+    the membership matmul to these rows streams only the categorical
+    features' one-hot block per pass (~Σ cat widths instead of K_pad)."""
+    rows, feats, locals_ = [], [], []
+    for f_ in sorted(int(s) for s in cat_slots):
+        w = spec.widths[f_]
+        o = spec.offsets[f_]
+        rows.extend(range(o, o + w))
+        feats.extend([f_] * w)
+        locals_.extend(range(w))
+    return (
+        np.asarray(rows, np.int32),
+        np.asarray(feats, np.int32),
+        np.asarray(locals_, np.int32),
+    )
+
+
 def membership_matmul(
-    u: jax.Array,  # (K_pad, N_pad) int8 from build_u
-    spec: USpec,
+    u_rows: jax.Array,  # (Kc, N_pad) int8 — the cat-feature rows of U
+    feat_of_row: jax.Array,  # (Kc,) int32 feature id per row
+    local_of_row: jax.Array,  # (Kc,) int32 feature-local bin per row
     sf: jax.Array,  # (k,) int32 split feature per leaf
     scm: jax.Array,  # (k, B) bool left-set mask per leaf (feature-local bins)
     n: int,
 ) -> jax.Array:
     """(k, n) bool: row in leaf jj's categorical left set — ONE standard
-    (k, K_pad) x (K_pad, N) MXU matmul against the fit-resident one-hot
-    instead of per-leaf (N,) gathers (each tiny gather costs ~ms of layout
-    round-trip in-context on TPU; measured ~35 ms/tree in the leafwise
-    while_loop). Scatter each leaf's mask into packed-row space via the
-    static col->feature maps, dot, threshold. Numerically exact: the
-    one-hot and the mask are 0/1 in bf16."""
-    fc, lcol = (jnp.asarray(a) for a in _col_maps_cached(spec))
+    (k, Kc) x (Kc, N) MXU matmul against the categorical rows of the
+    fit-resident one-hot instead of per-leaf (N,) gathers (each tiny
+    gather costs ~ms of layout round-trip in-context on TPU; measured
+    ~35 ms/tree in the leafwise while_loop). Scatter each leaf's mask
+    into packed-row space via the static row maps, dot, threshold.
+    Numerically exact: the one-hot and the mask are 0/1 in bf16."""
     k = sf.shape[0]
-    sel = (fc[None, :] == sf[:, None]) & (lcol[None, :] >= 0)
+    kc = feat_of_row.shape[0]
+    sel = feat_of_row[None, :] == sf[:, None]
     masks = (
         jnp.take_along_axis(
-            scm,
-            jnp.broadcast_to(jnp.maximum(lcol, 0)[None, :], (k, fc.shape[0])),
-            axis=1,
+            scm, jnp.broadcast_to(local_of_row[None, :], (k, kc)), axis=1
         )
         & sel
-    )  # (k, K_pad) — small (no N axis); bins hold feature-local ids
+    )  # (k, Kc) — small (no N axis); bins hold feature-local ids
     in_set_f = lax.dot_general(
-        masks.astype(jnp.bfloat16), u.astype(jnp.bfloat16),
+        masks.astype(jnp.bfloat16), u_rows.astype(jnp.bfloat16),
         (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
     )  # (k, N_pad)

@@ -389,3 +389,32 @@ class TestCatSparseEstimator:
         np.testing.assert_allclose(
             ms.booster.leaf_values, md.booster.leaf_values, rtol=1e-6
         )
+
+
+class TestCatPredictKernelDispatch:
+    """Predict picks the matmul kernel normally and the memory-bounded
+    gather kernel when the dense mask matrix would blow the size gate —
+    both must score identically."""
+
+    def test_gather_fallback_matches_matmul(self, monkeypatch):
+        X, y = _cat_data(n=2000, n_cat=9, seed=31)
+        bins, mp = bin_dataset(X, max_bin=31, categorical_features=[0])
+        r = train(
+            bins, y,
+            TrainOptions(objective="binary", num_iterations=6, num_leaves=15,
+                         max_bin=31, min_data_per_group=1),
+            mapper=mp,
+        )
+        b = r.booster
+        from mmlspark_tpu.lightgbm import booster as B
+
+        ref = b.raw_margin(X[:300])
+        leaves_ref = b.predict_leaf(X[:300])
+        assert B._cat_paths_cache(b, b._used_trees(None))[0] == "matmul"
+
+        monkeypatch.setattr(B, "_CM_BYTES_CAP", 0)  # force the gather path
+        object.__setattr__(b, "_cat_path_cache", None)  # drop cached tables
+        cat = B._cat_paths_cache(b, b._used_trees(None))
+        assert cat[0] == "gather"
+        np.testing.assert_allclose(b.raw_margin(X[:300]), ref, rtol=1e-6)
+        np.testing.assert_array_equal(b.predict_leaf(X[:300]), leaves_ref)

@@ -444,7 +444,7 @@ class TestCategoricalURouting:
         import jax.numpy as jnp
 
         from mmlspark_tpu.ops.u_histogram import (
-            build_u, make_u_spec, membership_matmul,
+            build_u, cat_row_maps, make_u_spec, membership_matmul,
         )
 
         rng = np.random.default_rng(23)
@@ -460,13 +460,28 @@ class TestCategoricalURouting:
         sf = jnp.asarray(rng.integers(0, f, size=k), jnp.int32)
         scm = jnp.asarray(rng.random((k, b)) < 0.4)
 
-        # the SAME helper the leafwise builder traces
-        in_set = np.asarray(membership_matmul(u, spec, sf, scm, n))
+        # the SAME helpers the leafwise builder traces, with a STRICT
+        # subset of categorical features (the production shape): leaves
+        # splitting on a non-categorical feature must produce all-False
+        # rows (the caller masks them via the node's is-categorical flag)
+        cat_subset = [0, 2]
+        rows_np, fr_np, lr_np = cat_row_maps(spec, cat_subset)
+        in_set = np.asarray(
+            membership_matmul(
+                u[jnp.asarray(rows_np)],
+                jnp.asarray(fr_np), jnp.asarray(lr_np), sf, scm, n,
+            )
+        )
 
-        # the gather fallback, row by row
+        # the gather reference, row by row
         scm_np = np.asarray(scm)
         sf_np = np.asarray(sf)
         expected = np.stack(
-            [scm_np[jj][bins_np[:, sf_np[jj]]] for jj in range(k)]
+            [
+                scm_np[jj][bins_np[:, sf_np[jj]]]
+                if sf_np[jj] in cat_subset
+                else np.zeros(n, bool)
+                for jj in range(k)
+            ]
         )
         np.testing.assert_array_equal(in_set, expected)
