@@ -5,10 +5,10 @@ dataset x boosting rows; ``benchmarks_VerifyTrainClassifier.csv`` is a
 classifier x 4 datasets x 4 boosting types, regressor x 4 datasets x 4
 boosting types, the TrainClassifier/TrainRegressor CROSS-LEARNER matrices
 (7 classification + 6 regression learner families through the wrapper +
-ComputeModelStatistics flow — 80 rows, the VerifyTrainClassifier
-analogue), multiclass, categorical, VW per-loss (adagrad AND ftrl),
+ComputeModelStatistics flow — 89 rows incl. the multiclass slice, the
+VerifyTrainClassifier analogue), multiclass, categorical, VW per-loss (adagrad AND ftrl),
 ragged-group LTR ndcg at several cutoffs, and the train/tune wrappers.
-151 pinned rows total across the golden_matrix_* CSVs.
+160 pinned rows total across the golden_*.csv files.
 
 Promote intended changes by copying the corresponding
 ``golden_matrix_*.csv.new.csv`` over its golden (the harness writes them
@@ -121,20 +121,28 @@ def test_golden_matrix_regressors(reg_sets):
     suite.verify(_golden("regressor"))
 
 
-def test_golden_matrix_multiclass_and_categorical(class_sets):
-    from mmlspark_tpu.lightgbm import LightGBMClassifier, LightGBMRegressor
+@pytest.fixture(scope="module")
+def multiclass_sets():
+    """(name, X, y, iters) triples shared by BOTH multiclass golden suites —
+    one definition so the dataset construction cannot silently diverge."""
     from sklearn.datasets import load_digits, load_wine, make_blobs
 
-    suite = BenchmarkSuite("matrix_multiclass")
     wn = load_wine()
     dg = load_digits()
     Xb, yb = make_blobs(n_samples=900, centers=4, n_features=6,
                         cluster_std=3.0, random_state=5)
-    for dname, X, y, iters in (
+    return (
         ("wine", wn.data, wn.target, 25),
         ("digits10", dg.data[:900], dg.target[:900], 25),
         ("blobs4", Xb, yb, 15),
-    ):
+    )
+
+
+def test_golden_matrix_multiclass_and_categorical(class_sets, multiclass_sets):
+    from mmlspark_tpu.lightgbm import LightGBMClassifier, LightGBMRegressor
+
+    suite = BenchmarkSuite("matrix_multiclass")
+    for dname, X, y, iters in multiclass_sets:
         (Xtr, ytr), (Xte, yte) = _split(X, y, 1)
         m = LightGBMClassifier(
             objective="multiclass", numIterations=iters, numLeaves=15,
@@ -226,6 +234,35 @@ def test_golden_matrix_cross_learner_classifiers(class_sets):
             suite.add(f"{dname}_{lname}_acc", float(stats["accuracy"][0]), 0.03)
             suite.add(f"{dname}_{lname}_auc", float(stats["AUC"][0]), 0.03)
     suite.verify(_golden("trainclassifier"))
+
+
+def test_golden_matrix_cross_learner_multiclass(multiclass_sets):
+    """Multiclass through the SAME TrainClassifier + ComputeModelStatistics
+    wrapper flow: 3 datasets x 3 boosting types, accuracy pinned (the
+    multiclass slice of the reference's cross-learner matrix)."""
+    from mmlspark_tpu.lightgbm import LightGBMClassifier
+    from mmlspark_tpu.train import ComputeModelStatistics, TrainClassifier
+
+    suite = BenchmarkSuite("matrix_trainmulticlass")
+    for dname, X, y, _iters in multiclass_sets:
+        (Xtr, ytr), (Xte, yte) = _split(X, y, 7)
+        for boosting, extra in (("gbdt", {}), ("goss", {}),
+                                ("dart", {"dropRate": 0.2})):
+            m = TrainClassifier(
+                model=LightGBMClassifier(
+                    objective="multiclass", numIterations=20, numLeaves=15,
+                    minDataInLeaf=5, boostingType=boosting, seed=0,
+                    parallelism="serial", **extra,
+                ),
+                labelCol="label",
+            ).fit(_table(Xtr, ytr))
+            stats = ComputeModelStatistics(labelCol="label").transform(
+                m.transform(_table(Xte, yte))
+            )
+            suite.add(
+                f"{dname}_lgbm_{boosting}_acc", float(stats["accuracy"][0]), 0.05
+            )
+    suite.verify(_golden("trainmulticlass"))
 
 
 def test_golden_matrix_cross_learner_regressors(reg_sets):
