@@ -262,34 +262,52 @@ def _split_search(
         nonempty = (cnt >= max(1, opts.min_data_per_group)) & (jpos > 0)
         ratio = gsum / (hsum + opts.cat_smooth)
         l2c = l2 + opts.cat_l2
-        big = jnp.float32(np.finfo(np.float32).max)
         parent_c = (tg * tg) / (h_tot + l2c)  # tg shared with the numeric branch
         fm_c = feature_mask[cat_idx]
-        dir_data = []
-        for sign in (1.0, -1.0):
-            key = jnp.where(nonempty, sign * ratio, big)  # empties sort last
-            order = jnp.argsort(key, axis=2)  # (k, Fc, B)
-            sg = jnp.cumsum(jnp.take_along_axis(gsum, order, 2), axis=2)
-            sh = jnp.cumsum(jnp.take_along_axis(hsum, order, 2), axis=2)
-            sc = jnp.cumsum(jnp.take_along_axis(cnt, order, 2), axis=2)
-            sne = jnp.cumsum(
-                jnp.take_along_axis(nonempty.astype(jnp.int32), order, 2), axis=2
-            )
-            grc, hrc, crc = g_tot[:, None, None] - sg, h_tot[:, None, None] - sh, c_tot[:, None, None] - sc
-            tlc, trc = _soft_threshold(sg, l1), _soft_threshold(grc, l1)
-            gain_c = tlc * tlc / (sh + l2c) + trc * trc / (hrc + l2c) - parent_c[:, None, None]
-            valid_c = (
-                (jpos + 1 <= opts.max_cat_threshold)
-                & (sne == jpos + 1)  # prefix of NONEMPTY bins only
-                & (sc >= opts.min_data_in_leaf)
-                & (crc >= opts.min_data_in_leaf)
-                & (sh >= opts.min_sum_hessian_in_leaf)
-                & (hrc >= opts.min_sum_hessian_in_leaf)
-                & (fm_c[None, :, None] > 0)
-            )
-            dir_data.append((jnp.where(valid_c, gain_c, -jnp.inf), order, sg, sh, sc))
-        gain_cat = jnp.maximum(dir_data[0][0], dir_data[1][0])
-        use_desc = dir_data[1][0] > dir_data[0][0]  # (k, Fc, B)
+        # Sorted-prefix search WITHOUT sorting: the prefix of the g/h-ratio
+        # order ending at category i is exactly {j : key_j <= key_i} (ties
+        # broken by bin index, = a stable sort's order), so each candidate's
+        # prefix sums are one masked einsum against the (B, B) order-
+        # indicator M — dense MXU/VPU work replacing the per-pass argsort +
+        # gather + cumsum chain (which also made the CPU test battery ~2x
+        # slower). Candidate index = BIN id (the prefix-defining category),
+        # and the winner's left-set mask is just M's row — no order
+        # permutation to invert. Both scan directions ride a leading axis d
+        # (0 = ascending ratio, 1 = descending).
+        keys = jnp.stack([ratio, -ratio], axis=0)  # (2, k, Fc, B)
+        ki = keys[..., :, None]  # key_i, candidate axis
+        kj = keys[..., None, :]  # key_j, member axis
+        tie = jnp.arange(b)[None, :] <= jnp.arange(b)[:, None]  # j <= i
+        M = ((kj < ki) | ((kj == ki) & tie)) & nonempty[None, ..., None, :]
+        Mf = M.astype(jnp.float32)
+        hp = lax.Precision.HIGHEST
+
+        def prefix(stat):  # (k, Fc, B) member sums -> (2, k, Fc, B) per candidate
+            return jnp.einsum("dkfij,kfj->dkfi", Mf, stat, precision=hp)
+
+        sg, sh, sc = prefix(gsum), prefix(hsum), prefix(cnt)
+        sizes = prefix(nonempty.astype(jnp.float32))
+        grc = g_tot[None, :, None, None] - sg
+        hrc = h_tot[None, :, None, None] - sh
+        crc = c_tot[None, :, None, None] - sc
+        tlc, trc = _soft_threshold(sg, l1), _soft_threshold(grc, l1)
+        gain_c = (
+            tlc * tlc / (sh + l2c)
+            + trc * trc / (hrc + l2c)
+            - parent_c[None, :, None, None]
+        )
+        valid_c = (
+            nonempty[None]  # the prefix-defining category itself qualifies
+            & (sizes <= opts.max_cat_threshold)
+            & (sc >= opts.min_data_in_leaf)
+            & (crc >= opts.min_data_in_leaf)
+            & (sh >= opts.min_sum_hessian_in_leaf)
+            & (hrc >= opts.min_sum_hessian_in_leaf)
+            & (fm_c[None, None, :, None] > 0)
+        )
+        gain_dirs = jnp.where(valid_c, gain_c, -jnp.inf)  # (2, k, Fc, B)
+        gain_cat = jnp.maximum(gain_dirs[0], gain_dirs[1])
+        use_desc = gain_dirs[1] > gain_dirs[0]  # (k, Fc, B)
 
         # One-vs-rest search (native use_onehot, max_cat_to_onehot): for
         # small-cardinality features the candidates are the SINGLE-category
@@ -357,18 +375,13 @@ def _split_search(
 
         is_cat_best = jnp.asarray(cf_np)[best_f]  # (k,)
         cpos = jnp.asarray(inv_np)[best_f]  # (k,) index into the cat slice
-        dsel = use_desc[iota, cpos, best_b]  # (k,) winning direction
+        dsel = use_desc[iota, cpos, best_b].astype(jnp.int32)  # (k,) direction
 
-        def _at_best(x0, x1):
-            return jnp.where(
-                dsel, x1[iota, cpos, best_b], x0[iota, cpos, best_b]
-            )
-
-        glb_c = _at_best(dir_data[0][2], dir_data[1][2])
-        hlb_c = _at_best(dir_data[0][3], dir_data[1][3])
-        clb_c = _at_best(dir_data[0][4], dir_data[1][4])
+        glb_c = sg[dsel, iota, cpos, best_b]
+        hlb_c = sh[dsel, iota, cpos, best_b]
+        clb_c = sc[dsel, iota, cpos, best_b]
         # One-vs-rest winners read their left stats STRAIGHT from the
-        # histogram at bin best_b (no cumulative sort prefix involved).
+        # histogram at bin best_b (no prefix involved).
         is_oh_best = (
             jnp.asarray(oh_np)[cpos] & is_cat_best
             if oh_np.any() else jnp.zeros(k, bool)
@@ -381,20 +394,8 @@ def _split_search(
         hlb = jnp.where(is_cat_best, hlb_c, hlb)
         clb = jnp.where(is_cat_best, clb_c, clb)
         thr_raw = jnp.where(is_cat_best, jnp.inf, thr_raw)
-        # Left-set membership: scatter ranks through the winning order —
-        # bins at sorted positions <= best_b are IN (best_b = set size - 1).
-        order_sel = jnp.where(
-            dsel[:, None],
-            dir_data[1][1][iota, cpos, :],
-            dir_data[0][1][iota, cpos, :],
-        )  # (k, B) bin ids in sorted order
-        in_prefix = jnp.arange(b)[None, :] <= best_b[:, None]  # (k, B) by rank
-        cat_mask = (
-            jnp.zeros((k, b), bool)
-            .at[iota[:, None], order_sel]
-            .set(in_prefix)
-            & is_cat_best[:, None]
-        )
+        # Left-set membership: the winning candidate's row of M IS the set.
+        cat_mask = M[dsel, iota, cpos, best_b, :] & is_cat_best[:, None]
         if oh_np.any():
             # one-vs-rest left set = exactly {best_b}
             cat_mask = jnp.where(
