@@ -186,7 +186,8 @@ class SplitSearch(NamedTuple):
     rval: jax.Array
     lcov: jax.Array
     rcov: jax.Array
-    is_cat: jax.Array  # (k,) bool: categorical split (bin = left-set size - 1)
+    is_cat: jax.Array  # (k,) bool: categorical split (bin = the prefix-
+    # defining BIN id; the left set itself lives in cat_mask)
     cat_mask: jax.Array  # (k, B) bool: bins in the LEFT set (all-False if numeric)
     value_cat: jax.Array  # (k,) own leaf value under l2+cat_l2 (cat-parent case)
 
@@ -1018,6 +1019,49 @@ def _make_step(
             )
 
         tree = jax.vmap(per_class, in_axes=(1, 1))(grad, hess)  # (C, ...) arrays
+
+        # Percentile leaf renewal (native RenewTreeOutput,
+        # regression_objective.hpp): quantile and L1 objectives have
+        # CONSTANT-magnitude gradients, so gradient-derived leaf values move
+        # margins by at most ~lr per iteration in RAW label units — on
+        # unscaled targets the fit never reaches the requested percentile.
+        # Native replaces each leaf's output with the weighted alpha-
+        # percentile (L1: median) of the leaf's residuals, then shrinks by
+        # the learning rate; so do we, before margins update.
+        if objective.name in ("quantile", "regression_l1"):
+            pct = opts.alpha if objective.name == "quantile" else 0.5
+            lr_t = lr if lr is not None else opts.learning_rate
+            resid = y - margins[:, 0]
+            w_eff = w * bag_mask
+            leaf = tree.row_leaf[0]  # (N,) — both objectives are C=1
+            m_slots = tree.leaf_val.shape[1]
+            n_rows = resid.shape[0]
+            # O(N) weighted per-leaf percentile: sort rows by (leaf,
+            # residual) via a composite integer key (residual RANK from a
+            # first sort keeps the key integral), then ONE global weight
+            # cumsum with per-leaf boundaries from segment reductions — no
+            # (num_leaves, N) matrix materializes inside the scanned step.
+            # (leaf, residual) ordering via two STABLE sorts (a composite
+            # integer key would overflow int32 at large num_leaves x rows)
+            perm1 = jnp.argsort(resid)
+            order = perm1[jnp.argsort(leaf[perm1], stable=True)]
+            r_s = resid[order]
+            l_s = leaf[order]
+            w_s = w_eff[order]
+            cum_all = jnp.cumsum(w_s)
+            tw = jax.ops.segment_sum(w_s, l_s, num_segments=m_slots)
+            before = cum_all - w_s  # exclusive global prefix
+            start = jax.ops.segment_min(before, l_s, num_segments=m_slots)
+            in_leaf_cum = cum_all - start[l_s]  # inclusive prefix WITHIN leaf
+            hit = in_leaf_cum >= jnp.maximum(pct * tw[l_s], 1e-12)
+            pos = jnp.where(hit, jnp.arange(n_rows), n_rows)
+            first = jax.ops.segment_min(pos, l_s, num_segments=m_slots)
+            vals = r_s[jnp.clip(first, 0, n_rows - 1)] * lr_t
+            renewed = jnp.where(
+                (tw > 0) & (first < n_rows), vals, tree.leaf_val[0]
+            )
+            tree = tree._replace(leaf_val=renewed[None, :])
+
         if opts.boosting_type == "rf":
             # Random-forest mode: trees fit the init-score residual
             # independently; margins never accumulate during training and

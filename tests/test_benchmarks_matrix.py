@@ -8,7 +8,8 @@ boosting types, the TrainClassifier/TrainRegressor CROSS-LEARNER matrices
 ComputeModelStatistics flow — 89 rows incl. the multiclass slice, the
 VerifyTrainClassifier analogue), multiclass, categorical, VW per-loss (adagrad AND ftrl),
 ragged-group LTR ndcg at several cutoffs, and the train/tune wrappers.
-160 pinned rows total across the golden_*.csv files.
+170 pinned rows total across the golden_*.csv files (incl. the
+regression-objective matrix: l1/huber/quantile/poisson/tweedie).
 
 Promote intended changes by copying the corresponding
 ``golden_matrix_*.csv.new.csv`` over its golden (the harness writes them
@@ -305,6 +306,48 @@ def test_golden_matrix_cross_learner_regressors(reg_sets):
                 0.08, higher_is_better=False,
             )
     suite.verify(_golden("trainregressor"))
+
+
+def test_golden_matrix_regression_objectives(reg_sets):
+    """Objective-math goldens: every non-default regression objective
+    (l1/huber/quantile/poisson/tweedie) pinned on two real datasets with an
+    objective-appropriate metric — l1/huber by scale-normalized MAE,
+    quantile by empirical coverage at alpha, poisson/tweedie by normalized
+    RMSE on positive targets. A silent gradient/hessian regression in any
+    objective moves its rows."""
+    from mmlspark_tpu.lightgbm import LightGBMRegressor
+
+    suite = BenchmarkSuite("matrix_objectives")
+    for dname in ("diabetes", "friedman1"):  # both have positive targets
+        (Xtr, ytr), (Xte, yte) = reg_sets[dname]
+        scale = float(np.std(ytr)) or 1.0
+
+        def fit(objective, **extra):
+            return LightGBMRegressor(
+                objective=objective, numIterations=40, numLeaves=15,
+                seed=0, parallelism="serial", **extra,
+            ).fit(_table(Xtr, ytr))
+
+        for objective in ("regression_l1", "huber"):
+            m = fit(objective)
+            mae = float(np.mean(np.abs(m.booster.raw_margin(Xte)[:, 0] - yte)))
+            suite.add(f"{dname}_{objective}_mae", mae / scale, 0.08,
+                      higher_is_better=False)
+
+        mq = fit("quantile", alpha=0.9)
+        coverage = float((yte <= mq.booster.raw_margin(Xte)[:, 0]).mean())
+        # |coverage - alpha| so drift in EITHER direction moves the row
+        # (a one-sided coverage pin would pass an overshooting fit)
+        suite.add(f"{dname}_quantile090_coverage_err", abs(coverage - 0.9),
+                  0.07, higher_is_better=False)
+
+        for objective in ("poisson", "tweedie"):
+            m = fit(objective)
+            pred = np.exp(m.booster.raw_margin(Xte)[:, 0])  # log-link margins
+            rmse = float(np.sqrt(np.mean((pred - yte) ** 2)))
+            suite.add(f"{dname}_{objective}_rmse", rmse / scale, 0.10,
+                      higher_is_better=False)
+    suite.verify(_golden("objectives"))
 
 
 def test_golden_matrix_vw(class_sets, reg_sets):

@@ -120,6 +120,30 @@ def test_regressor_quantile():
     assert 0.8 < frac_below < 0.97, frac_below
 
 
+def test_quantile_and_l1_are_scale_invariant():
+    """Percentile leaf renewal (native RenewTreeOutput): quantile/L1
+    gradients are constant-magnitude, so WITHOUT renewal the fit moves by
+    at most ~lr per iteration in raw label units and never reaches the
+    target percentile on unscaled data. Renewal makes coverage independent
+    of the label scale — the native engine's behavior."""
+    rng = np.random.default_rng(9)
+    X = rng.normal(size=(2000, 3))
+    base = X[:, 0] * 2 + rng.normal(size=2000)
+    for scale in (1.0, 1000.0):
+        y = base * scale
+        m = LightGBMRegressor(
+            numIterations=50, objective="quantile", alpha=0.9
+        ).fit(_to_table(X, y))
+        cov = (y <= m.transform(_to_table(X, y))["prediction"]).mean()
+        assert 0.8 < cov < 0.97, (scale, cov)
+        ml1 = LightGBMRegressor(
+            numIterations=50, objective="regression_l1"
+        ).fit(_to_table(X, y))
+        below = (y <= ml1.transform(_to_table(X, y))["prediction"]).mean()
+        # L1 fits the conditional MEDIAN at any scale
+        assert 0.4 < below < 0.6, (scale, below)
+
+
 def test_weight_column(breast_cancer):
     X, y = breast_cancer
     w = np.where(y == 1, 10.0, 1.0)
