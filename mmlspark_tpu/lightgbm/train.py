@@ -1036,13 +1036,12 @@ def _make_step(
             leaf = tree.row_leaf[0]  # (N,) — both objectives are C=1
             m_slots = tree.leaf_val.shape[1]
             n_rows = resid.shape[0]
-            # O(N) weighted per-leaf percentile: sort rows by (leaf,
-            # residual) via a composite integer key (residual RANK from a
-            # first sort keeps the key integral), then ONE global weight
-            # cumsum with per-leaf boundaries from segment reductions — no
+            # O(N) weighted per-leaf percentile: order rows by (leaf,
+            # residual) with two STABLE sorts (a composite integer sort key
+            # would silently overflow int32 at large num_leaves x rows —
+            # TPU truncates int64), then ONE global weight cumsum with
+            # per-leaf boundaries from segment reductions — no
             # (num_leaves, N) matrix materializes inside the scanned step.
-            # (leaf, residual) ordering via two STABLE sorts (a composite
-            # integer key would overflow int32 at large num_leaves x rows)
             perm1 = jnp.argsort(resid)
             order = perm1[jnp.argsort(leaf[perm1], stable=True)]
             r_s = resid[order]
@@ -1054,6 +1053,14 @@ def _make_step(
             start = jax.ops.segment_min(before, l_s, num_segments=m_slots)
             in_leaf_cum = cum_all - start[l_s]  # inclusive prefix WITHIN leaf
             hit = in_leaf_cum >= jnp.maximum(pct * tw[l_s], 1e-12)
+            # f32 rounding of million-row global cumsums can leave the
+            # threshold unreached in a leaf at alpha near 1; the percentile
+            # is always <= the leaf's max residual, so the last row of each
+            # leaf hits by definition.
+            last_in_leaf = jnp.concatenate(
+                [l_s[1:] != l_s[:-1], jnp.ones(1, bool)]
+            )
+            hit = hit | last_in_leaf
             pos = jnp.where(hit, jnp.arange(n_rows), n_rows)
             first = jax.ops.segment_min(pos, l_s, num_segments=m_slots)
             vals = r_s[jnp.clip(first, 0, n_rows - 1)] * lr_t

@@ -108,13 +108,16 @@ def _col_maps_cached(spec: USpec) -> Tuple[np.ndarray, np.ndarray]:
 def build_u(bins: jax.Array, spec: USpec, dtype=jnp.int8) -> jax.Array:
     """(K_pad, N_pad) TRANSPOSED one-hot of the packed bin ids — ONE compare
     pass's worth of VPU work (~120 ms at 400k x 28 x 256), paid once per
-    fit. The bin axis leads so the pass contraction is lane-on-lane. Built
-    in ONE traced op regardless of F (a wide dataset must not inflate
-    trace/compile time linearly): gather the (F, N_pad) transposed ids by
-    the static col->feature map, then compare against each packed row's
-    local bin id. Pad rows carry bin id -1 and the k..k_pad tail carries
-    local id -1, so both contribute nothing. The int32 gather fuses into
-    the int8 compare (no (K_pad, N_pad) int32 materialization)."""
+    fit. The bin axis leads so the pass contraction is lane-on-lane.
+
+    Built by a ``lax.scan`` over 128-row K blocks: trace size is O(1) in
+    the feature count (a thousands-of-features dataset must not inflate
+    trace/compile time — the original per-feature Python loop did), and
+    the per-step gather transient is bounded at 128 x N_pad int32 — the
+    single whole-K gather formulation made the TPU compiler itself crash
+    at 1M rows (the (K_pad, N_pad) int32 intermediate is tens of GB).
+    Pad rows carry bin id -1 and the k..k_pad tail carries local id -1,
+    so both contribute nothing."""
     n, f = bins.shape
     pad = (-n) % _N_ALIGN
     ids = bins.astype(jnp.int32)
@@ -122,8 +125,17 @@ def build_u(bins: jax.Array, spec: USpec, dtype=jnp.int8) -> jax.Array:
         ids = jnp.pad(ids, ((0, pad), (0, 0)), constant_values=-1)
     ids_t = ids.T  # (F, N_pad)
     feat_of_col, local_of_col = _col_maps_cached(spec)
-    col_ids = jnp.take(ids_t, jnp.asarray(feat_of_col), axis=0)  # (K_pad, N_pad)
-    return (col_ids == jnp.asarray(local_of_col)[:, None]).astype(dtype)
+    blk = _LANE  # k_pad is always a multiple of the lane block
+    fo = jnp.asarray(feat_of_col).reshape(-1, blk)
+    lo = jnp.asarray(local_of_col).reshape(-1, blk)
+
+    def block(_, fl):
+        fb, lb = fl
+        rows = jnp.take(ids_t, fb, axis=0)  # (blk, N_pad)
+        return None, (rows == lb[:, None]).astype(dtype)
+
+    _, u = lax.scan(block, None, (fo, lo))
+    return u.reshape(spec.k_pad, n + pad)
 
 
 def _dense_maps(spec: USpec) -> Tuple[np.ndarray, np.ndarray]:
