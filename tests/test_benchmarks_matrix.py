@@ -8,8 +8,10 @@ boosting types, the TrainClassifier/TrainRegressor CROSS-LEARNER matrices
 ComputeModelStatistics flow — 89 rows incl. the multiclass slice, the
 VerifyTrainClassifier analogue), multiclass, categorical, VW per-loss (adagrad AND ftrl),
 ragged-group LTR ndcg at several cutoffs, and the train/tune wrappers.
-170 pinned rows total across the golden_*.csv files (incl. the
-regression-objective matrix: l1/huber/quantile/poisson/tweedie).
+190 pinned rows total across the golden_*.csv files — the reference's
+benchmark breadth — incl. the regression-objective matrix
+(l1/huber/quantile/poisson/tweedie), per-cell AUC AND logloss on the
+classifier matrix, and a labelGain-wired ranker dataset.
 
 Promote intended changes by copying the corresponding
 ``golden_matrix_*.csv.new.csv`` over its golden (the harness writes them
@@ -92,6 +94,7 @@ def reg_sets():
 
 def test_golden_matrix_classifiers(class_sets):
     from mmlspark_tpu.lightgbm import LightGBMClassifier
+    from mmlspark_tpu.lightgbm.objectives import binary_logloss
 
     suite = BenchmarkSuite("matrix_classifier")
     for dname, ((Xtr, ytr), (Xte, yte)) in class_sets.items():
@@ -100,8 +103,15 @@ def test_golden_matrix_classifiers(class_sets):
                 numIterations=30, numLeaves=15, boostingType=boosting,
                 seed=0, parallelism="serial", **extra,
             ).fit(_table(Xtr, ytr))
-            score = _auc(yte, m.booster.raw_margin(Xte)[:, 0])
-            suite.add(f"{dname}_{boosting}_auc", score, 0.015)
+            margins = m.booster.raw_margin(Xte)[:, 0]
+            suite.add(f"{dname}_{boosting}_auc", _auc(yte, margins), 0.015)
+            # second metric per cell, same fit: logloss catches calibration
+            # drift AUC is blind to (rank-preserving margin scaling)
+            suite.add(
+                f"{dname}_{boosting}_logloss",
+                float(binary_logloss(yte, margins, np.ones(len(yte)))),
+                0.06, higher_is_better=False,
+            )
     suite.verify(_golden("classifier"))
 
 
@@ -401,7 +411,10 @@ def test_golden_matrix_ranker_ragged():
     from mmlspark_tpu.lightgbm.ranker import ndcg_at_k
 
     suite = BenchmarkSuite("matrix_ranker")
-    for seed, tag in ((9, "a"), (23, "b")):
+    # dataset "c" pins the labelGain wiring: a LINEAR gain table instead of
+    # LightGBM's default 2^i - 1 must change the fitted ordering pressure
+    for seed, tag, extra in ((9, "a", {}), (23, "b", {}),
+                             (31, "c", {"labelGain": [0, 1, 2, 3, 4]})):
         rng = np.random.default_rng(seed)
         sizes = rng.integers(3, 26, size=50)
         n = int(sizes.sum())
@@ -417,10 +430,11 @@ def test_golden_matrix_ranker_ragged():
         })
         m = LightGBMRanker(
             numIterations=25, groupCol="query", minDataInLeaf=3, seed=0,
-            parallelism="serial",
+            parallelism="serial", **extra,
         ).fit(t)
         score = m.transform(t)["prediction"]
-        for k in (3, 5, 10):
+        ks = (3, 5, 10) if tag != "c" else (1, 3, 5, 10)
+        for k in ks:
             suite.add(f"ltr{tag}_ndcg_at_{k}", float(ndcg_at_k(rel, score, group, k)),
                       0.02)
     suite.verify(_golden("ranker"))
