@@ -69,6 +69,23 @@ def main():
     )
     print(f"native model round-tripped through {path}")
 
+    # Categorical features: declare slots and the engine runs native-style
+    # set splits (one-vs-rest below maxCatToOnehot, sorted-set above).
+    cat = rng.integers(0, 6, size=len(y)).astype(np.float64)
+    eff = np.array([1.5, -2.0, 0.5, 3.0, -1.0, 0.0])
+    yc = (eff[cat.astype(int)] + X[:, 0] / X[:, 0].std() > 0).astype(np.float64)
+    Xc = np.column_stack([cat, X[:, :4]])
+    mc = LightGBMClassifier(
+        numIterations=30, numLeaves=15, categoricalSlotIndexes=[0],
+        minDataPerGroup=1,
+    ).fit(Table({"features": Xc[:n_train], "label": yc[:n_train]}))
+    acc_cat = (
+        mc.transform(Table({"features": Xc[n_train:], "label": yc[n_train:]}))
+        .column("prediction") == yc[n_train:]
+    ).mean()
+    print(f"categorical-feature model test accuracy: {acc_cat:.4f}")
+    assert acc_cat > 0.85
+
 
 if __name__ == "__main__":
     main()
