@@ -87,7 +87,7 @@ def _auc(y, score):
     return auc(y, score, np.ones(len(y)))
 
 
-def _fit_tpu(X, y, Xt, max_bin=MAX_BIN, cat_idx=None):
+def _fit_tpu(X, y, Xt, max_bin=MAX_BIN, cat_idx=None, extra_opts=None):
     """Returns (wire_secs, resident_secs, binning_host_secs, wire_runs,
     resident_runs, test margins, booster)."""
     from mmlspark_tpu.lightgbm.binning import bin_dataset, bin_dataset_to_device
@@ -100,6 +100,7 @@ def _fit_tpu(X, y, Xt, max_bin=MAX_BIN, cat_idx=None):
         learning_rate=LEARNING_RATE,
         max_bin=max_bin,
         growth="leafwise",
+        **(extra_opts or {}),
     )
     kw = {"categorical_features": cat_idx} if cat_idx else {}
     # Compile warm-up: jit programs are shape-specialized, so run ONE
@@ -296,6 +297,36 @@ def main():
     except Exception as e:  # pragma: no cover
         print(f"mixed cpu baseline failed: {e}", file=sys.stderr)
 
+    # Throughput preset on the SAME continuous workload: LightGBM's own
+    # gradient-quantization training (use_quantized_grad — 8-bit
+    # stochastically-rounded g/h, s8 x s8 integer MXU histogram pass) plus
+    # a 16-leaf frontier batch (one fewer U stream per tree). Quality is
+    # reported, not assumed: AUC lands within ~0.001 of the exact fit and
+    # above the CPU engine's. Compared against the same CPU run as the
+    # headline (the CPU engine has no quantized mode at matched settings).
+    (
+        q_secs, q_resident, _q_binning, _q_wire_runs, q_resident_runs,
+        q_margins, _,
+    ) = _fit_tpu(
+        Xtr, ytr, Xte,
+        extra_opts={"use_quantized_grad": True, "leaf_batch": 16},
+    )
+    quant = {
+        "gbdt_quant_train_row_iterations_per_sec": round(
+            N_ROWS * N_ITERS / q_secs, 1
+        ),
+        "gbdt_quant_tpu_fit_secs": round(q_secs, 3),
+        "gbdt_quant_tpu_fit_secs_device_resident": round(q_resident, 3),
+        "gbdt_quant_auc_tpu": round(float(_auc(yte, q_margins)), 5),
+        "gbdt_quant_resident_runs_secs": q_resident_runs,
+        "gbdt_quant_config": "use_quantized_grad=True, leaf_batch=16",
+    }
+    if cpu_secs:
+        quant["gbdt_quant_vs_baseline"] = round(cpu_secs / q_secs, 3)
+        quant["gbdt_quant_vs_baseline_device_resident"] = round(
+            cpu_secs / q_resident, 3
+        )
+
     print(
         json.dumps(
             {
@@ -323,6 +354,7 @@ def main():
                 "predict_vs_cpu": round(pred_tpu / pred_cpu, 2) if pred_cpu else 0.0,
                 "cpu_engine": "sklearn.HistGradientBoostingClassifier(median of 3)",
                 **mixed,
+                **quant,
             }
         )
     )

@@ -43,6 +43,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import os
 from typing import Optional, Tuple
 
 import jax
@@ -52,6 +53,12 @@ from jax import lax
 
 _LANE = 128
 _N_ALIGN = 512  # row padding granularity (lane-dim alignment for U tiles)
+# Fused Pallas panel+dot pass (MMLSPARK_TPU_U_FUSED=1 opts in). Default
+# OFF: measured ~2.5% SLOWER end-to-end than the two-op XLA formulation on
+# v5e (XLA's matmul pipeline beats the hand grid even though the fused
+# kernel saves the panel's HBM round-trip) — kept env-gated for future
+# toolchains and as the correctness-tested template for the fusion.
+_FUSED = os.environ.get("MMLSPARK_TPU_U_FUSED", "0") == "1"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -216,6 +223,113 @@ def stat_rows(grad: jax.Array, hess: jax.Array, count: jax.Array) -> jax.Array:
     ).astype(jnp.bfloat16)
 
 
+def stat_rows_quant(
+    grad: jax.Array, hess: jax.Array, count: jax.Array, key: jax.Array
+) -> Tuple[jax.Array, jax.Array]:
+    """8-bit stochastically-rounded stat rows + dequant scales — LightGBM's
+    gradient-quantization training (``use_quantized_grad``: its engine
+    discretizes g/h onto a small symmetric grid with stochastic rounding so
+    histogram accumulation rides the integer SIMD/MXU path; here the whole
+    U pass becomes one s8 x s8 MXU contraction at 2x the int ops/cycle of
+    the bf16 path and a narrower panel stream). 127-level symmetric grid
+    per tree: x_q = floor(x * 127/max|x| + u), u ~ U[0,1) — unbiased
+    (E[x_q] = x * 127/max|x|), so per-bin SUMS are unbiased estimators and
+    split gains converge to the exact ones at histogram row counts. Counts
+    are 0/1 and stay exact. Returns ((3, N) int8 [g_q; h_q; c],
+    (3,) f32 per-stat dequant scales [gs/127, hs/127, 1])."""
+    g = grad.astype(jnp.float32)
+    h = hess.astype(jnp.float32)
+    gs = jnp.maximum(jnp.max(jnp.abs(g)), jnp.float32(1e-30))
+    hs = jnp.maximum(jnp.max(jnp.abs(h)), jnp.float32(1e-30))
+    kg, kh = jax.random.split(key)
+
+    def q(x, s, kk):
+        u = jax.random.uniform(kk, x.shape, dtype=jnp.float32)
+        return jnp.clip(
+            jnp.floor(x * (127.0 / s) + u), -127, 127
+        ).astype(jnp.int8)
+
+    stats = jnp.stack([q(g, gs, kg), q(h, hs, kh), count.astype(jnp.int8)])
+    scales = jnp.stack([gs / 127.0, hs / 127.0, jnp.float32(1.0)])
+    return stats, scales
+
+
+def k_pad_fits_vmem(k_pad: int) -> bool:
+    """Fused-pass VMEM gate: 2 U blocks (k_pad x 512 s8) + accumulator
+    (k_pad x 128 s32) must sit comfortably in VMEM (~24 MB budget)."""
+    return k_pad * (2 * _N_ALIGN + 4 * _LANE) <= (24 << 20)
+
+
+def _fused_panel_dot(
+    u: jax.Array,  # (K_pad, N_pad) int8
+    aux: jax.Array,  # (8, N_pad) f32: rows [g, h, c, node, 0, 0, 0, 0]
+    k: int,
+    quant: bool,
+    interpret: bool = False,
+) -> jax.Array:
+    """One Pallas pass fusing the panel build into the U contraction.
+
+    The two-op XLA formulation materializes the (3k, N) panel to HBM
+    behind an optimization barrier (without it XLA re-fuses the build into
+    the dot's rhs load and recomputes it per K-tile — measured 2x slower).
+    This kernel gets the best of both: each N-tile's panel is built ONCE
+    in VMEM from the node keys + stat rows and consumed immediately by the
+    MXU, so the pass streams exactly U + 32 f32 bytes/row of aux — no
+    panel round-trip, no per-K-tile recompute. The output block
+    (K_pad, 128) stays VMEM-resident across the whole N grid and
+    accumulates (int32 exact for the quantized path, f32 otherwise).
+
+    Panel row j carries stat j//k for rows whose node key equals j%k —
+    the same (3k, N) layout the XLA path uses, padded to the full 128-lane
+    group (rows 3k..127 are zero; callers slice)."""
+    k_pad, n_pad = u.shape
+    tn = _N_ALIGN
+    out_dtype = jnp.int32 if quant else jnp.float32
+
+    def kern(aux_ref, u_ref, out_ref):
+        from jax.experimental import pallas as pl  # local: optional dep path
+
+        j = lax.broadcasted_iota(jnp.int32, (_LANE, tn), 0)
+        leaf = (j % k).astype(jnp.float32)
+        sidx = j // k
+        g, h, c = aux_ref[0:1, :], aux_ref[1:2, :], aux_ref[2:3, :]
+        nodev = aux_ref[3:4, :]
+        val = jnp.where(sidx == 0, g, jnp.where(sidx == 1, h, c))
+        panel = jnp.where((nodev == leaf) & (j < 3 * k), val, 0.0)  # (128, tn)
+        if quant:
+            acc = lax.dot_general(
+                u_ref[...], panel.astype(jnp.int8),
+                (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.int32,
+            )
+        else:
+            acc = lax.dot_general(
+                u_ref[...].astype(jnp.bfloat16), panel.astype(jnp.bfloat16),
+                (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+
+        @pl.when(pl.program_id(0) == 0)
+        def _init():
+            out_ref[...] = jnp.zeros_like(out_ref)
+
+        out_ref[...] += acc
+
+    from jax.experimental import pallas as pl
+
+    return pl.pallas_call(
+        kern,
+        grid=(n_pad // tn,),
+        in_specs=[
+            pl.BlockSpec((8, tn), lambda i: (0, i)),
+            pl.BlockSpec((k_pad, tn), lambda i: (0, i)),
+        ],
+        out_specs=pl.BlockSpec((k_pad, _LANE), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((k_pad, _LANE), out_dtype),
+        interpret=interpret,
+    )(aux, u)
+
+
 def build_histograms_u(
     u: jax.Array,  # (K_pad, N_pad) int8 from build_u
     grad: jax.Array,  # (N,) — ignored when stats is given
@@ -225,7 +339,7 @@ def build_histograms_u(
     num_nodes: int,
     spec: USpec,
     *,
-    stats: Optional[jax.Array] = None,  # (3, N) bf16 from stat_rows()
+    stats=None,  # (3, N) bf16 from stat_rows(), or (stats_i8, scales) quant
 ) -> jax.Array:
     """(num_nodes, F, B, 3) float32 — same contract as
     ``ops.histogram.build_histograms`` but with the one-hot precomputed.
@@ -233,7 +347,15 @@ def build_histograms_u(
     The per-pass work is: a (3k, N) transposed panel (node-key select over
     the stat rows, built entirely in the row-on-lanes layout) and one
     s8 x bf16 NT matmul. Precision model = the compare-built kernel's
-    default MXU pass (bf16 inputs, f32 accumulation; counts exact)."""
+    default MXU pass (bf16 inputs, f32 accumulation; counts exact).
+
+    When ``stats`` is a ``stat_rows_quant`` tuple the pass runs entirely in
+    int8 (s8 x s8 MXU, s32 accumulation — exact integer sums of the
+    quantized per-row values) and the packed result is dequantized by the
+    per-stat scales; counts stay bit-exact either way."""
+    scales = None
+    if isinstance(stats, tuple):
+        stats, scales = stats
     if 3 * num_nodes > _LANE:
         raise ValueError(f"panel width 3*{num_nodes} exceeds one lane group")
     k = num_nodes
@@ -242,23 +364,63 @@ def build_histograms_u(
 
     if stats is None:
         stats = stat_rows(grad, hess, count)
-    # (3k, N) stat-major transposed panel: row s*k+j carries stat s for rows
-    # whose node key is j, 0 elsewhere. node broadcasts across SUBLANES
-    # (cheap); no lane-dim relayout anywhere.
-    key = jnp.tile(jnp.arange(k, dtype=jnp.int32), 3)[:, None]  # (3k, 1)
-    mask_t = key == node.astype(jnp.int32)[None, :]  # (3k, N)
-    vals_t = jnp.repeat(stats, k, axis=0)  # (3k, N) bf16
-    panel_t = jnp.where(mask_t, vals_t, jnp.bfloat16(0))
-    if n_pad != n:
-        panel_t = jnp.pad(panel_t, ((0, 0), (0, n_pad - n)))
-    # Materialize: without the barrier XLA re-fuses the panel build into the
-    # dot's rhs load and recomputes it per K-tile (measured ~2x slower).
-    panel_t = lax.optimization_barrier(panel_t)
 
-    packed = lax.dot_general(
-        u.astype(jnp.bfloat16), panel_t,
-        (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32,
-    )  # (K_pad, 3k)
+    # VMEM residency: two double-buffered U blocks + the accumulator block
+    # ≈ k_pad * 1.5 KB; gate well under v5e's VMEM so wide-K datasets
+    # (thousands of packed bins) fall back to the two-op XLA pass.
+    if (
+        _FUSED
+        and k_pad_fits_vmem(u.shape[0])
+        and jax.default_backend() in ("tpu", "axon")
+    ):
+        # Fused Pallas pass: panel built per N-tile in VMEM, no HBM
+        # round-trip (docstring of _fused_panel_dot).
+        aux = jnp.concatenate(
+            [
+                stats.astype(jnp.float32),  # quantized values are small ints
+                node.astype(jnp.float32)[None, :],
+                jnp.zeros((4, n), jnp.float32),
+            ]
+        )
+        if n_pad != n:
+            # pad node lane with -1 (matches no leaf); stat lanes with 0
+            aux = jnp.pad(aux, ((0, 0), (0, n_pad - n)))
+            aux = aux.at[3, n:].set(-1.0)
+        packed = _fused_panel_dot(u, aux, k, quant=scales is not None)
+        packed = packed[:, : 3 * k]
+    else:
+        # (3k, N) stat-major transposed panel: row s*k+j carries stat s for
+        # rows whose node key is j, 0 elsewhere. node broadcasts across
+        # SUBLANES (cheap); no lane-dim relayout anywhere.
+        key = jnp.tile(jnp.arange(k, dtype=jnp.int32), 3)[:, None]  # (3k, 1)
+        mask_t = key == node.astype(jnp.int32)[None, :]  # (3k, N)
+        zero = jnp.int8(0) if scales is not None else jnp.bfloat16(0)
+        vals_t = jnp.repeat(stats, k, axis=0)  # (3k, N) bf16 | int8
+        panel_t = jnp.where(mask_t, vals_t, zero)
+        if n_pad != n:
+            panel_t = jnp.pad(panel_t, ((0, 0), (0, n_pad - n)))
+        # Materialize: without the barrier XLA re-fuses the panel build into
+        # the dot's rhs load and recomputes it per K-tile (~2x slower).
+        panel_t = lax.optimization_barrier(panel_t)
+
+        if scales is not None:
+            packed = lax.dot_general(
+                u, panel_t,
+                (((1,), (1,)), ((), ())), preferred_element_type=jnp.int32,
+            )  # (K_pad, 3k) exact int sums of quantized stats
+        else:
+            packed = lax.dot_general(
+                u.astype(jnp.bfloat16), panel_t,
+                (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32,
+            )  # (K_pad, 3k)
+
+    if scales is not None:
+        # shared dequant: row s*k+j carries stat s, so the (3, k) reshape
+        # broadcasts each stat's scale over its k node columns
+        packed = (
+            packed.reshape(-1, 3, k).astype(jnp.float32)
+            * scales[None, :, None]
+        ).reshape(-1, 3 * k)
 
     f, b = spec.num_features, spec.num_bins
     idx, mask = _dense_maps_cached(spec)

@@ -173,3 +173,207 @@ class TestUTrainParity:
         )
         a = auc(y, r.booster.raw_margin(X)[:, 0], np.ones(n))
         assert a > 0.85, a
+
+
+class TestQuantizedGrad:
+    """LightGBM's use_quantized_grad analogue: 8-bit stochastically-rounded
+    stat rows, s8 x s8 integer MXU pass, per-stat dequant scales."""
+
+    def test_stat_rows_quant_counts_exact_and_sums_unbiased(self):
+        import jax
+
+        rng = np.random.default_rng(7)
+        n = 20000
+        g = rng.normal(size=n).astype(np.float32)
+        h = rng.uniform(0.05, 1.0, size=n).astype(np.float32)
+        c = (rng.uniform(size=n) > 0.3).astype(np.float32)
+        from mmlspark_tpu.ops.u_histogram import stat_rows_quant
+
+        stats, scales = stat_rows_quant(
+            jnp.asarray(g), jnp.asarray(h), jnp.asarray(c),
+            jax.random.PRNGKey(0),
+        )
+        stats = np.asarray(stats)
+        scales = np.asarray(scales)
+        assert stats.dtype == np.int8
+        # counts are bit-exact 0/1, scale exactly 1
+        np.testing.assert_array_equal(stats[2], c.astype(np.int8))
+        assert scales[2] == 1.0
+        # per-element quantization stays within one grid step of the input
+        for row, x, s in ((0, g, scales[0]), (1, h, scales[1])):
+            deq = stats[row].astype(np.float32) * s
+            np.testing.assert_allclose(deq, x, atol=float(s) + 1e-7)
+            # stochastic rounding is unbiased => SUM of dequantized values
+            # concentrates: n * grid * O(1/sqrt(n)) tolerance
+            assert abs(deq.sum() - x.sum()) < float(s) * 6 * np.sqrt(n)
+
+    def test_quant_histogram_counts_exact_gh_within_grid(self):
+        import jax
+
+        widths, f, b, bins, g, h, c, node = _mixed_case(seed=3)
+        k = 5
+        from mmlspark_tpu.ops.u_histogram import stat_rows_quant
+
+        spec = make_u_spec(b, f, per_feature=widths)
+        u = build_u(jnp.asarray(bins), spec)
+        exact = np.asarray(build_histograms_u(
+            u, jnp.asarray(g), jnp.asarray(h), jnp.asarray(c),
+            jnp.asarray(node), k, spec,
+        ))
+        qstats = stat_rows_quant(
+            jnp.asarray(g), jnp.asarray(h), jnp.asarray(c),
+            jax.random.PRNGKey(1),
+        )
+        quant = np.asarray(build_histograms_u(
+            u, jnp.asarray(g), jnp.asarray(h), jnp.asarray(c),
+            jnp.asarray(node), k, spec, stats=qstats,
+        ))
+        # counts ride the exact int path: bit-identical
+        np.testing.assert_array_equal(quant[..., 2], exact[..., 2])
+        # g/h bin sums: each of the <=n member rows contributes at most one
+        # grid step of quantization error
+        scales = np.asarray(qstats[1])
+        n_bin = exact[..., 2]
+        for s_idx in (0, 1):
+            bound = scales[s_idx] * (n_bin + 1) + 1e-4
+            assert (np.abs(quant[..., s_idx] - exact[..., s_idx]) <= bound).all()
+
+    def test_end_to_end_quantized_fit_quality_and_determinism(self):
+        rng = np.random.default_rng(11)
+        n = 4000
+        X = rng.normal(size=(n, 8))
+        y = ((X[:, 0] + X[:, 1] * X[:, 2]) > 0).astype(np.float64)
+        bins, mp = bin_dataset(X, max_bin=63)
+        base = TrainOptions(objective="binary", num_iterations=25,
+                            num_leaves=15, max_bin=63, histogram_method="u")
+        import dataclasses
+
+        r_exact = train(bins, y, base, mapper=mp)
+        qopts = dataclasses.replace(base, use_quantized_grad=True)
+        r_q = train(bins, y, qopts, mapper=mp)
+        a_exact = auc(y, r_exact.booster.raw_margin(X)[:, 0], np.ones(n))
+        a_q = auc(y, r_q.booster.raw_margin(X)[:, 0], np.ones(n))
+        assert a_q > a_exact - 0.01, (a_q, a_exact)
+        # seeded stochastic rounding: same options => identical model
+        r_q2 = train(bins, y, qopts, mapper=mp)
+        np.testing.assert_array_equal(
+            r_q.booster.leaf_values, r_q2.booster.leaf_values
+        )
+
+    def test_param_flows_from_stage(self):
+        from mmlspark_tpu.lightgbm.classifier import LightGBMClassifier
+
+        stage = LightGBMClassifier(useQuantizedGrad=True)
+        assert stage._make_options(num_class=1).use_quantized_grad is True
+        assert (
+            LightGBMClassifier()._make_options(num_class=1).use_quantized_grad
+            is False
+        )
+
+    def test_multiclass_quantized(self):
+        rng = np.random.default_rng(13)
+        n = 3000
+        X = rng.normal(size=(n, 6))
+        y = (X[:, 0] > 0.5).astype(int) + (X[:, 1] > 0).astype(int)
+        bins, mp = bin_dataset(X, max_bin=31)
+        opts = TrainOptions(objective="multiclass", num_class=3,
+                            num_iterations=10, num_leaves=7, max_bin=31,
+                            histogram_method="u", use_quantized_grad=True)
+        r = train(bins, y.astype(np.float64), opts, mapper=mp)
+        pred = r.booster.raw_margin(X).argmax(1)
+        assert (pred == y).mean() > 0.8
+
+    def test_quant_falls_back_with_warning_when_u_inactive(self, caplog):
+        import logging
+
+        rng = np.random.default_rng(17)
+        n = 1500
+        X = rng.normal(size=(n, 5))
+        y = (X[:, 0] > 0).astype(np.float64)
+        bins, mp = bin_dataset(X, max_bin=63)
+        opts = TrainOptions(objective="binary", num_iterations=3,
+                            num_leaves=7, max_bin=63,
+                            use_quantized_grad=True,
+                            tree_learner="voting_parallel", top_k=3)
+        with caplog.at_level(logging.WARNING, logger="mmlspark_tpu.lightgbm"):
+            r = train(bins, y, opts, mapper=mp)
+        assert any("use_quantized_grad" in m for m in caplog.messages)
+        assert r.booster.num_trees >= 1
+
+
+    def test_quant_through_binary_classifier_stage(self):
+        # regression: binary classifiers carry num_class=2 with ONE margin
+        # column; the stochastic-rounding keys must follow grad.shape[1]
+        from mmlspark_tpu.data.table import Table
+        from mmlspark_tpu.lightgbm.classifier import LightGBMClassifier
+
+        rng = np.random.default_rng(23)
+        n = 1200
+        X = rng.normal(size=(n, 6))
+        y = (X[:, 0] + X[:, 1] > 0).astype(np.float64)
+        tbl = Table({"features": X, "label": y})
+        m = LightGBMClassifier(
+            numIterations=8, useQuantizedGrad=True,
+            featuresCol="features", labelCol="label",
+        ).fit(tbl)
+        p = np.asarray(m.transform(tbl)["probability"])[:, 1]
+        assert auc(y, p, np.ones(n)) > 0.9
+
+
+class TestFusedPanelDot:
+    """The opt-in Pallas fusion (MMLSPARK_TPU_U_FUSED) must match the
+    two-op XLA formulation bit-for-bit on the quant path and to bf16
+    precision on the exact path (same precision model)."""
+
+    @pytest.mark.parametrize("quant", [False, True])
+    def test_matches_xla_path(self, quant):
+        import jax
+
+        from mmlspark_tpu.ops.u_histogram import (
+            _fused_panel_dot,
+            stat_rows_quant,
+        )
+
+        widths, f, b, bins, g, h, c, node = _mixed_case(seed=5, n=1024)
+        k = 4
+        spec = make_u_spec(b, f, per_feature=widths)
+        u = build_u(jnp.asarray(bins), spec)
+        if quant:
+            stats, scales = stat_rows_quant(
+                jnp.asarray(g), jnp.asarray(h), jnp.asarray(c),
+                jax.random.PRNGKey(3),
+            )
+        else:
+            stats = stat_rows(jnp.asarray(g), jnp.asarray(h), jnp.asarray(c))
+        n = bins.shape[0]
+        aux = jnp.concatenate([
+            stats.astype(jnp.float32),
+            jnp.asarray(node, jnp.float32)[None, :],
+            jnp.zeros((4, n), jnp.float32),
+        ])
+        pad = u.shape[1] - n
+        if pad:
+            aux = jnp.pad(aux, ((0, 0), (0, pad)))
+            aux = aux.at[3, n:].set(-1.0)
+        fused = np.asarray(
+            _fused_panel_dot(u, aux, k, quant=quant, interpret=True)
+        )[:, : 3 * k]
+        # XLA reference: the in-module non-fused branch
+        key = jnp.tile(jnp.arange(k, dtype=jnp.int32), 3)[:, None]
+        mask_t = key == jnp.asarray(node, jnp.int32)[None, :]
+        if quant:
+            panel = jnp.where(mask_t, jnp.repeat(stats, k, axis=0), jnp.int8(0))
+            if pad:
+                panel = jnp.pad(panel, ((0, 0), (0, pad)))
+            ref = np.asarray(jnp.einsum(
+                "kn,pn->kp", u.astype(jnp.int32), panel.astype(jnp.int32)))
+            np.testing.assert_array_equal(fused, ref)
+        else:
+            panel = jnp.where(mask_t, jnp.repeat(stats, k, axis=0), jnp.bfloat16(0))
+            if pad:
+                panel = jnp.pad(panel, ((0, 0), (0, pad)))
+            ref = np.asarray(jax.lax.dot_general(
+                u.astype(jnp.bfloat16), panel,
+                (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32))
+            np.testing.assert_allclose(fused, ref, rtol=1e-5, atol=1e-3)
