@@ -7,11 +7,13 @@ boosting types, the TrainClassifier/TrainRegressor CROSS-LEARNER matrices
 (7 classification + 6 regression learner families through the wrapper +
 ComputeModelStatistics flow — 89 rows incl. the multiclass slice, the
 VerifyTrainClassifier analogue), multiclass, categorical, VW per-loss (adagrad AND ftrl),
-ragged-group LTR ndcg at several cutoffs, and the train/tune wrappers.
-190 pinned rows total across the golden_*.csv files — the reference's
-benchmark breadth — incl. the regression-objective matrix
-(l1/huber/quantile/poisson/tweedie), per-cell AUC AND logloss on the
-classifier matrix, and a labelGain-wired ranker dataset.
+ragged-group LTR ndcg at several cutoffs, the train/tune wrappers, and
+the quantized-gradient slice (use_quantized_grad AUC + logloss per
+dataset, seeded-deterministic). 198 pinned rows total across the
+golden_*.csv files — the reference's benchmark breadth — incl. the
+regression-objective matrix (l1/huber/quantile/poisson/tweedie), per-cell
+AUC AND logloss on the classifier matrix, and a labelGain-wired ranker
+dataset.
 
 Promote intended changes by copying the corresponding
 ``golden_matrix_*.csv.new.csv`` over its golden (the harness writes them
@@ -478,3 +480,31 @@ def test_golden_matrix_wrappers(class_sets, reg_sets):
     ).fit(_table(Xtr, ytr))
     suite.add("synthetic_tune_best_acc", float(tuned.getBestMetric()), 0.03)
     suite.verify(_golden("wrappers"))
+
+
+def test_golden_matrix_quantized(class_sets):
+    """Quantized-gradient fits (use_quantized_grad) are seeded-
+    deterministic — pin AUC + logloss across the classification datasets.
+    Engine-level with histogram_method='u' so the quantized s8 pass
+    actually runs under CPU CI (the stage default would silently fall back
+    to exact stats off-TPU, pinning nothing new)."""
+    from mmlspark_tpu.lightgbm.binning import bin_dataset
+    from mmlspark_tpu.lightgbm.objectives import binary_logloss
+    from mmlspark_tpu.lightgbm.train import TrainOptions, train
+
+    suite = BenchmarkSuite("matrix_quant")
+    for dname, ((Xtr, ytr), (Xte, yte)) in class_sets.items():
+        bins, mp = bin_dataset(np.asarray(Xtr, np.float64), max_bin=255)
+        opts = TrainOptions(
+            objective="binary", num_iterations=30, num_leaves=15, seed=0,
+            histogram_method="u", use_quantized_grad=True,
+        )
+        r = train(bins, np.asarray(ytr, np.float64), opts, mapper=mp)
+        margins = r.booster.raw_margin(np.asarray(Xte, np.float64))[:, 0]
+        suite.add(f"{dname}_quant_auc", _auc(yte, margins), 0.015)
+        suite.add(
+            f"{dname}_quant_logloss",
+            float(binary_logloss(yte, margins, np.ones(len(yte)))),
+            0.06, higher_is_better=False,
+        )
+    suite.verify(_golden("quant"))
