@@ -15,6 +15,8 @@ Learning for Apache Spark), re-designed TPU-first on JAX/XLA/Pallas/pjit:
 Subpackages mirror the reference's component inventory (SURVEY.md §2):
 
 - ``core``      — params/pipeline contracts, serialization, schema, topology
+- ``runtime``   — fault-tolerant partition scheduler (the driver/executor
+  layer Spark provided: retries, heartbeats, lineage recompute)
 - ``data``      — columnar Table, readers, partitioning
 - ``parallel``  — mesh construction, sharding helpers, collectives, ring attention
 - ``ops``       — hashing, histograms, image kernels (XLA + Pallas)

@@ -197,10 +197,20 @@ class ShardedDataset:
         self,
         mapper: BinMapper,
         out_path: Optional[str] = None,
+        policy=None,
+        metrics=None,
     ) -> Tuple[np.memmap, np.ndarray, Optional[np.ndarray]]:
         """Stream every shard through ``apply_bins`` into an on-disk uint8
         matrix. Returns (bins memmap (N, F) uint8, y (N,), w or None) —
-        labels/weights are small (8 bytes/row) and stay in RAM."""
+        labels/weights are small (8 bytes/row) and stay in RAM.
+
+        With a :class:`~mmlspark_tpu.runtime.SchedulerPolicy` (explicit or
+        ambient via ``runtime.policy()``), each shard becomes one task on
+        the fault-tolerant scheduler: shards bin concurrently into their
+        disjoint memmap slices, a dead executor's shard is retried, and the
+        shard file itself is the lineage source (a lost partition re-reads
+        from disk). Output is bit-identical to the sequential pass — every
+        task writes only its own row range."""
         self._scan()
         n, f = self.num_rows, self.num_features
         # fail fast on unlabeled data — BEFORE the (potentially hours-long)
@@ -214,14 +224,43 @@ class ShardedDataset:
         bins = np.memmap(out_path, dtype=np.uint8, mode="w+", shape=(n, f))
         y_all = np.empty(n, dtype=np.float64)
         w_all = np.empty(n, dtype=np.float64) if have_w else None
-        lo = 0
-        for X, y, w in self.iter_shards():
-            hi = lo + len(X)
-            bins[lo:hi] = apply_bins(X, mapper)
-            y_all[lo:hi] = y
-            if have_w:
-                w_all[lo:hi] = w
-            lo = hi
+
+        from mmlspark_tpu import runtime
+
+        pol = policy or runtime.current_policy()
+        if pol is None:
+            lo = 0
+            for X, y, w in self.iter_shards():
+                hi = lo + len(X)
+                bins[lo:hi] = apply_bins(X, mapper)
+                y_all[lo:hi] = y
+                if have_w:
+                    w_all[lo:hi] = w
+                lo = hi
+        else:
+            offsets = np.cumsum([0] + [i.num_rows for i in self._infos])
+            lineage = runtime.Lineage()
+            shards = [
+                lineage.record(
+                    si,
+                    (lambda si=si, p=path: (si,) + self._load(p)),
+                    describe=path,
+                )
+                for si, path in enumerate(self.paths)
+            ]
+
+            def bin_shard(payload):
+                si, X, y, w = payload
+                lo, hi = int(offsets[si]), int(offsets[si + 1])
+                bins[lo:hi] = apply_bins(X, mapper)
+                y_all[lo:hi] = y
+                if have_w:
+                    w_all[lo:hi] = w
+                return hi - lo
+
+            runtime.run_partitioned(
+                bin_shard, shards, pol, lineage=lineage, metrics=metrics
+            )
         bins.flush()
         return bins, y_all, w_all
 

@@ -135,8 +135,11 @@ class LightGBMParams(
         "bench shape, docs/perf_histogram.md). Per-bin sums stay unbiased "
         "and counts exact; off (default) keeps bit-exact bf16 stats. "
         "Requires the precomputed-U path (single-device, maxBin <= 255, U "
-        "within the HBM budget) and <= 16.9M rows — otherwise training "
-        "logs a warning and proceeds with exact stats",
+        "within the HBM budget) and < 2^24 rows (f32 count exactness) — "
+        "otherwise training logs a warning and proceeds with exact stats. "
+        "Depthwise fits with depth >= 7 exceed the 128-slot U panel "
+        "budget on deep levels (> 42 frontier nodes) and fall back "
+        "per-level to exact histograms, logged once per fit",
         default=False, converter=to_bool,
     )
     categoricalSlotIndexes = Param(
@@ -189,6 +192,15 @@ class LightGBMParams(
     leafPredictionCol = Param("Output column for leaf indices ('' = off)", default="", converter=to_str)
     useSingleDatasetMode = Param("Accepted for API parity (dataset is always host-resident)", default=True, converter=to_bool)
     numTasks = Param("Override number of mesh shards (0 = all devices)", default=0, converter=to_int, validator=ge(0))
+    numExecutors = Param(
+        "Run the histogram-binning prepass as partitioned tasks on this "
+        "many fault-tolerant executors (mmlspark_tpu.runtime): bounded "
+        "retries, heartbeat-loss re-dispatch, and lineage recompute apply, "
+        "and the binned matrix is bit-identical to the inline pass. 0 "
+        "(default) bins inline; an ambient runtime.policy() also activates "
+        "the scheduler",
+        default=0, converter=to_int, validator=ge(0),
+    )
 
     def _objective_name(self) -> str:
         raise NotImplementedError
@@ -314,6 +326,36 @@ class LightGBMBase(LightGBMParams, Estimator):
     def callbacks(self):
         return list(getattr(self, "_callbacks", []))
 
+    def _bin_dataset(self, X, opts, cat_slots):
+        """Histogram-discretize the training matrix. With `numExecutors` > 0
+        or an ambient :func:`mmlspark_tpu.runtime.policy`, the per-row pass
+        runs as partitioned tasks on the fault-tolerant scheduler — the
+        Spark analog of binning inside executors — and is bit-identical to
+        the inline path (apply_bins is row-pure). Scheduler metrics land on
+        ``self._runtime_metrics`` for inspection."""
+        kwargs = dict(
+            max_bin=opts.max_bin,
+            categorical_features=sorted(cat_slots) or None,
+            sample_cnt=self.getBinSampleCount(),
+            max_bin_by_feature=self.getMaxBinByFeature() or None,
+        )
+        from mmlspark_tpu import runtime
+
+        ambient = runtime.current_policy()
+        if ambient is None and self.getNumExecutors() <= 0:
+            return bin_dataset(X, **kwargs)
+        from mmlspark_tpu.lightgbm.binning import bin_dataset_partitioned
+
+        pol = ambient or runtime.SchedulerPolicy(
+            max_workers=self.getNumExecutors(), seed=self.getSeed()
+        )
+        self._runtime_metrics = runtime.RuntimeMetrics()
+        bins, mapper = bin_dataset_partitioned(
+            X, policy=pol, metrics=self._runtime_metrics, **kwargs
+        )
+        self._runtime_metrics.log(prefix="binning: ")
+        return bins, mapper
+
     def _fit(self, table: Table) -> "LightGBMModelBase":
         # Validation split by indicator column (LightGBMBase.scala:196-197).
         valid_table = None
@@ -363,12 +405,7 @@ class LightGBMBase(LightGBMParams, Estimator):
                     )
                 cat_slots.add(name_to_idx[nm])
 
-        bins, mapper = bin_dataset(
-            X, max_bin=opts.max_bin,
-            categorical_features=sorted(cat_slots) or None,
-            sample_cnt=self.getBinSampleCount(),
-            max_bin_by_feature=self.getMaxBinByFeature() or None,
-        )
+        bins, mapper = self._bin_dataset(X, opts, cat_slots)
         valid_sets = []
         if valid_table is not None and valid_table.num_rows > 0:
             Xv, yv, wv, _ = self._prepare(valid_table, num_features=X.shape[1])

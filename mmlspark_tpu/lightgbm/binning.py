@@ -246,6 +246,63 @@ def bin_dataset(
     return apply_bins(X, mapper), mapper
 
 
+def bin_dataset_partitioned(
+    X, max_bin: int = 255, mapper: Optional[BinMapper] = None,
+    categorical_features=None, sample_cnt: int = 200_000,
+    max_bin_by_feature=None, policy=None, metrics=None,
+) -> Tuple[np.ndarray, BinMapper]:
+    """:func:`bin_dataset` with the row-binning pass dispatched as
+    partitioned tasks on the fault-tolerant scheduler
+    (:mod:`mmlspark_tpu.runtime`). The :class:`BinMapper` fit stays inline
+    (one cheap, deterministic quantile pass over a sample); the expensive
+    per-row :func:`apply_bins` is row-pure, so partition results
+    concatenated in index order are bit-identical to the inline call — an
+    injected executor death mid-bin retries/recomputes and changes nothing
+    downstream. Each partition records lineage (its row slice), so a
+    :class:`~mmlspark_tpu.runtime.lineage.PartitionLostError` rebuilds the
+    shard instead of failing the fit.
+
+    CSR input falls back to the inline path (``apply_bins_csr`` scatters
+    over the whole matrix in one pass).
+    """
+    from mmlspark_tpu import runtime
+    from mmlspark_tpu.data.sparse import CSRMatrix
+
+    if isinstance(X, CSRMatrix):
+        return bin_dataset(
+            X, max_bin=max_bin, mapper=mapper,
+            categorical_features=categorical_features, sample_cnt=sample_cnt,
+            max_bin_by_feature=max_bin_by_feature,
+        )
+    X = np.asarray(X, dtype=np.float64)
+    if mapper is None:
+        mapper = fit_bin_mapper(
+            X, max_bin=max_bin, sample_cnt=sample_cnt,
+            categorical_features=categorical_features,
+            max_bin_by_feature=max_bin_by_feature,
+        )
+    pol = policy or runtime.current_policy() or runtime.SchedulerPolicy()
+    n = X.shape[0]
+    num_parts = max(1, min(pol.max_workers, n))
+    if n == 0:
+        return apply_bins(X, mapper), mapper
+    bounds = np.linspace(0, n, num_parts + 1).astype(np.int64)
+    lineage = runtime.Lineage()
+    shards = [
+        lineage.record(
+            i,
+            (lambda lo=int(bounds[i]), hi=int(bounds[i + 1]): X[lo:hi]),
+            describe=f"rows[{bounds[i]}:{bounds[i + 1]}]",
+        )
+        for i in range(num_parts)
+    ]
+    parts = runtime.run_partitioned(
+        lambda rows: apply_bins(rows, mapper), shards, pol,
+        lineage=lineage, metrics=metrics,
+    )
+    return np.concatenate(parts, axis=0), mapper
+
+
 # ---------------------------------------------------------------------------
 # Sparse (CSR) ingest — the LGBM_DatasetCreateFromCSRSpark analogue
 # (reference lightgbm/LightGBMUtils.scala:246-266). Implicit entries are 0.0;

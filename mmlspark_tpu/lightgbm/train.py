@@ -99,7 +99,8 @@ class TrainOptions:
     # LightGBM's gradient-quantization training (use_quantized_grad): g/h
     # stochastically rounded to a 127-level per-tree grid so the U-pass
     # histogram contraction runs s8 x s8 on the int MXU (2x the ops/cycle
-    # of bf16) — per-bin sums stay unbiased, counts stay exact. Only
+    # of bf16) — per-bin sums stay unbiased, counts exact below 2^24 rows
+    # (the f32 integer-exactness limit; the row gate enforces it). Only
     # affects fits on the precomputed-U path; off = bit-exact bf16 stats.
     use_quantized_grad: bool = False
     # only batch leaves with gain >= ratio * pass-best (0 = off): tightens
@@ -224,8 +225,11 @@ def _split_search(
     # Left stats at "<= bin": a lower-triangular ones-matmul over the bin
     # axis instead of jnp.cumsum — XLA lowers cumsum to reduce-window on
     # TPU (measured 0.27 ms per search at B=256, ~1.4 ms/tree), while the
-    # (B, B) triangle rides the MXU for free. Counts stay exact (0/1
-    # triangle x integer sums < 2^24); g/h association differs from
+    # (B, B) triangle rides the MXU for free. Counts stay exact below
+    # 2^24 rows (0/1 triangle x integer sums; f32 holds integers exactly
+    # only up to 2^24 — the quantized-path row gate enforces the bound,
+    # and the exact path's counts carry the same f32 caveat past it);
+    # g/h association differs from
     # reduce-window's only within f32 rounding, which the cumsum lowering
     # never specified either.
     tri = jnp.tril(jnp.ones((b, b), jnp.float32))
@@ -1521,12 +1525,16 @@ def train(
                 "backend without histogram_method='u', mesh/voting "
                 "parallelism, num_bins > 256, or U over the HBM budget)"
             )
-        elif n + pad > (1 << 31) // 127:
-            # s8 x s8 sums accumulate in int32: |sum| <= 127 * rows, so
-            # past ~16.9M rows a single node's bin sum could wrap.
+        elif n + pad > min((1 << 31) // 127, 1 << 24):
+            # Two ceilings, enforce the tighter (2^24): s8 x s8 sums
+            # accumulate in int32 (|sum| <= 127 * rows wraps past
+            # 2^31/127 ~= 16.9M rows), and the f32 count channel loses
+            # integer exactness above 2^24 — the "counts stay exact"
+            # contract in _split_search holds only below it.
             reason = (
-                f"{n + pad} rows could overflow the int32 histogram "
-                "accumulator (limit 2^31/127 ~= 16.9M)"
+                f"{n + pad} rows exceeds the quantized-path cap "
+                "min(2^31/127, 2^24) = 2^24 (f32 count exactness / int32 "
+                "histogram accumulator)"
             )
         if reason is not None:
             from mmlspark_tpu.core.profiling import get_logger
@@ -1536,6 +1544,27 @@ def train(
                 "bf16 stats instead", reason,
             )
             opts = dataclasses.replace(opts, use_quantized_grad=False)
+
+    if (
+        opts.use_quantized_grad
+        and u_spec is not None
+        and opts.growth == "depthwise"
+        and opts.depth >= 7
+    ):
+        # The U panel packs 3 stat planes per frontier node into 128
+        # slots, so levels with > 42 nodes (2^6 = 64 at level 6, reached
+        # once depth >= 7) can't ride the quantized U kernel; _hist_fn
+        # drops those levels to the exact histogram path. Surface the
+        # per-level degrade once per fit instead of silently.
+        from mmlspark_tpu.core.profiling import get_logger
+
+        get_logger("mmlspark_tpu.lightgbm").warning(
+            "use_quantized_grad with depthwise growth and depth %d: levels "
+            "deeper than 5 have > 42 frontier nodes and exceed the 128-slot "
+            "U panel budget (3 stats x nodes), so those levels fall back to "
+            "exact (non-quantized) histograms per level",
+            opts.depth,
+        )
 
     okey = (_opts_key(opts), num_bins, mesh, u_spec, objective.cache_token)
     if opts.boosting_type == "goss":

@@ -1,0 +1,72 @@
+"""mmlspark_tpu.runtime — a fault-tolerant partition scheduler.
+
+MMLSpark's "runtime" was Spark's driver/executor model: partition
+dispatch, bounded retries, heartbeat-based executor loss detection,
+straggler re-dispatch, and lineage recompute all came for free. This
+subsystem is our self-owned replacement — small, thread-based, and
+deterministic enough to test fault recovery bit-for-bit:
+
+- :mod:`~mmlspark_tpu.runtime.scheduler` — the driver: per-task state
+  machine, seeded exponential backoff, deterministic result ordering;
+- :mod:`~mmlspark_tpu.runtime.executor`  — the fleet: heartbeating
+  worker pool with graceful drain and dead-worker replacement;
+- :mod:`~mmlspark_tpu.runtime.lineage`   — recompute a lost partition
+  from its recorded source instead of failing the job;
+- :mod:`~mmlspark_tpu.runtime.faults`    — seeded fault injection
+  (kill-task, delay-task, drop-heartbeat) for chaos tests;
+- :mod:`~mmlspark_tpu.runtime.metrics`   — per-task timings, retry
+  counts, queue depth via ``core/profiling.py`` conventions.
+
+Quick start::
+
+    from mmlspark_tpu import runtime
+
+    results = runtime.run_partitioned(process, shards,
+                                      runtime.SchedulerPolicy(max_workers=4))
+
+    # chaos: kill the executor running a random task, assert recovery
+    plan = runtime.FaultPlan(seed=7).kill_random_task(len(shards))
+    with runtime.inject_faults(plan):
+        same = runtime.run_partitioned(process, shards)
+    assert same == results and plan.fired
+"""
+
+from mmlspark_tpu.runtime.executor import ExecutorPool
+from mmlspark_tpu.runtime.faults import (
+    ExecutorDeathError,
+    FaultPlan,
+    current_faults,
+    inject_faults,
+)
+from mmlspark_tpu.runtime.lineage import Lineage, PartitionLostError, ShardLineage
+from mmlspark_tpu.runtime.metrics import RuntimeMetrics
+from mmlspark_tpu.runtime.scheduler import (
+    JobFailedError,
+    Scheduler,
+    SchedulerPolicy,
+    TaskLostError,
+    TaskState,
+    current_policy,
+    policy,
+    run_partitioned,
+)
+
+__all__ = [
+    "ExecutorDeathError",
+    "ExecutorPool",
+    "FaultPlan",
+    "JobFailedError",
+    "Lineage",
+    "PartitionLostError",
+    "RuntimeMetrics",
+    "Scheduler",
+    "SchedulerPolicy",
+    "ShardLineage",
+    "TaskLostError",
+    "TaskState",
+    "current_faults",
+    "current_policy",
+    "inject_faults",
+    "policy",
+    "run_partitioned",
+]

@@ -1,0 +1,144 @@
+"""Deterministic fault injection for the partition scheduler.
+
+Spark's fault-tolerance tests kill executors from the outside; here the
+failure modes are injected as *seeded, deterministic* hooks that fire at
+exact (task, attempt) points, so a fault-path test asserts on one specific
+recovery sequence instead of racing a process killer:
+
+- ``kill_task(n)``      — the executor running task ``n`` dies mid-task
+  (raises :class:`ExecutorDeathError`; the worker thread exits and the
+  pool replaces it, like a lost JVM executor);
+- ``delay_task(n, s)``  — task ``n`` stalls ``s`` seconds before running
+  (straggler / per-task-timeout scenarios);
+- ``drop_heartbeat(n)`` — the executor running task ``n`` stops
+  heartbeating and hangs until the scheduler declares it lost and
+  re-dispatches (the classic network-partitioned worker).
+
+Each registered fault fires at most once; ``plan.fired`` records what
+actually triggered, so tests assert the fault happened AND was survived.
+``kill_random_task`` draws its victim from the plan's seeded RNG — the
+"kill one executor at random" chaos test, reproducible run to run.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+import time
+from typing import Iterator, List, Optional, Tuple
+
+import numpy as np
+
+
+class ExecutorDeathError(RuntimeError):
+    """Simulated executor death: the worker thread running the task exits
+    (the scheduler retries the task on a surviving/replacement worker)."""
+
+
+class FaultPlan:
+    """Seeded registry of (task, attempt)-keyed faults, consulted by
+    executor workers as each attempt starts. Thread-safe; each fault pops
+    when it fires so retries run clean."""
+
+    def __init__(self, seed: Optional[int] = None):
+        if seed is None:
+            # CI's runtime-faults step pins this so every run replays the
+            # exact same chaos (kill_random_task victims included)
+            seed = int(os.environ.get("MMLSPARK_TPU_FAULT_SEED", "0"))
+        self.seed = int(seed)
+        self._rng = np.random.default_rng(seed)
+        self._kill = {}
+        self._delay = {}
+        self._drop_beat = {}
+        self._lock = threading.Lock()
+        #: [(kind, task_index, attempt)] in fire order
+        self.fired: List[Tuple[str, int, int]] = []
+
+    # -- registration (chainable) -------------------------------------------
+
+    def kill_task(self, index: int, attempt: int = 0) -> "FaultPlan":
+        self._kill[(int(index), int(attempt))] = True
+        return self
+
+    def delay_task(self, index: int, seconds: float, attempt: int = 0) -> "FaultPlan":
+        self._delay[(int(index), int(attempt))] = float(seconds)
+        return self
+
+    def drop_heartbeat(
+        self, index: int, attempt: int = 0, hold: float = 30.0
+    ) -> "FaultPlan":
+        """The executor running attempt ``attempt`` of task ``index`` stops
+        heartbeating and blocks (up to ``hold`` seconds, or until the
+        scheduler supersedes the attempt), then dies."""
+        self._drop_beat[(int(index), int(attempt))] = float(hold)
+        return self
+
+    def kill_random_task(self, num_tasks: int, attempt: int = 0) -> "FaultPlan":
+        """Seeded kill-one-executor: the victim index is drawn from the
+        plan's RNG, so the chaos is reproducible."""
+        return self.kill_task(int(self._rng.integers(num_tasks)), attempt)
+
+    @property
+    def pending(self) -> int:
+        with self._lock:
+            return len(self._kill) + len(self._delay) + len(self._drop_beat)
+
+    # -- worker-side hook ----------------------------------------------------
+
+    def apply_on_start(
+        self,
+        index: int,
+        attempt: int,
+        worker=None,
+        superseded: Optional[threading.Event] = None,
+    ) -> None:
+        """Fire any faults registered for this (task, attempt). Called by
+        the executor worker immediately before running the task body."""
+        key = (int(index), int(attempt))
+        with self._lock:
+            delay = self._delay.pop(key, None)
+            drop = self._drop_beat.pop(key, None)
+            kill = self._kill.pop(key, None)
+        if delay is not None:
+            self.fired.append(("delay", index, attempt))
+            time.sleep(delay)
+        if drop is not None:
+            self.fired.append(("drop_heartbeat", index, attempt))
+            if worker is not None:
+                worker.beat_suppressed = True
+            # hang (no heartbeats) until the scheduler declares this
+            # executor lost and re-dispatches, then die like one
+            if superseded is not None:
+                superseded.wait(timeout=drop)
+            else:
+                time.sleep(drop)
+            raise ExecutorDeathError(
+                f"injected heartbeat loss on task {index} attempt {attempt}"
+            )
+        if kill:
+            self.fired.append(("kill", index, attempt))
+            raise ExecutorDeathError(
+                f"injected executor death on task {index} attempt {attempt}"
+            )
+
+
+# -- ambient injection (reaches schedulers created inside fit/serve calls) --
+
+_ACTIVE: List[FaultPlan] = []
+
+
+@contextlib.contextmanager
+def inject_faults(plan: FaultPlan) -> Iterator[FaultPlan]:
+    """Make ``plan`` visible to every scheduler whose policy carries no
+    explicit plan — the way a test injects executor death into a
+    ``LightGBMClassifier.fit`` without threading a plan through the API."""
+    _ACTIVE.append(plan)
+    try:
+        yield plan
+    finally:
+        _ACTIVE.remove(plan)
+
+
+def current_faults() -> Optional[FaultPlan]:
+    return _ACTIVE[-1] if _ACTIVE else None

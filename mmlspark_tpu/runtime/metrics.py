@@ -1,0 +1,122 @@
+"""Scheduler/executor metrics — per-task timings, retry counts, queue depth.
+
+Follows ``core/profiling.py`` conventions: an accumulating object with
+``summary()`` returning a plain dict and ``log(logger, prefix)`` emitting
+through :func:`~mmlspark_tpu.core.profiling.get_logger`, exactly like
+:class:`~mmlspark_tpu.core.profiling.StopWatch` (aggregate queue-wait/run
+phase times ride an embedded StopWatch, so existing log tooling applies).
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import threading
+from typing import Dict, Optional
+
+from mmlspark_tpu.core.profiling import StopWatch, get_logger
+
+
+class RuntimeMetrics:
+    """Thread-safe counters/timings for one scheduler (accumulates across
+    jobs when the scheduler is reused, e.g. the serving dispatch loop)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.stopwatch = StopWatch()  # aggregate "queue_wait"/"run" phases
+        #: task index -> {"queue_wait": s, "run": s, "attempts": n}
+        self.task_timings: Dict[int, Dict[str, float]] = {}
+        self.retries: "collections.Counter[int]" = collections.Counter()
+        self.counters: "collections.Counter[str]" = collections.Counter()
+        self.max_queue_depth = 0
+
+    # -- recording (called by the scheduler/executors) ----------------------
+
+    def note_dispatch(self, index: int, queue_depth: int) -> None:
+        with self._lock:
+            self.counters["dispatches"] += 1
+            self.max_queue_depth = max(self.max_queue_depth, queue_depth)
+
+    def note_start(self, index: int, queue_wait: float) -> None:
+        with self._lock:
+            t = self.task_timings.setdefault(
+                index, {"queue_wait": 0.0, "run": 0.0, "attempts": 0}
+            )
+            t["queue_wait"] += queue_wait
+            t["attempts"] += 1
+        self._accumulate_phase("queue_wait", queue_wait)
+
+    def note_done(self, index: int, run_seconds: float) -> None:
+        with self._lock:
+            t = self.task_timings.setdefault(
+                index, {"queue_wait": 0.0, "run": 0.0, "attempts": 1}
+            )
+            t["run"] += run_seconds
+            self.counters["tasks_done"] += 1
+        self._accumulate_phase("run", run_seconds)
+
+    def _accumulate_phase(self, phase: str, seconds: float) -> None:
+        # StopWatch only accumulates through measure(); fold externally
+        # timed spans into the same phase table so sw.log()/summary() work
+        totals = self.stopwatch._totals
+        totals[phase] = totals.get(phase, 0.0) + seconds
+
+    def note_retry(self, index: int) -> None:
+        with self._lock:
+            self.retries[index] += 1
+            self.counters["retries_total"] += 1
+
+    def note_failure(self, index: int, reason: str) -> None:
+        """reason: 'error' | 'executor_death' | 'timeout' | 'heartbeat'."""
+        with self._lock:
+            self.counters["failures_total"] += 1
+            self.counters[f"failures_{reason}"] += 1
+
+    def note_recompute(self, index: int) -> None:
+        with self._lock:
+            self.counters["lineage_recomputes"] += 1
+
+    def note_wasted_result(self) -> None:
+        """A superseded attempt (timeout / heartbeat loss) reported late;
+        its result was discarded."""
+        with self._lock:
+            self.counters["wasted_results"] += 1
+
+    # -- reporting (core/profiling conventions) -----------------------------
+
+    @property
+    def retries_total(self) -> int:
+        return self.counters["retries_total"]
+
+    def summary(self) -> dict:
+        with self._lock:
+            return {
+                "tasks_done": self.counters["tasks_done"],
+                "dispatches": self.counters["dispatches"],
+                "retries_total": self.counters["retries_total"],
+                "failures_total": self.counters["failures_total"],
+                "failures_error": self.counters["failures_error"],
+                "failures_heartbeat": self.counters["failures_heartbeat"],
+                "failures_timeout": self.counters["failures_timeout"],
+                "failures_executor_death": self.counters["failures_executor_death"],
+                "lineage_recomputes": self.counters["lineage_recomputes"],
+                "wasted_results": self.counters["wasted_results"],
+                "max_queue_depth": self.max_queue_depth,
+                "phases": self.stopwatch.summary(),
+                "per_task": {i: dict(t) for i, t in self.task_timings.items()},
+                "retries_per_task": dict(self.retries),
+            }
+
+    def log(self, logger: Optional[logging.Logger] = None, prefix: str = "") -> None:
+        logger = logger or get_logger("mmlspark_tpu.runtime")
+        s = self.summary()
+        logger.info(
+            "%stasks=%d dispatches=%d retries=%d failures=%d "
+            "(heartbeat=%d timeout=%d death=%d) recomputes=%d "
+            "max_queue_depth=%d",
+            prefix, s["tasks_done"], s["dispatches"], s["retries_total"],
+            s["failures_total"], s["failures_heartbeat"], s["failures_timeout"],
+            s["failures_executor_death"], s["lineage_recomputes"],
+            s["max_queue_depth"],
+        )
+        self.stopwatch.log(logger, prefix=prefix)

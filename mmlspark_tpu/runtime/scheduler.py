@@ -1,0 +1,426 @@
+"""Partitioned-job scheduler — the driver half of the runtime.
+
+Reproduces the slice of Spark's driver that MMLSpark actually leaned on:
+a partitioned job is N independent tasks, each walking
+``PENDING -> RUNNING -> DONE | FAILED`` with bounded retries, exponential
+backoff with *seeded* jitter (two runs with the same policy seed back off
+identically — fault tests stay deterministic), per-task timeouts,
+heartbeat-loss re-dispatch, and lineage-based recompute of lost
+partitions. Results always come back in task-index order regardless of
+completion order, so a partitioned computation is a drop-in replacement
+for its inline loop — bit-identical output, which is what the
+fault-injected ``fit`` parity tests assert.
+
+The driver loop runs in the caller's thread: it dispatches due tasks,
+then waits on the job condition with a heartbeat-interval timeout, and on
+every wake scans RUNNING attempts for per-task timeout and stale
+heartbeats. A lost attempt is *superseded* (its late result, if any, is
+discarded), its worker is declared lost, and the task is re-queued.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import enum
+import threading
+import time
+from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence, Union
+
+import numpy as np
+
+from mmlspark_tpu.core.profiling import get_logger
+from mmlspark_tpu.runtime.executor import ExecutorPool
+from mmlspark_tpu.runtime.faults import FaultPlan, current_faults
+from mmlspark_tpu.runtime.lineage import Lineage, PartitionLostError, ShardLineage
+from mmlspark_tpu.runtime.metrics import RuntimeMetrics
+
+logger = get_logger("mmlspark_tpu.runtime")
+
+
+class TaskState(enum.Enum):
+    PENDING = "pending"
+    RUNNING = "running"
+    DONE = "done"
+    FAILED = "failed"
+
+
+class TaskLostError(RuntimeError):
+    """Driver-side verdict on a running attempt: per-task timeout exceeded
+    or the executor's heartbeat went stale. Counts against the retry
+    budget like any task failure."""
+
+
+class JobFailedError(RuntimeError):
+    """A task exhausted its retry budget; the whole job fails (Spark
+    semantics: ``spark.task.maxFailures`` exceeded aborts the stage)."""
+
+
+@dataclasses.dataclass
+class SchedulerPolicy:
+    """Retry/timeout/backoff knobs for one partitioned job (the analog of
+    ``spark.task.maxFailures`` / ``spark.network.timeout`` et al.)."""
+
+    max_workers: int = 4
+    #: re-dispatches allowed per task beyond the first attempt
+    max_retries: int = 2
+    backoff_base: float = 0.05
+    backoff_factor: float = 2.0
+    #: jitter fraction; the jitter draw is seeded per (seed, task, failure)
+    backoff_jitter: float = 0.25
+    backoff_max: float = 5.0
+    #: wall-clock limit per attempt; None disables
+    task_timeout: Optional[float] = None
+    heartbeat_interval: float = 0.05
+    #: a worker whose last beat is older than this is declared lost
+    heartbeat_timeout: float = 1.0
+    seed: int = 0
+    #: explicit fault plan; falls back to faults.current_faults()
+    faults: Optional[FaultPlan] = None
+
+    def backoff(self, index: int, failures: int) -> float:
+        """Delay before re-dispatching ``index`` after its ``failures``-th
+        failure. Deterministic: jitter comes from an RNG seeded with
+        ``(policy.seed, index, failures)``."""
+        base = min(
+            self.backoff_max,
+            self.backoff_base * self.backoff_factor ** max(0, failures - 1),
+        )
+        jitter = np.random.default_rng((self.seed, index, failures)).random()
+        return base * (1.0 + self.backoff_jitter * jitter)
+
+
+@dataclasses.dataclass
+class TaskRecord:
+    index: int
+    payload: Any
+    state: TaskState = TaskState.PENDING
+    attempt: int = -1  # id of the latest attempt
+    failures: int = 0
+    result: Any = None
+    error: Optional[BaseException] = None
+    not_before: float = 0.0  # monotonic time before which we won't re-dispatch
+    needs_recompute: bool = False
+
+
+class _Attempt:
+    """One dispatch of one task; the unit the executor pool runs."""
+
+    def __init__(self, job: "_Job", task: TaskRecord, attempt_id: int):
+        self.job = job
+        self.task = task
+        self.id = attempt_id
+        #: 0-based per-task attempt number (what FaultPlan keys on)
+        self.task_attempt = task.failures
+        self.superseded = threading.Event()
+        self.worker = None
+        self.dispatched_at = time.monotonic()
+        self.started_at: Optional[float] = None
+
+    # -- executor-side hooks -------------------------------------------------
+
+    def mark_started(self, worker) -> None:
+        self.worker = worker
+        self.started_at = time.monotonic()
+        self.job.metrics.note_start(
+            self.task.index, self.started_at - self.dispatched_at
+        )
+
+    def execute(self, worker) -> Any:
+        plan = self.job.policy.faults or current_faults()
+        if plan is not None:
+            plan.apply_on_start(
+                self.task.index,
+                self.task_attempt,
+                worker=worker,
+                superseded=self.superseded,
+            )
+        payload = self.task.payload
+        if isinstance(payload, ShardLineage):
+            payload = payload.materialize()
+        return self.job.fn(payload)
+
+    def report_success(self, result: Any) -> None:
+        self.job._on_success(self, result)
+
+    def report_failure(self, err: BaseException, executor_died: bool = False) -> None:
+        self.job._on_failure(self, err, executor_died)
+
+
+class _Job:
+    """Driver-side state of one partitioned job."""
+
+    def __init__(
+        self,
+        fn: Callable[[Any], Any],
+        shards: Sequence[Any],
+        policy: SchedulerPolicy,
+        metrics: RuntimeMetrics,
+        lineage: Optional[Lineage],
+    ):
+        self.fn = fn
+        self.policy = policy
+        self.metrics = metrics
+        self.lineage = lineage
+        self.tasks = [TaskRecord(i, payload) for i, payload in enumerate(shards)]
+        self.cond = threading.Condition()
+        self.pending = set(range(len(self.tasks)))
+        self.running: Dict[int, _Attempt] = {}
+        self.done_count = 0
+        self.failed: List[TaskRecord] = []
+        self._attempt_ids = 0
+
+    def finished(self) -> bool:
+        return self.done_count + len(self.failed) == len(self.tasks)
+
+    def next_attempt_id(self) -> int:
+        aid = self._attempt_ids
+        self._attempt_ids += 1
+        return aid
+
+    # -- completion callbacks (worker threads) -------------------------------
+
+    def _is_current(self, att: _Attempt) -> bool:
+        return (
+            not att.superseded.is_set()
+            and self.running.get(att.task.index) is att
+        )
+
+    def _on_success(self, att: _Attempt, result: Any) -> None:
+        with self.cond:
+            if not self._is_current(att):
+                self.metrics.note_wasted_result()
+                return
+            t = att.task
+            del self.running[t.index]
+            t.state = TaskState.DONE
+            t.result = result
+            self.done_count += 1
+            self.metrics.note_done(t.index, time.monotonic() - (att.started_at or att.dispatched_at))
+            self.cond.notify_all()
+
+    def _on_failure(self, att: _Attempt, err: BaseException, executor_died: bool) -> None:
+        with self.cond:
+            if not self._is_current(att):
+                self.metrics.note_wasted_result()
+                return
+            t = att.task
+            del self.running[t.index]
+            self._register_failure(
+                t, err, "executor_death" if executor_died else "error"
+            )
+            self.cond.notify_all()
+
+    def _register_failure(self, t: TaskRecord, err: BaseException, reason: str) -> None:
+        """Book a failure against ``t`` and either re-queue or fail it.
+        Caller holds ``self.cond``."""
+        t.failures += 1
+        self.metrics.note_failure(t.index, reason)
+        if (
+            isinstance(err, PartitionLostError)
+            and self.lineage is not None
+            and self.lineage.has(t.index)
+        ):
+            t.needs_recompute = True
+        if t.failures > self.policy.max_retries:
+            t.state = TaskState.FAILED
+            t.error = err
+            self.failed.append(t)
+            logger.warning(
+                "task %d failed permanently after %d attempts (%s): %s",
+                t.index, t.failures, reason, err,
+            )
+        else:
+            self.metrics.note_retry(t.index)
+            t.state = TaskState.PENDING
+            t.not_before = time.monotonic() + self.policy.backoff(t.index, t.failures)
+            self.pending.add(t.index)
+            logger.info(
+                "task %d attempt failed (%s); retry %d/%d after backoff",
+                t.index, reason, t.failures, self.policy.max_retries,
+            )
+
+
+class Scheduler:
+    """Driver for partitioned jobs over an :class:`ExecutorPool`.
+
+    Reusable across jobs (the serving dispatch loop keeps one alive);
+    metrics accumulate across runs. If no pool is supplied the scheduler
+    owns one sized by the policy and :meth:`close` shuts it down.
+    """
+
+    def __init__(
+        self,
+        pool: Optional[ExecutorPool] = None,
+        policy: Optional[SchedulerPolicy] = None,
+        metrics: Optional[RuntimeMetrics] = None,
+    ):
+        self.policy = policy or current_policy() or SchedulerPolicy()
+        self.metrics = metrics or RuntimeMetrics()
+        self._owns_pool = pool is None
+        self.pool = pool or ExecutorPool(
+            self.policy.max_workers,
+            heartbeat_interval=self.policy.heartbeat_interval,
+        )
+
+    # -- driver loop ---------------------------------------------------------
+
+    def run(
+        self,
+        fn: Callable[[Any], Any],
+        shards: Sequence[Any],
+        *,
+        lineage: Optional[Lineage] = None,
+    ) -> List[Any]:
+        """Run ``fn`` over every shard; return results in shard order.
+
+        Raises :class:`JobFailedError` if any task exhausts its retry
+        budget (partial results are discarded, Spark stage-abort style).
+        """
+        shards = list(shards)
+        if not shards:
+            return []
+        job = _Job(fn, shards, self.policy, self.metrics, lineage)
+        while True:
+            with job.cond:
+                if job.finished():
+                    break
+                now = time.monotonic()
+                self._dispatch_due(job, now)
+                self._monitor(job, now)
+                timeout = self._wait_timeout(job, now)
+                job.cond.wait(timeout)
+            # Replace any executor that died (ExecutorDeathError exit) or
+            # was declared lost (stale heartbeat) — outside the job lock,
+            # since spawning threads under it serves nothing.
+            if self.pool.alive_count < self.pool.target_workers:
+                self.pool.ensure_capacity()
+        if job.failed:
+            first = job.failed[0]
+            raise JobFailedError(
+                f"{len(job.failed)}/{len(job.tasks)} tasks failed permanently; "
+                f"first: task {first.index} after {first.failures} attempts"
+            ) from first.error
+        return [t.result for t in job.tasks]
+
+    def _dispatch_due(self, job: _Job, now: float) -> None:
+        """Submit every pending task whose backoff has elapsed. Caller
+        holds ``job.cond``."""
+        for index in sorted(job.pending):
+            t = job.tasks[index]
+            if t.not_before > now:
+                continue
+            if t.needs_recompute and job.lineage is not None:
+                t.payload = job.lineage.recompute(index)
+                t.needs_recompute = False
+                self.metrics.note_recompute(index)
+                logger.info("task %d: recomputed lost partition from lineage", index)
+            job.pending.discard(index)
+            att = _Attempt(job, t, job.next_attempt_id())
+            t.attempt = att.id
+            t.state = TaskState.RUNNING
+            job.running[index] = att
+            self.metrics.note_dispatch(index, self.pool.queue_depth() + 1)
+            self.pool.submit(att)
+
+    def _monitor(self, job: _Job, now: float) -> bool:
+        """Scan RUNNING attempts for per-task timeout and heartbeat loss;
+        supersede and re-queue offenders. Caller holds ``job.cond``.
+        Returns True if a worker was declared lost."""
+        lost = False
+        timeout = self.policy.task_timeout
+        for index, att in list(job.running.items()):
+            t = att.task
+            if (
+                timeout is not None
+                and att.started_at is not None
+                and now - att.started_at > timeout
+            ):
+                att.superseded.set()
+                del job.running[index]
+                job._register_failure(
+                    t,
+                    TaskLostError(
+                        f"task {index} attempt {att.id} exceeded "
+                        f"task_timeout={timeout:g}s"
+                    ),
+                    "timeout",
+                )
+            elif (
+                att.worker is not None
+                and now - att.worker.last_beat > self.policy.heartbeat_timeout
+            ):
+                att.superseded.set()
+                del job.running[index]
+                self.pool.declare_lost(att.worker)
+                lost = True
+                job._register_failure(
+                    t,
+                    TaskLostError(
+                        f"executor running task {index} attempt {att.id} missed "
+                        f"heartbeats for > {self.policy.heartbeat_timeout:g}s"
+                    ),
+                    "heartbeat",
+                )
+        return lost
+
+    def _wait_timeout(self, job: _Job, now: float) -> float:
+        """How long the driver may sleep: until the next backoff expiry,
+        capped at a heartbeat interval so monitoring stays responsive."""
+        timeout = self.policy.heartbeat_interval
+        for index in job.pending:
+            delta = job.tasks[index].not_before - now
+            if 0 < delta < timeout:
+                timeout = delta
+        return max(timeout, 0.001)
+
+    def close(self) -> None:
+        if self._owns_pool:
+            self.pool.shutdown()
+
+    def __enter__(self) -> "Scheduler":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def run_partitioned(
+    fn: Callable[[Any], Any],
+    shards: Sequence[Any],
+    policy: Optional[SchedulerPolicy] = None,
+    *,
+    lineage: Optional[Lineage] = None,
+    pool: Optional[ExecutorPool] = None,
+    metrics: Optional[RuntimeMetrics] = None,
+) -> List[Any]:
+    """Run ``fn`` over ``shards`` on a fault-tolerant scheduler; results
+    come back in shard order. The one-call public entry point."""
+    with Scheduler(pool=pool, policy=policy, metrics=metrics) as sched:
+        return sched.run(fn, shards, lineage=lineage)
+
+
+# -- ambient policy (reaches schedulers created inside fit/serve calls) ------
+
+_POLICY_STACK: List[SchedulerPolicy] = []
+
+
+@contextlib.contextmanager
+def policy(
+    policy_or_none: Optional[SchedulerPolicy] = None, **kwargs: Any
+) -> Iterator[SchedulerPolicy]:
+    """Make a :class:`SchedulerPolicy` ambient: estimators/servers that
+    build their own scheduler pick it up without API threading.
+
+    ``with runtime.policy(max_workers=8, max_retries=3): est.fit(...)``
+    """
+    p = policy_or_none if policy_or_none is not None else SchedulerPolicy(**kwargs)
+    _POLICY_STACK.append(p)
+    try:
+        yield p
+    finally:
+        _POLICY_STACK.remove(p)
+
+
+def current_policy() -> Optional[SchedulerPolicy]:
+    return _POLICY_STACK[-1] if _POLICY_STACK else None

@@ -91,6 +91,7 @@ class _BatchLoop:
         max_batch_size: int,
         max_latency_ms: float,
         max_retries: int = 1,
+        scheduler=None,
     ):
         self.model = model
         self.input_col = input_col
@@ -98,6 +99,10 @@ class _BatchLoop:
         self.max_batch_size = int(max_batch_size)
         self.max_latency_ms = float(max_latency_ms)
         self.max_retries = int(max_retries)
+        #: optional mmlspark_tpu.runtime.Scheduler — when set, each
+        #: micro-batch is applied as partitioned tasks with retry /
+        #: heartbeat re-dispatch (the Spark-executor dispatch analog)
+        self.scheduler = scheduler
         self.queue: "queue.Queue[_PendingRequest]" = queue.Queue()
         self._epoch = 0
         self._history: Dict[int, List[_PendingRequest]] = {}  # uncommitted epochs
@@ -144,9 +149,29 @@ class _BatchLoop:
         return batch
 
     def _apply_model(self, table: Table) -> Table:
-        if isinstance(self.model, Transformer):
-            return self.model.transform(table)
-        return self.model(table)
+        apply = (
+            self.model.transform if isinstance(self.model, Transformer)
+            else self.model
+        )
+        if self.scheduler is None:
+            return apply(table)
+        # Scheduler-backed dispatch: split the micro-batch across executor
+        # tasks; an executor dying mid-batch retries its partition, and
+        # results reassemble in request order, so the caller sees one
+        # ordinary (fault-absorbed) response set.
+        col = table.column(self.input_col)
+        k = max(1, min(self.scheduler.policy.max_workers, len(col)))
+        bounds = np.linspace(0, len(col), k + 1).astype(int)
+        shards = [
+            Table({self.input_col: col[lo:hi]})
+            for lo, hi in zip(bounds[:-1], bounds[1:])
+            if hi > lo
+        ]
+        parts = self.scheduler.run(apply, shards)
+        out = np.concatenate(
+            [np.asarray(p.column(self.output_col)) for p in parts]
+        )
+        return Table({self.output_col: out})
 
     def _process(self, batch: List[_PendingRequest]) -> None:
         epoch = self._epoch
@@ -433,11 +458,24 @@ class DistributedServingServer:
         max_latency_ms: float = 2.0,
         max_retries: int = 1,
         base_port: int = 0,
+        num_executors: int = 0,
+        executor_policy=None,
         **kwargs,
     ):
+        # num_executors > 0 (or an ambient runtime.policy() / explicit
+        # executor_policy) routes every micro-batch through the
+        # fault-tolerant partition scheduler: the Spark-cluster posture
+        # where batch evaluation runs on executors the driver can lose.
+        self.scheduler = None
+        from mmlspark_tpu import runtime
+
+        pol = executor_policy or runtime.current_policy()
+        if num_executors > 0 or pol is not None:
+            pol = pol or runtime.SchedulerPolicy(max_workers=num_executors)
+            self.scheduler = runtime.Scheduler(policy=pol)
         self.loop = _BatchLoop(
             model, input_col, output_col, max_batch_size, max_latency_ms,
-            max_retries,
+            max_retries, scheduler=self.scheduler,
         )
         # base_port > 0: listeners bind base_port, base_port+1, ... (the
         # deployable layout — k8s Services need declared ports); 0 keeps
@@ -489,6 +527,11 @@ class DistributedServingServer:
         self.loop.stop()
         for s in self.servers:
             s.stop()
+        if self.scheduler is not None:
+            # graceful executor drain, then teardown (Spark's
+            # decommission-before-stop)
+            self.scheduler.pool.drain(timeout=5.0)
+            self.scheduler.close()
 
     def __enter__(self) -> "DistributedServingServer":
         return self.start()

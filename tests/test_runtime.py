@@ -1,0 +1,346 @@
+"""mmlspark_tpu.runtime — fault-tolerant partition scheduler tests.
+
+Every fault here is *injected deterministically* (seeded FaultPlan keyed
+on (task, attempt)), so each test asserts one specific recovery sequence:
+the fault fired (``plan.fired``), the job survived it, and — for the
+fit-parity tests — the output is bit-identical to the clean run.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu import runtime
+from mmlspark_tpu.data import Table
+from mmlspark_tpu.lightgbm import LightGBMClassifier
+
+# tight-but-safe knobs: fast heartbeats, near-zero backoff
+FAST = dict(backoff_base=0.01, heartbeat_interval=0.02)
+
+
+def fast_policy(**kw):
+    merged = dict(FAST)
+    merged.update(kw)
+    return runtime.SchedulerPolicy(**merged)
+
+
+# ---------------------------------------------------------------------------
+# scheduler core
+# ---------------------------------------------------------------------------
+
+
+def test_run_partitioned_happy_path():
+    out = runtime.run_partitioned(
+        lambda x: x * 10, list(range(8)), fast_policy(max_workers=4)
+    )
+    assert out == [x * 10 for x in range(8)]
+
+
+def test_results_ordered_despite_stragglers():
+    # task 0 finishes LAST; results still come back in shard order
+    def work(x):
+        if x == 0:
+            time.sleep(0.2)
+        return x + 100
+
+    out = runtime.run_partitioned(work, [0, 1, 2, 3], fast_policy(max_workers=4))
+    assert out == [100, 101, 102, 103]
+
+
+def test_retry_on_transient_failure():
+    attempts = {"n": 0}
+    lock = threading.Lock()
+
+    def flaky(x):
+        with lock:
+            attempts["n"] += 1
+            if attempts["n"] == 1:
+                raise ValueError("transient")
+        return x
+
+    m = runtime.RuntimeMetrics()
+    out = runtime.run_partitioned(
+        flaky, [7], fast_policy(max_workers=1), metrics=m
+    )
+    assert out == [7]
+    assert m.retries_total == 1
+    assert m.summary()["failures_error"] == 1
+
+
+def test_retry_exhaustion_fails_job():
+    pol = fast_policy(max_workers=1, max_retries=2)
+    m = runtime.RuntimeMetrics()
+    with pytest.raises(runtime.JobFailedError):
+        runtime.run_partitioned(
+            lambda x: (_ for _ in ()).throw(ValueError("always")),
+            [1], pol, metrics=m,
+        )
+    # 1 initial + 2 retries, all failed
+    assert m.summary()["failures_error"] == 3
+    assert m.retries_total == 2
+
+
+def test_backoff_policy_deterministic_and_bounded():
+    p = runtime.SchedulerPolicy(
+        seed=42, backoff_base=0.1, backoff_factor=2.0, backoff_jitter=0.25,
+        backoff_max=1.0,
+    )
+    # same (seed, task, failure) -> identical delay; different seed differs
+    assert p.backoff(3, 2) == runtime.SchedulerPolicy(
+        seed=42, backoff_base=0.1, backoff_factor=2.0, backoff_jitter=0.25,
+        backoff_max=1.0,
+    ).backoff(3, 2)
+    assert p.backoff(3, 2) != runtime.SchedulerPolicy(
+        seed=43, backoff_base=0.1, backoff_factor=2.0, backoff_jitter=0.25,
+        backoff_max=1.0,
+    ).backoff(3, 2)
+    # exponential envelope: base * factor^(k-1), plus at most 25% jitter
+    for k, expect in ((1, 0.1), (2, 0.2), (3, 0.4)):
+        d = p.backoff(0, k)
+        assert expect <= d <= expect * 1.25
+    # capped at backoff_max (+ jitter)
+    assert p.backoff(0, 30) <= 1.0 * 1.25
+
+
+def test_empty_job():
+    assert runtime.run_partitioned(lambda x: x, []) == []
+
+
+# ---------------------------------------------------------------------------
+# fault injection
+# ---------------------------------------------------------------------------
+
+
+def test_executor_death_retries_and_replaces_worker():
+    plan = runtime.FaultPlan(seed=7).kill_task(2)
+    m = runtime.RuntimeMetrics()
+    out = runtime.run_partitioned(
+        lambda x: x * 2, [0, 1, 2, 3],
+        fast_policy(max_workers=2, faults=plan), metrics=m,
+    )
+    assert out == [0, 2, 4, 6]
+    assert plan.fired == [("kill", 2, 0)]
+    s = m.summary()
+    assert s["failures_executor_death"] == 1
+    assert s["retries_total"] == 1
+    assert s["retries_per_task"] == {2: 1}
+
+
+def test_executor_death_with_single_worker_respawns():
+    # the ONLY worker dies; the driver must notice and spawn a replacement
+    # to run the retry (no surviving executor to fall back on)
+    plan = runtime.FaultPlan().kill_task(0)
+    out = runtime.run_partitioned(
+        lambda x: x + 1, [1, 2], fast_policy(max_workers=1, faults=plan)
+    )
+    assert out == [2, 3]
+    assert plan.fired == [("kill", 0, 0)]
+
+
+def test_kill_random_task_is_seeded():
+    v1 = runtime.FaultPlan(seed=5).kill_random_task(32)
+    v2 = runtime.FaultPlan(seed=5).kill_random_task(32)
+    assert v1._kill.keys() == v2._kill.keys()
+
+
+def test_heartbeat_loss_redispatch():
+    # The executor running task 0 stops heartbeating and hangs; the driver
+    # must declare it lost, re-dispatch task 0 elsewhere, and finish.
+    plan = runtime.FaultPlan(seed=3).drop_heartbeat(0)
+    m = runtime.RuntimeMetrics()
+    pol = fast_policy(
+        max_workers=2, faults=plan, heartbeat_timeout=0.15
+    )
+    out = runtime.run_partitioned(lambda x: x + 1, [10, 20, 30], pol, metrics=m)
+    assert out == [11, 21, 31]
+    assert ("drop_heartbeat", 0, 0) in plan.fired
+    s = m.summary()
+    assert s["failures_heartbeat"] == 1
+    assert s["retries_total"] >= 1
+
+
+def test_task_timeout_redispatch():
+    plan = runtime.FaultPlan().delay_task(1, 0.5)
+    m = runtime.RuntimeMetrics()
+    pol = fast_policy(max_workers=2, faults=plan, task_timeout=0.1)
+    out = runtime.run_partitioned(lambda x: x, [5, 6], pol, metrics=m)
+    assert out == [5, 6]
+    assert m.summary()["failures_timeout"] == 1
+
+
+def test_inject_faults_is_ambient():
+    plan = runtime.FaultPlan(seed=1).kill_task(0)
+    with runtime.inject_faults(plan) as p:
+        assert runtime.current_faults() is p
+        out = runtime.run_partitioned(
+            lambda x: -x, [1, 2], fast_policy(max_workers=2)
+        )
+    assert runtime.current_faults() is None
+    assert out == [-1, -2] and plan.fired
+
+
+# ---------------------------------------------------------------------------
+# lineage
+# ---------------------------------------------------------------------------
+
+
+def test_lineage_recompute_on_lost_partition():
+    lin = runtime.Lineage()
+    lin.record(0, lambda: 40, lambda v: v + 2, describe="40+2")
+    first = {"seen": False}
+    lock = threading.Lock()
+
+    def work(x):
+        with lock:
+            if not first["seen"]:
+                first["seen"] = True
+                raise runtime.PartitionLostError("input buffer evicted")
+        return x * 2
+
+    m = runtime.RuntimeMetrics()
+    out = runtime.run_partitioned(
+        work, [lin._shards[0]], fast_policy(max_workers=1),
+        lineage=lin, metrics=m,
+    )
+    assert out == [84]
+    assert lin.recomputes[0] == 1
+    assert m.summary()["lineage_recomputes"] == 1
+
+
+def test_lineage_materialize_order():
+    shard = runtime.ShardLineage(
+        source=lambda: [1, 2], transforms=(sorted, tuple)
+    )
+    assert shard.materialize() == (1, 2)
+
+
+# ---------------------------------------------------------------------------
+# metrics
+# ---------------------------------------------------------------------------
+
+
+def test_metrics_per_task_timings_and_retries():
+    plan = runtime.FaultPlan().kill_task(1)
+    m = runtime.RuntimeMetrics()
+    runtime.run_partitioned(
+        lambda x: x, [0, 1, 2], fast_policy(max_workers=2, faults=plan),
+        metrics=m,
+    )
+    s = m.summary()
+    assert s["tasks_done"] == 3
+    assert s["dispatches"] == 4  # 3 tasks + 1 retry
+    assert set(s["per_task"]) == {0, 1, 2}
+    for t in s["per_task"].values():
+        assert t["attempts"] >= 1 and t["run"] >= 0.0 and t["queue_wait"] >= 0.0
+    assert s["per_task"][1]["attempts"] == 2
+    assert s["retries_per_task"] == {1: 1}
+    # phase aggregates ride the embedded StopWatch (core/profiling shape)
+    assert set(s["phases"]) >= {"queue_wait", "run"}
+
+
+# ---------------------------------------------------------------------------
+# fault-injected fit parity (the acceptance bar)
+# ---------------------------------------------------------------------------
+
+
+def _fit_table(n=400, f=6, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f))
+    y = (X[:, 0] + 0.5 * X[:, 1] + 0.1 * rng.normal(size=n) > 0).astype(
+        np.float64
+    )
+    return Table({"features": X, "label": y}), X, y
+
+
+def _auc(y, score):
+    order = np.argsort(score)
+    ranks = np.empty(len(y))
+    ranks[order] = np.arange(1, len(y) + 1)
+    pos = y > 0
+    n_pos, n_neg = pos.sum(), (~pos).sum()
+    return (ranks[pos].sum() - n_pos * (n_pos + 1) / 2) / (n_pos * n_neg)
+
+
+def test_fault_injected_fit_bit_identical():
+    """A seeded executor kill mid-fit (binning runs on the scheduler) must
+    retry/recompute and yield bit-identical model text to the clean run."""
+    table, X, y = _fit_table()
+
+    def estimator():
+        return LightGBMClassifier(
+            numIterations=10, numLeaves=7, parallelism="serial", seed=3,
+        )
+
+    clean = estimator().fit(table)
+    clean_text = clean.booster.model_to_string()
+
+    plan = runtime.FaultPlan(seed=11).kill_random_task(3)
+    est = estimator().setNumExecutors(3)
+    with runtime.inject_faults(plan):
+        faulted = est.fit(table)
+
+    assert plan.fired and plan.fired[0][0] == "kill"
+    assert faulted.booster.model_to_string() == clean_text
+    # runtime metrics observed the death + retry
+    s = est._runtime_metrics.summary()
+    assert s["failures_executor_death"] >= 1 and s["retries_total"] >= 1
+    # AUC parity follows from model-text parity; assert it end-to-end anyway
+    auc_clean = _auc(y, clean.booster.raw_margin(X).ravel())
+    auc_fault = _auc(y, faulted.booster.raw_margin(X).ravel())
+    assert auc_fault == auc_clean
+
+
+def test_heartbeat_loss_during_fit_bit_identical():
+    """The network-partitioned-executor variant: suppressed heartbeats on a
+    binning task must re-dispatch and still produce the clean model."""
+    table, _, _ = _fit_table(n=300)
+
+    def estimator():
+        return LightGBMClassifier(
+            numIterations=8, numLeaves=7, parallelism="serial", seed=5,
+        )
+
+    clean_text = estimator().fit(table).booster.model_to_string()
+
+    plan = runtime.FaultPlan(seed=2).drop_heartbeat(1)
+    pol = fast_policy(max_workers=2, heartbeat_timeout=0.15, faults=plan)
+    with runtime.policy(pol):
+        faulted = estimator().fit(table)
+    assert ("drop_heartbeat", 1, 0) in plan.fired
+    assert faulted.booster.model_to_string() == clean_text
+
+
+def test_ambient_policy_routes_binning():
+    table, _, _ = _fit_table(n=200)
+    est = LightGBMClassifier(
+        numIterations=5, numLeaves=5, parallelism="serial", seed=1
+    )
+    with runtime.policy(max_workers=2, **FAST):
+        est.fit(table)
+    assert est._runtime_metrics.summary()["tasks_done"] == 2
+
+
+# ---------------------------------------------------------------------------
+# executor pool plumbing
+# ---------------------------------------------------------------------------
+
+
+def test_pool_drain_and_shutdown():
+    pool = runtime.ExecutorPool(2, heartbeat_interval=0.02)
+    try:
+        sched = runtime.Scheduler(pool=pool, policy=fast_policy(max_workers=2))
+        assert sched.run(lambda x: x, [1, 2, 3]) == [1, 2, 3]
+        assert pool.drain(timeout=2.0)
+    finally:
+        pool.shutdown()
+    with pytest.raises(RuntimeError):
+        pool.submit(object())
+
+
+def test_scheduler_reuse_accumulates_metrics():
+    with runtime.Scheduler(policy=fast_policy(max_workers=2)) as sched:
+        sched.run(lambda x: x, [1, 2])
+        sched.run(lambda x: x, [3, 4, 5])
+    assert sched.metrics.summary()["tasks_done"] == 5

@@ -261,3 +261,74 @@ def test_distributed_base_port_binds_sequential_ports():
         assert ports == [28990, 28991]
         status, out = _post(srv.service_info[1].url, {"input": 4.0})
         assert status == 200 and out["prediction"] == 8.0
+
+
+class TestSchedulerBackedDispatch:
+    """DistributedServingServer routed through mmlspark_tpu.runtime — the
+    Spark-cluster posture where micro-batches evaluate on executors the
+    driver can lose (and replace) without a client ever seeing it."""
+
+    def _policy(self, **kw):
+        from mmlspark_tpu import runtime
+
+        base = dict(max_workers=2, backoff_base=0.01, heartbeat_interval=0.02)
+        base.update(kw)
+        return runtime.SchedulerPolicy(**base)
+
+    def test_num_executors_routes_batches_through_scheduler(self):
+        srv = DistributedServingServer(
+            _Doubler(), num_servers=2, num_executors=2, max_latency_ms=1.0
+        )
+        with srv:
+            for i, info in enumerate(srv.service_info):
+                status, out = _post(info.url, {"input": float(i)})
+                assert status == 200 and out["prediction"] == i * 2.0
+        assert srv.scheduler is not None
+        assert srv.scheduler.metrics.summary()["tasks_done"] >= 2
+
+    def test_injected_executor_death_absorbed(self):
+        """An executor killed mid-batch retries its partition; the client
+        still gets 200 with the right answer, and metrics show the death."""
+        from mmlspark_tpu import runtime
+
+        plan = runtime.FaultPlan(seed=9).kill_task(0)
+        srv = DistributedServingServer(
+            _Doubler(), num_servers=1, num_executors=2,
+            executor_policy=self._policy(faults=plan), max_latency_ms=1.0,
+        )
+        with srv:
+            status, out = _post(srv.service_info[0].url, {"input": 21.0})
+            assert status == 200 and out["prediction"] == 42.0
+        assert plan.fired == [("kill", 0, 0)]
+        s = srv.scheduler.metrics.summary()
+        assert s["failures_executor_death"] == 1 and s["retries_total"] == 1
+
+    def test_ambient_policy_activates_scheduler(self):
+        from mmlspark_tpu import runtime
+
+        with runtime.policy(max_workers=2, backoff_base=0.01):
+            srv = DistributedServingServer(
+                _Doubler(), num_servers=1, max_latency_ms=1.0
+            )
+        with srv:
+            status, out = _post(srv.service_info[0].url, {"input": 3.0})
+            assert status == 200 and out["prediction"] == 6.0
+        assert srv.scheduler is not None
+
+    def test_batch_split_preserves_request_order(self):
+        """A >1-request micro-batch splits across executor tasks; replies
+        must route back to the right requester."""
+        srv = DistributedServingServer(
+            _Doubler(), num_servers=2, num_executors=3,
+            max_batch_size=16, max_latency_ms=30.0,
+        )
+        with srv:
+            urls = [i.url for i in srv.service_info]
+            with ThreadPoolExecutor(max_workers=8) as ex:
+                futs = [
+                    ex.submit(_post, urls[k % len(urls)], {"input": float(k)})
+                    for k in range(24)
+                ]
+                results = [f.result() for f in futs]
+        for k, (status, out) in enumerate(results):
+            assert status == 200 and out["prediction"] == k * 2.0
