@@ -1,0 +1,47 @@
+"""Framework-aware static analysis (``graftlint``) + pipeline schema checks.
+
+The SparkML side of the reference gets its composability guarantees from
+``transformSchema`` — a mis-wired ``Pipeline`` fails before any executor
+runs. This package is the reproduction's equivalent static layer, with two
+halves:
+
+- :mod:`mmlspark_tpu.analysis.lint` (``graftlint``): an AST-driven linter
+  enforcing the framework's implicit contracts — jit purity, jnp-vs-np in
+  traced code, (8, 128) Pallas tile alignment, lock discipline in the
+  threaded runtime/serving layers, and the bare-except policy. Run as
+  ``python -m mmlspark_tpu.analysis.lint <paths>``.
+- the pipeline schema validator: stages declare ``transform_schema`` and
+  ``Pipeline.validate()`` propagates column schemas through the stage
+  graph at construction time (:mod:`mmlspark_tpu.core.schema`).
+
+Docs: ``docs/static_analysis.md`` (rule catalog, suppression syntax,
+adding a rule).
+"""
+
+from mmlspark_tpu.analysis.base import (
+    FileContext,
+    Rule,
+    Violation,
+    all_rules,
+    register_rule,
+)
+
+__all__ = [
+    "FileContext",
+    "Rule",
+    "Violation",
+    "all_rules",
+    "register_rule",
+    "lint_paths",
+    "lint_source",
+]
+
+
+def __getattr__(name):
+    # Lazy so `python -m mmlspark_tpu.analysis.lint` doesn't trip runpy's
+    # already-in-sys.modules warning by importing the CLI module here.
+    if name in ("lint_paths", "lint_source"):
+        from mmlspark_tpu.analysis import lint
+
+        return getattr(lint, name)
+    raise AttributeError(name)

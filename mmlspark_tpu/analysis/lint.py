@@ -1,0 +1,175 @@
+"""graftlint driver + CLI: ``python -m mmlspark_tpu.analysis.lint <paths>``.
+
+Two-phase run: parse every file first (so the traced-function index sees
+the whole project and cross-module jit reachability works — see
+``analysis/traced.py``), then run every rule over every file, dropping
+findings the source suppresses per line
+(``# graftlint: disable=<rule>``).
+
+Exit status: 0 when clean, 1 on violations (``--fail-on-violation`` is
+accepted for explicitness in CI, it is the default behavior), 2 on usage
+or parse errors.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import Iterable, List, Optional, Sequence, Tuple
+
+from mmlspark_tpu.analysis.base import FileContext, Violation, all_rules
+from mmlspark_tpu.analysis.traced import TracedIndex
+
+_SKIP_DIRS = {".git", "__pycache__", ".venv", "node_modules", "build"}
+
+
+def discover_files(paths: Sequence[str]) -> List[str]:
+    out: List[str] = []
+    for path in paths:
+        if os.path.isfile(path):
+            out.append(path)
+        elif os.path.isdir(path):
+            for root, dirs, files in os.walk(path):
+                dirs[:] = sorted(d for d in dirs if d not in _SKIP_DIRS)
+                out.extend(
+                    os.path.join(root, f)
+                    for f in sorted(files)
+                    if f.endswith(".py")
+                )
+        else:
+            raise FileNotFoundError(path)
+    return out
+
+
+def _load_contexts(
+    files: Iterable[str],
+) -> Tuple[List[FileContext], List[str]]:
+    contexts, errors = [], []
+    for path in files:
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                source = f.read()
+            contexts.append(FileContext(path, source))
+        except (OSError, SyntaxError, ValueError) as e:
+            errors.append(f"{path}: {e}")
+    return contexts, errors
+
+
+def lint_contexts(
+    contexts: List[FileContext],
+    select: Optional[Sequence[str]] = None,
+    ignore: Sequence[str] = (),
+) -> Tuple[List[Violation], int]:
+    """Run the rule set; returns (violations, suppressed_count)."""
+    rules = all_rules()
+    unknown = [
+        r for r in list(select or []) + list(ignore) if r not in rules
+    ]
+    if unknown:
+        raise KeyError(f"unknown rule(s): {', '.join(unknown)}")
+    active = [
+        cls()
+        for name, cls in sorted(rules.items())
+        if (select is None or name in select) and name not in ignore
+    ]
+    index = TracedIndex(contexts)
+    for ctx in contexts:
+        ctx.traced_index = index
+    violations: List[Violation] = []
+    suppressed = 0
+    for ctx in contexts:
+        for rule in active:
+            for v in rule.check(ctx):
+                if ctx.suppressed(v.rule, v.line):
+                    suppressed += 1
+                else:
+                    violations.append(v)
+    violations.sort(key=lambda v: (v.path, v.line, v.col, v.rule))
+    return violations, suppressed
+
+
+def lint_paths(
+    paths: Sequence[str],
+    select: Optional[Sequence[str]] = None,
+    ignore: Sequence[str] = (),
+) -> Tuple[List[Violation], int, List[str]]:
+    """Lint files/directories; returns (violations, suppressed, errors)."""
+    contexts, errors = _load_contexts(discover_files(paths))
+    violations, suppressed = lint_contexts(contexts, select, ignore)
+    return violations, suppressed, errors
+
+
+def lint_source(
+    source: str,
+    path: str = "<string>",
+    select: Optional[Sequence[str]] = None,
+) -> List[Violation]:
+    """Lint one in-memory source string (tests / tooling)."""
+    violations, _ = lint_contexts([FileContext(path, source)], select)
+    return violations
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m mmlspark_tpu.analysis.lint",
+        description="graftlint: framework-aware static analysis "
+        "(docs/static_analysis.md)",
+    )
+    parser.add_argument("paths", nargs="*", help="files or directories")
+    parser.add_argument(
+        "--fail-on-violation",
+        action="store_true",
+        help="exit 1 on violations (the default; accepted for explicit CI "
+        "wiring)",
+    )
+    parser.add_argument(
+        "--select", action="append", default=None, metavar="RULE",
+        help="run only the named rule (repeatable)",
+    )
+    parser.add_argument(
+        "--ignore", action="append", default=[], metavar="RULE",
+        help="skip the named rule (repeatable)",
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true", help="print the rule catalog"
+    )
+    parser.add_argument(
+        "-q", "--quiet", action="store_true",
+        help="print only the summary line",
+    )
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for name, cls in sorted(all_rules().items()):
+            print(f"{name}: {cls.description}")
+        return 0
+    if not args.paths:
+        parser.print_usage(sys.stderr)
+        return 2
+
+    try:
+        violations, suppressed, errors = lint_paths(
+            args.paths, select=args.select, ignore=args.ignore
+        )
+    except (FileNotFoundError, KeyError) as e:
+        print(f"graftlint: {e}", file=sys.stderr)
+        return 2
+
+    for err in errors:
+        print(f"graftlint: parse error: {err}", file=sys.stderr)
+    if not args.quiet:
+        for v in violations:
+            print(v.render())
+    note = f", {suppressed} suppressed" if suppressed else ""
+    print(
+        f"graftlint: {len(violations)} violation(s){note}"
+        + (f", {len(errors)} parse error(s)" if errors else "")
+    )
+    if errors:
+        return 2
+    return 1 if violations else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,376 @@
+"""The builtin graftlint rule set.
+
+Five framework contracts, one rule each — catalog and rationale in
+``docs/static_analysis.md``:
+
+- ``jit-purity``: no host side effects inside traced code.
+- ``numpy-in-traced-code``: ``np.*`` reachable from a trace must be
+  ``jnp.*`` or hoisted to host-side setup.
+- ``pallas-tile-alignment``: literal Pallas block shapes must respect the
+  (8, 128) VPU register tile.
+- ``lock-discipline``: no blocking call while holding a lock in the
+  threaded ``runtime/`` / ``serving/`` layers.
+- ``bare-except-policy``: ``except Exception`` must re-raise, log, or
+  carry an explicit justification.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set
+
+from mmlspark_tpu.analysis.base import (
+    FileContext,
+    Rule,
+    Violation,
+    dotted_name,
+    local_int_constants,
+    module_int_constants,
+    register_rule,
+    resolve_int,
+)
+
+_SUBLANE, _LANE = 8, 128
+
+
+def _traced_defs(ctx: FileContext) -> List[ast.FunctionDef]:
+    """Traced defs from the project-wide index when the driver attached
+    one, else a single-file index (lint_source / unit tests)."""
+    index = getattr(ctx, "traced_index", None)
+    if index is None:
+        from mmlspark_tpu.analysis.traced import TracedIndex
+
+        index = TracedIndex([ctx])
+        ctx.traced_index = index
+    return index.traced_defs(ctx)
+
+
+@register_rule
+class JitPurityRule(Rule):
+    name = "jit-purity"
+    description = (
+        "No wall-clock reads, host RNG, printing, I/O, or global mutation "
+        "inside jit/pallas-traced functions: side effects run once at trace "
+        "time, then silently never again."
+    )
+
+    _BANNED_PREFIXES = {
+        "time.": "wall-clock read executes at trace time only",
+        "random.": "host RNG is frozen at trace time; use jax.random",
+        "np.random.": "host RNG is frozen at trace time; use jax.random",
+        "numpy.random.": "host RNG is frozen at trace time; use jax.random",
+    }
+    _BANNED_CALLS = {
+        "print": "print() runs at trace time only; use jax.debug.print",
+        "input": "blocking host I/O inside traced code",
+        "open": "host file I/O inside traced code",
+    }
+
+    def check(self, ctx: FileContext) -> Iterator[Violation]:
+        for func in _traced_defs(ctx):
+            for node in ast.walk(func):
+                if isinstance(node, ast.Global):
+                    yield self.violation(
+                        ctx, node,
+                        f"global mutation of {', '.join(node.names)!s} inside "
+                        f"traced function '{func.name}' happens at trace time "
+                        "only",
+                    )
+                if not isinstance(node, ast.Call):
+                    continue
+                name = dotted_name(node.func)
+                if name is None:
+                    continue
+                if name in self._BANNED_CALLS:
+                    yield self.violation(
+                        ctx, node,
+                        f"{name}() inside traced function '{func.name}': "
+                        f"{self._BANNED_CALLS[name]}",
+                    )
+                    continue
+                for prefix, why in self._BANNED_PREFIXES.items():
+                    if name.startswith(prefix):
+                        yield self.violation(
+                            ctx, node,
+                            f"{name}() inside traced function "
+                            f"'{func.name}': {why}",
+                        )
+                        break
+
+
+@register_rule
+class NumpyInTracedCodeRule(Rule):
+    name = "numpy-in-traced-code"
+    description = (
+        "np.* calls reachable from jit/pallas-traced code: they break on "
+        "tracers or silently constant-fold; use jnp.* or hoist to host-side "
+        "setup (an lru_cache'd builder is the blessed hoist and is not "
+        "flagged)."
+    )
+
+    # Host-side constant constructors that are fine under trace: dtypes,
+    # scalar casts of static values, and dtype introspection.
+    _ALLOWED_ATTRS = {
+        "dtype", "errstate", "iinfo", "finfo", "can_cast", "result_type",
+        "promote_types",
+        "int8", "int16", "int32", "int64", "uint8", "uint16", "uint32",
+        "uint64", "float16", "float32", "float64", "bool_", "complex64",
+        "complex128", "intp", "uintp", "generic",
+    }
+
+    def check(self, ctx: FileContext) -> Iterator[Violation]:
+        for func in _traced_defs(ctx):
+            for node in ast.walk(func):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = dotted_name(node.func)
+                if name is None:
+                    continue
+                for mod in ("np.", "numpy."):
+                    if not name.startswith(mod):
+                        continue
+                    rest = name[len(mod):]
+                    if rest.split(".")[0] in self._ALLOWED_ATTRS:
+                        continue
+                    if rest.startswith("random."):
+                        continue  # jit-purity owns host RNG
+                    yield self.violation(
+                        ctx, node,
+                        f"{name}() reachable from traced function "
+                        f"'{func.name}': numpy breaks on tracers or "
+                        "constant-folds at trace time; use jnp."
+                        f"{rest} or hoist to host-side setup",
+                    )
+                    break
+
+
+@register_rule
+class PallasTileAlignmentRule(Rule):
+    name = "pallas-tile-alignment"
+    description = (
+        "Literal block shapes passed to pl.pallas_call/pl.BlockSpec must "
+        "tile the (8, 128) VPU register: last dim % 128 == 0, second-to-"
+        "last % 8 == 0. Misaligned blocks relayout on every grid step."
+    )
+
+    def check(self, ctx: FileContext) -> Iterator[Violation]:
+        consts = module_int_constants(ctx.tree)
+        owners = self._owner_map(ctx.tree)
+        env_cache: Dict[int, Dict[str, int]] = {}
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func)
+            if name is None or name.split(".")[-1] != "BlockSpec":
+                continue
+            shape = self._shape_arg(node)
+            if shape is None:
+                continue
+            env = consts
+            owner = owners.get(id(node))
+            if owner is not None:
+                env = env_cache.setdefault(
+                    id(owner), local_int_constants(owner, consts)
+                )
+            yield from self._check_shape(ctx, node, shape, env)
+
+    @staticmethod
+    def _owner_map(tree: ast.Module) -> Dict[int, ast.AST]:
+        """Map each node id to its innermost enclosing function def."""
+        owners: Dict[int, ast.AST] = {}
+
+        def visit(node: ast.AST, owner: Optional[ast.AST]) -> None:
+            if owner is not None:
+                owners[id(node)] = owner
+            next_owner = (
+                node
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                else owner
+            )
+            for child in ast.iter_child_nodes(node):
+                visit(child, next_owner)
+
+        visit(tree, None)
+        return owners
+
+    @staticmethod
+    def _shape_arg(node: ast.Call) -> Optional[ast.Tuple]:
+        for kw in node.keywords:
+            if kw.arg == "block_shape" and isinstance(kw.value, ast.Tuple):
+                return kw.value
+        if node.args and isinstance(node.args[0], ast.Tuple):
+            return node.args[0]
+        return None
+
+    def _check_shape(
+        self,
+        ctx: FileContext,
+        node: ast.Call,
+        shape: ast.Tuple,
+        env: Dict[str, int],
+    ) -> Iterator[Violation]:
+        dims = [resolve_int(el, env) for el in shape.elts]
+        if not dims:
+            return
+        rendered = (
+            "(" + ", ".join(
+                str(d) if d is not None else "?" for d in dims
+            ) + ")"
+        )
+        last = dims[-1]
+        if last is not None and last != 1 and last % _LANE != 0:
+            yield self.violation(
+                ctx, node,
+                f"block shape {rendered}: lane dim {last} is not a "
+                f"multiple of {_LANE} — each grid step pays a lane "
+                "relayout",
+            )
+        if len(dims) >= 2:
+            sub = dims[-2]
+            if sub is not None and sub != 1 and sub % _SUBLANE != 0:
+                yield self.violation(
+                    ctx, node,
+                    f"block shape {rendered}: sublane dim {sub} is not a "
+                    f"multiple of {_SUBLANE} — each grid step pays a "
+                    "sublane relayout",
+                )
+
+
+@register_rule
+class LockDisciplineRule(Rule):
+    name = "lock-discipline"
+    description = (
+        "No blocking call (thread join, sleep, queue get/put, network I/O) "
+        "while holding a threading.Lock/RLock in runtime/ or serving/: the "
+        "lock serializes every heartbeat and reply path behind the wait."
+    )
+
+    _PATH_PARTS = ("runtime", "serving")
+    _NETWORK_PREFIXES = (
+        "urllib.request.urlopen", "urlopen", "requests.", "socket.",
+        "http.client.",
+    )
+    _NETWORK_METHODS = {"recv", "recv_into", "accept", "connect", "urlopen"}
+
+    def _applies(self, ctx: FileContext) -> bool:
+        parts = ctx.path.replace("\\", "/").split("/")
+        return any(p in parts for p in self._PATH_PARTS)
+
+    def check(self, ctx: FileContext) -> Iterator[Violation]:
+        if not self._applies(ctx):
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, (ast.With, ast.AsyncWith)):
+                continue
+            lock_expr = self._held_lock(node)
+            if lock_expr is None:
+                continue
+            for stmt in node.body:
+                for sub in ast.walk(stmt):
+                    if isinstance(sub, ast.Call):
+                        why = self._blocking_reason(sub)
+                        if why is not None:
+                            yield self.violation(
+                                ctx, sub,
+                                f"{why} while holding {lock_expr}: every "
+                                "thread contending for the lock stalls "
+                                "behind this wait",
+                            )
+
+    @staticmethod
+    def _held_lock(node: ast.With) -> Optional[str]:
+        for item in node.items:
+            expr = item.context_expr
+            if isinstance(expr, ast.Call):
+                expr = expr.func
+            name = dotted_name(expr)
+            if name is not None and "lock" in name.lower():
+                return name
+        return None
+
+    def _blocking_reason(self, call: ast.Call) -> Optional[str]:
+        name = dotted_name(call.func)
+        if name is not None:
+            if name.startswith("time.") and name.endswith("sleep"):
+                return f"{name}()"
+            for prefix in self._NETWORK_PREFIXES:
+                if name == prefix or name.startswith(prefix):
+                    return f"network call {name}()"
+        if not isinstance(call.func, ast.Attribute):
+            return None
+        attr = call.func.attr
+        has_positional = bool(call.args)
+        kwargs = {kw.arg for kw in call.keywords}
+        if attr == "sleep":
+            return "sleep()"
+        if attr == "join" and not has_positional:
+            # str.join always takes one positional iterable; thread/process
+            # join takes none (timeouts arrive as keywords)
+            return ".join()"
+        if attr in ("get", "put") and (
+            (not has_positional and not kwargs)
+            or kwargs & {"timeout", "block"}
+        ):
+            # dict.get(key[, default]) always passes positionals without
+            # timeout/block keywords; queue get/put is what remains
+            return f"queue .{attr}()"
+        if attr in self._NETWORK_METHODS:
+            return f"network call .{attr}()"
+        return None
+
+
+@register_rule
+class BareExceptPolicyRule(Rule):
+    name = "bare-except-policy"
+    description = (
+        "`except:` / `except Exception:` must re-raise, log the exception, "
+        "or carry an explicit justification (# noqa: BLE001 or a graftlint "
+        "suppression) — silent swallowing hides scheduler and kernel bugs."
+    )
+
+    _BROAD = {"Exception", "BaseException"}
+    _LOG_METHODS = {
+        "debug", "info", "warning", "error", "exception", "critical", "log",
+    }
+
+    def check(self, ctx: FileContext) -> Iterator[Violation]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not self._is_broad(node):
+                continue
+            if self._justified(ctx, node):
+                continue
+            caught = "bare except" if node.type is None else (
+                f"except {dotted_name(node.type)}"
+            )
+            yield self.violation(
+                ctx, node,
+                f"{caught} swallows the error: re-raise, log it, narrow "
+                "the type, or justify with `# noqa: BLE001`",
+            )
+
+    def _is_broad(self, node: ast.ExceptHandler) -> bool:
+        if node.type is None:
+            return True
+        name = dotted_name(node.type)
+        return name in self._BROAD
+
+    def _justified(self, ctx: FileContext, node: ast.ExceptHandler) -> bool:
+        line = ctx.line_text(node.lineno)
+        if "noqa" in line and "BLE001" in line:
+            return True
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Raise):
+                return True
+            if isinstance(sub, ast.Call) and self._is_log_call(sub):
+                return True
+        return False
+
+    def _is_log_call(self, call: ast.Call) -> bool:
+        if not isinstance(call.func, ast.Attribute):
+            return False
+        if call.func.attr not in self._LOG_METHODS:
+            return False
+        base = dotted_name(call.func.value)
+        return base is not None and "log" in base.lower()

@@ -217,7 +217,9 @@ class CognitiveServicesBase(_HasServiceParams, HasOutputCol, Transformer):
                 if self.getTyped() and schema is not None:
                     return schema.from_json(payload)
                 return payload
-            except Exception as e:  # polling timeout / malformed payload
+            except Exception as e:  # noqa: BLE001 — error-row semantics:
+                # polling timeout / malformed payload become a structured
+                # _ParseError row carrying the message, not a lost failure
                 return _ParseError(f"{type(e).__name__}: {e}")
 
         return parse

@@ -81,11 +81,25 @@ class Evaluator(Params):
 
 class Pipeline(Estimator):
     """Chain of stages; Estimators are fitted in sequence, Transformers pass
-    through — identical semantics to SparkML ``Pipeline.fit``."""
+    through — identical semantics to SparkML ``Pipeline.fit``, including the
+    up-front ``transformSchema`` pass: :meth:`validate` threads the column
+    schema through every stage before anything executes, so a mis-wired
+    graph fails in milliseconds instead of after the first TPU compile."""
 
     stages = Param("The chain of pipeline stages", default=[], is_complex=True)
 
+    def validate(self, table_or_schema: Any) -> Dict[str, Any]:
+        """Statically propagate a schema (or a Table's schema) through the
+        stage graph WITHOUT executing any stage. Returns the output schema;
+        raises :class:`~mmlspark_tpu.core.schema.SchemaError` naming the
+        offending stage on the first wiring error."""
+        return _chain_schema(self.getStages(), table_or_schema)
+
+    def transform_schema(self, schema: Dict[str, Any]) -> Dict[str, Any]:
+        return _chain_schema(self.getStages(), schema)
+
     def _fit(self, table: Table) -> "PipelineModel":
+        self.validate(table)
         fitted: List[Transformer] = []
         cur = table
         stages = self.getStages()
@@ -113,6 +127,26 @@ class PipelineModel(Model):
         for stage in self.getStages():
             table = stage.transform(table)
         return table
+
+    def transform_schema(self, schema: Dict[str, Any]) -> Dict[str, Any]:
+        return _chain_schema(self.getStages(), schema)
+
+
+def _chain_schema(stages: List[PipelineStage], source: Any) -> Dict[str, Any]:
+    """Thread a schema through a stage list, re-tagging errors with the
+    failing stage's position + class so pipeline users see *which* stage
+    is mis-wired, not just which column."""
+    from mmlspark_tpu.core.schema import SchemaError, as_schema
+
+    schema = as_schema(source)
+    for i, stage in enumerate(stages):
+        label = f"{i} ({type(stage).__name__})"
+        try:
+            schema = stage.transform_schema(schema)
+        except SchemaError as e:
+            raise e.with_stage(label) from None
+        schema = as_schema(schema)
+    return schema
 
 
 def make_pipeline_model(*stages: Transformer) -> PipelineModel:

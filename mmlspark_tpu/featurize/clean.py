@@ -2,7 +2,7 @@
 
 from __future__ import annotations
 
-from typing import Dict, List
+from typing import Any, Dict, List
 
 import numpy as np
 
@@ -14,7 +14,30 @@ from mmlspark_tpu.core.params import (
     to_str,
 )
 from mmlspark_tpu.core.pipeline import Estimator, Model
+from mmlspark_tpu.core.schema import ColType, add_column, require_column
 from mmlspark_tpu.data.table import Table
+
+
+def _clean_out_schema(stage: Any, schema: Dict[str, Any]) -> Dict[str, Any]:
+    """Each input col must exist; each output col carries the imputed values
+    (float64 for numeric inputs, the input's own dtype otherwise)."""
+    name = type(stage).__name__
+    ins = list(stage.getInputCols())
+    outs = (
+        list(stage.getOutputCols()) if stage.isSet("outputCols") else ins
+    )
+    if len(ins) != len(outs):
+        raise ValueError(
+            f"inputCols ({len(ins)}) and outputCols ({len(outs)}) must align"
+        )
+    for in_col, out_col in zip(ins, outs):
+        col = require_column(schema, in_col, name)
+        if col.dtype is not None and col.dtype != np.dtype(object):
+            col = ColType(np.dtype(np.float64), col.shape)
+        schema = add_column(
+            schema, out_col, col, name, replace=out_col == in_col
+        )
+    return schema
 
 
 class CleanMissingData(HasInputCols, HasOutputCols, Estimator):
@@ -27,6 +50,9 @@ class CleanMissingData(HasInputCols, HasOutputCols, Estimator):
         validator=one_of("Mean", "Median", "Custom"),
     )
     customValue = Param("Replacement when cleaningMode=Custom", default=None)
+
+    def transform_schema(self, schema: Dict[str, Any]) -> Dict[str, Any]:
+        return _clean_out_schema(self, schema)
 
     def _fit(self, table: Table) -> "CleanMissingDataModel":
         mode = self.getCleaningMode()
@@ -61,6 +87,9 @@ class CleanMissingData(HasInputCols, HasOutputCols, Estimator):
 
 class CleanMissingDataModel(HasInputCols, HasOutputCols, Model):
     fillValues = Param("column -> replacement value", default={})
+
+    def transform_schema(self, schema: Dict[str, Any]) -> Dict[str, Any]:
+        return _clean_out_schema(self, schema)
 
     def transform(self, table: Table) -> Table:
         fills = self.getFillValues()

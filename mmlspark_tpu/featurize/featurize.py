@@ -25,6 +25,7 @@ from mmlspark_tpu.core.params import (
     to_str,
 )
 from mmlspark_tpu.core.pipeline import Estimator, Model, Transformer
+from mmlspark_tpu.core.schema import ColType, add_column, require_column
 from mmlspark_tpu.data.table import Table
 from mmlspark_tpu.featurize.text import hashing_tf
 
@@ -61,6 +62,36 @@ class AssembleFeatures(HasInputCols, HasOutputCol, Transformer):
                 )
         return table.with_column(self.getOutputCol(), np.hstack(blocks))
 
+    def transform_schema(self, schema: Dict[str, Any]) -> Dict[str, Any]:
+        name = type(self).__name__
+        width: Optional[int] = 0
+        for c in self.getInputCols():
+            col = require_column(schema, c, name, numeric=False)
+            if col.dtype is not None and col.dtype.kind in "US":
+                # mirrors the runtime "not assemblable" error, statically
+                from mmlspark_tpu.core.schema import DTYPE_MISMATCH, SchemaError
+
+                raise SchemaError(
+                    DTYPE_MISMATCH,
+                    f"column {c!r} (dtype {col.dtype}) is not assemblable; "
+                    "index or hash it first",
+                    stage=name,
+                    column=c,
+                )
+            if width is not None and col.shape is not None:
+                width += col.shape[0] if col.shape else 1
+            else:
+                width = None  # any unknown-width input -> unknown total
+        out = self.getOutputCol()
+        shape = (width,) if width is not None else None
+        return add_column(
+            schema,
+            out,
+            ColType(np.dtype(np.float32), shape),
+            name,
+            replace=out in set(self.getInputCols()),
+        )
+
 
 class Featurize(HasInputCols, HasOutputCol, Estimator):
     """Auto-featurizer: imputes numerics, one-hot (or index) encodes low-
@@ -82,6 +113,20 @@ class Featurize(HasInputCols, HasOutputCol, Estimator):
     allowImages = Param("Kept for parity", default=False, converter=to_bool)
 
     _MAX_CATEGORICAL_CARDINALITY = 100
+
+    def transform_schema(self, schema: Dict[str, Any]) -> Dict[str, Any]:
+        name = type(self).__name__
+        for c in self.getInputCols():
+            require_column(schema, c, name)
+        out = self.getOutputCol()
+        # width depends on fitted plans (one-hot cardinalities) -> unknown
+        return add_column(
+            schema,
+            out,
+            ColType(np.dtype(np.float32)),
+            name,
+            replace=out in set(self.getInputCols()),
+        )
 
     def _fit(self, table: Table) -> "FeaturizeModel":
         plans: List[Dict[str, Any]] = []
@@ -122,6 +167,35 @@ class FeaturizeModel(HasOutputCol, Model):
     plans = Param("Per-column featurization plans", default=[])
     oneHotEncodeCategoricals = Param("One-hot categoricals", default=True, converter=to_bool)
     numberOfFeatures = Param("Text hash dimensions", default=1 << 8, converter=to_int)
+
+    def transform_schema(self, schema: Dict[str, Any]) -> Dict[str, Any]:
+        name = type(self).__name__
+        width: Optional[int] = 0
+        for plan in self.getPlans():
+            col = require_column(schema, plan["col"], name)
+            kind = plan["kind"]
+            if kind == "numeric":
+                w: Optional[int] = 1
+            elif kind == "categorical":
+                w = (
+                    len(plan["levels"]) + 1
+                    if self.getOneHotEncodeCategoricals()
+                    else 1
+                )
+            elif kind == "text":
+                w = self.getNumberOfFeatures()
+            else:  # vector: width comes from the input column, if known
+                w = col.shape[0] if col.shape else None
+            width = width + w if (width is not None and w is not None) else None
+        out = self.getOutputCol()
+        shape = (width,) if width is not None else None
+        return add_column(
+            schema,
+            out,
+            ColType(np.dtype(np.float32), shape),
+            name,
+            replace=out in {p["col"] for p in self.getPlans()},
+        )
 
     def transform(self, table: Table) -> Table:
         blocks: List[np.ndarray] = []

@@ -15,12 +15,29 @@ import numpy as np
 
 from mmlspark_tpu.core.params import HasInputCol, HasOutputCol, Param, to_bool
 from mmlspark_tpu.core.pipeline import Estimator, Model, Transformer
+from mmlspark_tpu.core.schema import ColType, add_column, require_column
 from mmlspark_tpu.data.table import Table
+
+
+def _index_out_schema(stage: Any, schema: Dict[str, Any]) -> Dict[str, Any]:
+    name = type(stage).__name__
+    require_column(schema, stage.getInputCol(), name)
+    out = stage.getOutputCol()
+    return add_column(
+        schema,
+        out,
+        ColType(np.dtype(np.int64), ()),
+        name,
+        replace=out == stage.getInputCol(),
+    )
 
 
 class ValueIndexer(HasInputCol, HasOutputCol, Estimator):
     """Distinct values -> dense indices [0, n); unseen values map to n
     (an explicit 'unknown' bucket) at transform time."""
+
+    def transform_schema(self, schema: Dict[str, Any]) -> Dict[str, Any]:
+        return _index_out_schema(self, schema)
 
     def _fit(self, table: Table) -> "ValueIndexerModel":
         col = table.column(self.getInputCol())
@@ -67,6 +84,9 @@ class ValueIndexerModel(HasInputCol, HasOutputCol, Model):
             metadata={"categorical": True, "levels": list(levels)},
         )
 
+    def transform_schema(self, schema: Dict[str, Any]) -> Dict[str, Any]:
+        return _index_out_schema(self, schema)
+
 
 def decode_levels(indices: np.ndarray, levels: List[Any]) -> np.ndarray:
     """Indices -> original level values; the unknown bucket decodes to None
@@ -96,3 +116,14 @@ class IndexToValue(HasInputCol, HasOutputCol, Transformer):
             )
         out = decode_levels(table.column(self.getInputCol()), meta["levels"])
         return table.with_column(self.getOutputCol(), out)
+
+    def transform_schema(self, schema: Dict[str, Any]) -> Dict[str, Any]:
+        name = type(self).__name__
+        require_column(schema, self.getInputCol(), name)
+        out = self.getOutputCol()
+        # decoded dtype depends on the level values (str -> object,
+        # numeric -> float64) recorded in column metadata, not the schema
+        return add_column(
+            schema, out, ColType(), name,
+            replace=out == self.getInputCol(),
+        )

@@ -25,8 +25,35 @@ from mmlspark_tpu.core.params import (
     to_str,
 )
 from mmlspark_tpu.core.pipeline import Estimator, Model, Transformer
+from mmlspark_tpu.core.schema import ColType, add_column, require_column
 from mmlspark_tpu.data.table import Table
 from mmlspark_tpu.ops.hashing import mask_bits, murmur32_strings
+
+
+def _ragged_out_schema(stage: Any, schema: Dict[str, Any]) -> Dict[str, Any]:
+    """input col exists; output is a ragged (object) list column."""
+    name = type(stage).__name__
+    src = stage.getInputCol()
+    require_column(schema, src, name)
+    out = stage.getOutputCol()
+    return add_column(
+        schema, out, ColType(np.dtype(object)), name, replace=out == src
+    )
+
+
+def _tf_out_schema(stage: Any, schema: Dict[str, Any]) -> Dict[str, Any]:
+    """input col exists; output is a dense (numFeatures,) float32 vector."""
+    name = type(stage).__name__
+    src = stage.getInputCol()
+    require_column(schema, src, name)
+    out = stage.getOutputCol()
+    return add_column(
+        schema,
+        out,
+        ColType(np.dtype(np.float32), (stage.getNumFeatures(),)),
+        name,
+        replace=out == src,
+    )
 
 
 def _tokenize(text: str, pattern: str, to_lower: bool, min_len: int) -> List[str]:
@@ -102,6 +129,9 @@ class PageSplitter(HasInputCol, HasOutputCol, Transformer):
             out[i] = pages
         return table.with_column(self.getOutputCol(), out)
 
+    def transform_schema(self, schema: Dict[str, Any]) -> Dict[str, Any]:
+        return _ragged_out_schema(self, schema)
+
 
 class MultiNGram(HasInputCol, HasOutputCol, Transformer):
     """All n-grams for several lengths at once
@@ -120,6 +150,9 @@ class MultiNGram(HasInputCol, HasOutputCol, Transformer):
                 grams.extend(_ngrams(tokens, n))
             out[i] = grams
         return table.with_column(self.getOutputCol(), out)
+
+    def transform_schema(self, schema: Dict[str, Any]) -> Dict[str, Any]:
+        return _ragged_out_schema(self, schema)
 
 
 class TextFeaturizer(HasInputCol, HasOutputCol, Estimator):
@@ -161,6 +194,9 @@ class TextFeaturizer(HasInputCol, HasOutputCol, Estimator):
                 tokens = tokens + _ngrams(tokens, self.getNGramLength())
             docs.append(tokens)
         return docs
+
+    def transform_schema(self, schema: Dict[str, Any]) -> Dict[str, Any]:
+        return _tf_out_schema(self, schema)
 
     def _fit(self, table: Table) -> "TextFeaturizerModel":
         docs = self._docs(table.column(self.getInputCol()))
@@ -210,3 +246,6 @@ class TextFeaturizerModel(HasInputCol, HasOutputCol, Model):
         if idf is not None:
             tf = tf * np.asarray(idf, dtype=np.float32)
         return table.with_column(self.getOutputCol(), tf)
+
+    def transform_schema(self, schema: Dict[str, Any]) -> Dict[str, Any]:
+        return _tf_out_schema(self, schema)

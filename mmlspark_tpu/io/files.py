@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import fnmatch
 import io as _stdlib_io
+import logging
 import os
 import zipfile
 from typing import List, Optional, Tuple
@@ -16,6 +17,8 @@ from typing import List, Optional, Tuple
 import numpy as np
 
 from mmlspark_tpu.data.table import Table
+
+logger = logging.getLogger("mmlspark_tpu.io")
 
 
 def _walk(path: str, recursive: bool, pattern: Optional[str]) -> List[str]:
@@ -80,7 +83,10 @@ def decode_image(data: bytes) -> Optional[np.ndarray]:
 
         with Image.open(_stdlib_io.BytesIO(data)) as im:
             return np.asarray(im.convert("RGB"))
-    except Exception:
+    except Exception as e:
+        # PIL raises a zoo of per-codec errors; null-row semantics want
+        # them all, but not silently.
+        logger.debug("undecodable image (%s: %s)", type(e).__name__, e)
         return None
 
 

@@ -37,7 +37,7 @@ from __future__ import annotations
 import collections
 import dataclasses
 import math
-from functools import partial
+from functools import lru_cache, partial
 from typing import Any, Dict, List, NamedTuple, Optional, Sequence, Tuple
 
 import jax
@@ -205,6 +205,23 @@ def _soft_threshold(g: jax.Array, l1: float) -> jax.Array:
     return jnp.sign(g) * jnp.maximum(jnp.abs(g) - l1, 0.0)
 
 
+@lru_cache(maxsize=None)
+def _cat_static_maps(
+    cat_slots: tuple, onehot_slots: tuple, num_features: int
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """Host-side index maps for the categorical split search, memoized on
+    the (static) slot tuples so none of the numpy setup runs under trace:
+    sorted categorical feature indices, the is-categorical mask, the
+    feature -> categorical-slice position map, and the one-vs-rest mask."""
+    cat_idx = np.asarray(sorted(cat_slots), np.int32)
+    is_cat = np.zeros(num_features, bool)
+    is_cat[cat_idx] = True
+    inv = np.zeros(num_features, np.int32)
+    inv[cat_idx] = np.arange(len(cat_idx))
+    onehot = np.isin(cat_idx, np.asarray(onehot_slots, np.int32))
+    return cat_idx, is_cat, inv, onehot
+
+
 def _split_search(
     hist: jax.Array,  # (k, F, B, 3)
     totals: jax.Array,  # (k, 3) exact per-node [sum_g, sum_h, count]
@@ -268,11 +285,9 @@ def _split_search(
         # All sorted-prefix machinery runs on the (k, F_cat, B) SLICE only —
         # sorts are the expensive primitive here, and categorical features
         # are typically a small subset of the matrix.
-        cat_idx_np = np.asarray(sorted(opts.categorical_slots), np.int32)
-        cf_np = np.zeros(f, bool)
-        cf_np[cat_idx_np] = True
-        inv_np = np.zeros(f, np.int32)
-        inv_np[cat_idx_np] = np.arange(len(cat_idx_np))
+        cat_idx_np, cf_np, inv_np, oh_np = _cat_static_maps(
+            opts.categorical_slots, opts.onehot_slots, f
+        )
         cat_idx = jnp.asarray(cat_idx_np)
         hist_c = hist[:, cat_idx]  # (k, Fc, B, 3)
         gsum, hsum, cnt = hist_c[..., 0], hist_c[..., 1], hist_c[..., 2]
@@ -336,7 +351,6 @@ def _split_search(
         # order involved). Same lambda_l2 + cat_l2 regularization; no
         # cat_smooth, no min_data_per_group (native's one-hot loop applies
         # neither). Bin 0 (unseen/NaN) never splits left.
-        oh_np = np.isin(cat_idx_np, np.asarray(opts.onehot_slots, np.int32))
         if oh_np.any():
             gr_oh = g_tot[:, None, None] - gsum
             hr_oh = h_tot[:, None, None] - hsum

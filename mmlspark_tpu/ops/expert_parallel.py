@@ -25,6 +25,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from mmlspark_tpu.ops.shmap import shard_map
 from mmlspark_tpu.parallel.mesh import AXIS_EXPERT
 
 
@@ -75,7 +76,7 @@ def moe_apply(
         out = expert_fn(params_one, x_l) * mask * chosen_l
         return lax.psum(out, AXIS_EXPERT)
 
-    return jax.shard_map(
+    return shard_map(
         local_fn,
         mesh=mesh,
         in_specs=(
@@ -166,7 +167,7 @@ def moe_apply_a2a(
         y = back[safe_slot] * keep[:, None] * chosen_l
         return y
 
-    return jax.shard_map(
+    return shard_map(
         local_fn,
         mesh=mesh,
         in_specs=(

@@ -23,6 +23,26 @@ _C1 = np.uint32(0xCC9E2D51)
 _C2 = np.uint32(0x1B873593)
 
 
+def _require_host(values) -> None:
+    """Fail fast with an actionable message when a JAX tracer reaches the
+    host-only hashing path (graftlint's traced-reachability index keeps
+    callers honest statically; this guards the dynamic paths it cannot
+    see, e.g. a hash call smuggled in through a callback). Without the
+    guard the failure is a TracerArrayConversionError raised from deep
+    inside ``np.asarray``. The import stays lazy: this module is
+    numpy-only unless JAX types actually show up."""
+    if not type(values).__module__.startswith("jax"):
+        return
+    import jax
+
+    if isinstance(values, jax.core.Tracer):
+        raise TypeError(
+            "murmur3 hashing is host-side only (SURVEY: hashing stays off "
+            "the accelerator; only integer indices reach the TPU) — call "
+            "it before jit, or hoist the result as a static input"
+        )
+
+
 def _rotl32(x: np.ndarray, r: int) -> np.ndarray:
     return (x << np.uint32(r)) | (x >> np.uint32(32 - r))
 
@@ -51,6 +71,7 @@ def murmur32_ints(values: np.ndarray, seed: int = 0) -> np.ndarray:
     """Hash each int32/uint32 value as a 4-byte murmur3 block (VW's
     ``hash_uniform`` over integer feature ids). Dispatches to the host C++
     library when built; vectorized numpy otherwise."""
+    _require_host(values)
     from mmlspark_tpu.native import murmur3_ints_native
 
     native = murmur3_ints_native(np.asarray(values), seed)

@@ -22,6 +22,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from mmlspark_tpu.ops.shmap import shard_map
 from mmlspark_tpu.parallel.mesh import AXIS_PIPE
 
 
@@ -93,7 +94,7 @@ def pipeline_apply(
         return lax.psum(outputs, AXIS_PIPE)
 
     # strip the stage axis onto the mesh; microbatches replicated
-    out = jax.shard_map(
+    out = shard_map(
         local_fn,
         mesh=mesh,
         in_specs=(

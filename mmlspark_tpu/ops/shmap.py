@@ -1,0 +1,33 @@
+"""``shard_map`` version shim.
+
+``jax.shard_map`` graduated out of ``jax.experimental.shard_map`` (and
+renamed ``check_rep`` → ``check_vma``) in newer JAX releases; older ones
+only ship the experimental spelling. Every explicit-SPMD op routes
+through this one wrapper so the rest of the tree can use the modern
+surface unconditionally.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+
+
+def shard_map(
+    f: Callable[..., Any],
+    *,
+    mesh: Any,
+    in_specs: Any,
+    out_specs: Any,
+    check_vma: bool = True,
+) -> Callable[..., Any]:
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=check_vma
+        )
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    return _shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=check_vma
+    )

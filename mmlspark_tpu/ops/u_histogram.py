@@ -53,6 +53,31 @@ from jax import lax
 
 _LANE = 128
 _N_ALIGN = 512  # row padding granularity (lane-dim alignment for U tiles)
+
+
+def _ensure_barrier_batching() -> None:
+    """Older JAX ships no batching rule for ``optimization_barrier``, and the
+    multiclass trainer vmaps over classes straight through the panel build
+    (train.py's per-class tree grower). The rule is the identity — pass the
+    batched operands through the barrier, keep the batch dims — which is
+    exactly what newer releases register; install it when missing."""
+    try:
+        from jax._src.lax import lax as _lax_internal
+        from jax.interpreters import batching
+
+        prim = _lax_internal.optimization_barrier_p
+    except (ImportError, AttributeError):
+        return
+    if prim in batching.primitive_batchers:
+        return
+
+    def _rule(batched_args, batch_dims, **params):
+        return prim.bind(*batched_args, **params), batch_dims
+
+    batching.primitive_batchers[prim] = _rule
+
+
+_ensure_barrier_batching()
 # Fused Pallas panel+dot pass (MMLSPARK_TPU_U_FUSED=1 opts in). Default
 # OFF: measured ~2.5% SLOWER end-to-end than the two-op XLA formulation on
 # v5e (XLA's matmul pipeline beats the hand grid even though the fused
@@ -103,7 +128,10 @@ def u_bytes(n_rows: int, spec: USpec) -> int:
 def _col_maps_cached(spec: USpec) -> Tuple[np.ndarray, np.ndarray]:
     """Static per-spec column maps: ``feat_of_col[c]`` = feature owning
     packed row c, ``local_of_col[c]`` = c's bin id within that feature
-    (-1 on the k..k_pad tail so tail rows match nothing)."""
+    (-1 on the k..k_pad tail so tail rows match nothing). Cached as HOST
+    numpy (the lru_cache host boundary graftlint understands): callers may
+    hit this inside a trace, and a device array built there would be a
+    trace-local constant the cache must not retain."""
     feat = np.zeros(spec.k_pad, np.int32)
     local = np.full(spec.k_pad, -1, np.int32)
     for j, (o, w) in enumerate(zip(spec.offsets, spec.widths)):
@@ -133,8 +161,8 @@ def build_u(bins: jax.Array, spec: USpec, dtype=jnp.int8) -> jax.Array:
     ids_t = ids.T  # (F, N_pad)
     feat_of_col, local_of_col = _col_maps_cached(spec)
     blk = _LANE  # k_pad is always a multiple of the lane block
-    fo = jnp.asarray(feat_of_col).reshape(-1, blk)
-    lo = jnp.asarray(local_of_col).reshape(-1, blk)
+    fo = feat_of_col.reshape(-1, blk)
+    lo = local_of_col.reshape(-1, blk)
 
     def block(_, fl):
         fb, lb = fl
@@ -159,7 +187,8 @@ def _dense_maps(spec: USpec) -> Tuple[np.ndarray, np.ndarray]:
 
 
 @functools.lru_cache(maxsize=64)
-def _dense_maps_cached(spec: USpec):
+def _dense_maps_cached(spec: USpec) -> Tuple[np.ndarray, np.ndarray]:
+    # Cached as HOST numpy; see _col_maps_cached.
     return _dense_maps(spec)
 
 
@@ -424,6 +453,6 @@ def build_histograms_u(
 
     f, b = spec.num_features, spec.num_bins
     idx, mask = _dense_maps_cached(spec)
-    dense = packed[jnp.asarray(idx).reshape(-1)].reshape(f, b, 3 * k)
-    dense = dense * jnp.asarray(mask)[:, :, None]
+    dense = packed[idx.reshape(-1)].reshape(f, b, 3 * k)
+    dense = dense * mask[:, :, None]
     return dense.reshape(f, b, 3, k).transpose(3, 0, 1, 2)

@@ -108,7 +108,7 @@ def build_histograms_voting(
         full = jax.vmap(lambda fu, si, hs: fu.at[si].set(hs))(full, sel, h_sel)
         return full, totals
 
-    from jax.experimental.shard_map import shard_map
+    from mmlspark_tpu.ops.shmap import shard_map
 
     sharded = shard_map(
         local_fn,
@@ -122,7 +122,7 @@ def build_histograms_voting(
             P(),  # feature mask replicated
         ),
         out_specs=(P(), P()),
-        check_rep=False,
+        check_vma=False,
     )
     if feature_mask is None:
         feature_mask = jnp.ones(f, dtype=jnp.float32)

@@ -30,6 +30,7 @@ recompiles). The reference machinery maps as:
 from __future__ import annotations
 
 import json
+import logging
 import queue
 import threading
 import time
@@ -42,6 +43,8 @@ import numpy as np
 
 from mmlspark_tpu.core.pipeline import Transformer
 from mmlspark_tpu.data.table import Table
+
+logger = logging.getLogger("mmlspark_tpu.serving")
 
 
 class _Server(ThreadingHTTPServer):
@@ -187,14 +190,18 @@ class _BatchLoop:
                 payloads[i] = np.asarray(p) if isinstance(p, list) else p
             try:
                 col = np.stack(payloads)  # rectangular -> fast path
-            except Exception:
-                col = payloads
+            except (ValueError, TypeError):
+                col = payloads  # ragged payloads stay an object column
             out = self._apply_model(Table({self.input_col: col}))
             values = out.column(self.output_col)
             for r, v in zip(batch, values):
                 self._reply(r, v)
             self.commit(epoch)
         except Exception as e:
+            logger.warning(
+                "batch epoch %d failed (%s: %s); re-enqueueing retryable "
+                "requests", epoch, type(e).__name__, e,
+            )
             self.commit(epoch)
             # Task-retry re-hydration: the failed batch goes back on the
             # queue (``registerPartition``/``recoveredPartitions``,
@@ -382,7 +389,8 @@ class RegistrationService:
                 try:
                     info = json.loads(self.rfile.read(length))
                     svc = ServiceInfo(info["name"], info["host"], int(info["port"]))
-                except Exception:
+                except (KeyError, TypeError, ValueError) as e:
+                    logger.debug("rejected malformed /register payload: %s", e)
                     self.send_response(400)
                     self.end_headers()
                     return
@@ -519,6 +527,7 @@ class DistributedServingServer:
             self._register_endpoints()
         except Exception:
             # a failed registration must not leak running listeners/ports
+            logger.exception("endpoint registration failed; stopping servers")
             self.stop()
             raise
         return self

@@ -31,6 +31,7 @@ from mmlspark_tpu.core.params import (
     to_str,
 )
 from mmlspark_tpu.core.pipeline import Estimator, Model, Transformer
+from mmlspark_tpu.core.schema import ColType, add_column, require_column
 from mmlspark_tpu.data.table import Table
 
 logger = logging.getLogger("mmlspark_tpu.stages")
@@ -56,6 +57,12 @@ class DropColumns(Transformer):
             table.column(c)  # raise on missing, like the reference's verifySchema
         return table.drop(*self.getCols())
 
+    def transform_schema(self, schema: Dict[str, Any]) -> Dict[str, Any]:
+        name = type(self).__name__
+        for c in self.getCols():
+            require_column(schema, c, name)
+        return {k: v for k, v in schema.items() if k not in set(self.getCols())}
+
 
 class SelectColumns(Transformer):
     """Keep only the listed columns (``stages/SelectColumns.scala``)."""
@@ -64,6 +71,12 @@ class SelectColumns(Transformer):
 
     def transform(self, table: Table) -> Table:
         return table.select(*self.getCols())
+
+    def transform_schema(self, schema: Dict[str, Any]) -> Dict[str, Any]:
+        name = type(self).__name__
+        return {
+            c: require_column(schema, c, name) for c in self.getCols()
+        }
 
 
 class RenameColumn(Transformer):
@@ -74,6 +87,13 @@ class RenameColumn(Transformer):
 
     def transform(self, table: Table) -> Table:
         return table.rename(self.getInputCol(), self.getOutputCol())
+
+    def transform_schema(self, schema: Dict[str, Any]) -> Dict[str, Any]:
+        name = type(self).__name__
+        src, dst = self.getInputCol(), self.getOutputCol()
+        col = require_column(schema, src, name)
+        rest = {k: v for k, v in schema.items() if k != src}
+        return add_column(rest, dst, col, name)
 
 
 class Repartition(Transformer):
@@ -110,6 +130,10 @@ class StratifiedRepartition(HasLabelCol, Transformer):
         validator=one_of("equal", "original", "mixed"),
     )
     seed = Param("Random seed", default=0, converter=to_int)
+
+    def transform_schema(self, schema: Dict[str, Any]) -> Dict[str, Any]:
+        require_column(schema, self.getLabelCol(), type(self).__name__)
+        return dict(schema)
 
     def transform(self, table: Table) -> Table:
         if table.num_rows == 0:
@@ -158,6 +182,9 @@ class ClassBalancer(HasInputCol, HasOutputCol, Estimator):
         converter=to_bool,
     )
 
+    def transform_schema(self, schema: Dict[str, Any]) -> Dict[str, Any]:
+        return _weight_col_schema(self, schema)
+
     def _fit(self, table: Table) -> "ClassBalancerModel":
         col = table.column(self.getInputCol())
         values, counts = np.unique(col.astype(str), return_counts=True)
@@ -180,6 +207,24 @@ class ClassBalancerModel(HasInputCol, HasOutputCol, Model):
         out = np.array([w.get(v, 1.0) for v in col], dtype=np.float64)
         return table.with_column(self.getOutputCol(), out)
 
+    def transform_schema(self, schema: Dict[str, Any]) -> Dict[str, Any]:
+        return _weight_col_schema(self, schema)
+
+
+def _weight_col_schema(
+    stage: Any, schema: Dict[str, Any]
+) -> Dict[str, Any]:
+    name = type(stage).__name__
+    require_column(schema, stage.getInputCol(), name)
+    out = stage.getOutputCol()
+    return add_column(
+        schema,
+        out,
+        ColType(np.dtype(np.float64), ()),
+        name,
+        replace=out == stage.getInputCol(),
+    )
+
 
 class Explode(HasInputCol, HasOutputCol, Transformer):
     """One output row per element of a ragged/list column
@@ -195,6 +240,15 @@ class Explode(HasInputCol, HasOutputCol, Transformer):
             flat.extend(list(v))
         base = table.drop(self.getInputCol()).take(repeat_idx)
         return base.with_column(out_name, flat)
+
+    def transform_schema(self, schema: Dict[str, Any]) -> Dict[str, Any]:
+        name = type(self).__name__
+        src = self.getInputCol()
+        require_column(schema, src, name)
+        out = self.getOutputCol() if self.isDefined("outputCol") else src
+        rest = {k: v for k, v in schema.items() if k != src}
+        # element dtype of a ragged column is data-dependent -> unknown
+        return add_column(rest, out, ColType(), name)
 
 
 class Lambda(Transformer):
@@ -230,6 +284,18 @@ class UDFTransformer(HasInputCol, HasInputCols, HasOutputCol, Transformer):
             args = [table.column(self.getInputCol())]
         return table.with_column(self.getOutputCol(), f(*args))
 
+    def transform_schema(self, schema: Dict[str, Any]) -> Dict[str, Any]:
+        name = type(self).__name__
+        if self.isDefined("inputCols") and self.isSet("inputCols"):
+            ins = list(self.getInputCols())
+        else:
+            ins = [self.getInputCol()]
+        for c in ins:
+            require_column(schema, c, name)
+        out = self.getOutputCol()
+        # the udf's output dtype is opaque to static analysis
+        return add_column(schema, out, ColType(), name, replace=out in ins)
+
 
 class MultiColumnAdapter(HasInputCols, HasOutputCols, Transformer, Estimator):
     """Map a single-column stage over many column pairs
@@ -255,6 +321,11 @@ class MultiColumnAdapter(HasInputCols, HasOutputCols, Transformer, Estimator):
         for in_col, out_col in self._pairs():
             table = self._stage_for(in_col, out_col).transform(table)
         return table
+
+    def transform_schema(self, schema: Dict[str, Any]) -> Dict[str, Any]:
+        for in_col, out_col in self._pairs():
+            schema = self._stage_for(in_col, out_col).transform_schema(schema)
+        return schema
 
     def _fit(self, table: Table) -> Model:
         from mmlspark_tpu.core.pipeline import PipelineModel
@@ -330,6 +401,9 @@ class TextPreprocessor(HasInputCol, HasOutputCol, Transformer):
         out = np.array([apply(str(s)) for s in col], dtype=object)
         return table.with_column(self.getOutputCol(), out)
 
+    def transform_schema(self, schema: Dict[str, Any]) -> Dict[str, Any]:
+        return _text_out_schema(self, schema)
+
 
 class UnicodeNormalize(HasInputCol, HasOutputCol, Transformer):
     """Unicode NFKD/NFC normalization + optional lower-casing
@@ -355,6 +429,21 @@ class UnicodeNormalize(HasInputCol, HasOutputCol, Transformer):
         out = np.array([norm(s) for s in col], dtype=object)
         return table.with_column(self.getOutputCol(), out)
 
+    def transform_schema(self, schema: Dict[str, Any]) -> Dict[str, Any]:
+        return _text_out_schema(self, schema)
+
+
+def _text_out_schema(stage: Any, schema: Dict[str, Any]) -> Dict[str, Any]:
+    """input col must exist; output is a fresh object (string) column,
+    overwriting in place when outputCol == inputCol."""
+    name = type(stage).__name__
+    src = stage.getInputCol()
+    require_column(schema, src, name)
+    out = stage.getOutputCol()
+    return add_column(
+        schema, out, ColType(np.dtype(object)), name, replace=out == src
+    )
+
 
 class Timer(Estimator):
     """Wraps a stage; logs fit/transform wall time (``stages/Timer.scala:57``).
@@ -370,6 +459,9 @@ class Timer(Estimator):
         default=True,
         converter=to_bool,
     )
+
+    def transform_schema(self, schema: Dict[str, Any]) -> Dict[str, Any]:
+        return self.getStage().transform_schema(schema)
 
     def _log(self, msg: str) -> str:
         if self.getLogToScala():

@@ -19,6 +19,7 @@ import numpy as np
 
 from mmlspark_tpu.core.params import Param, gt, to_bool, to_int
 from mmlspark_tpu.core.pipeline import Transformer
+from mmlspark_tpu.core.schema import ColType, require_column
 from mmlspark_tpu.data.table import Table
 
 
@@ -31,6 +32,14 @@ def _batch_bounds(n: int, sizes: List[int]) -> List[tuple]:
         lo += size
         i += 1
     return bounds
+
+
+class _MiniBatchBase(Transformer):
+    """Shared schema rule for the batchers: every column keeps its name but
+    becomes an object column whose elements are the per-batch arrays."""
+
+    def transform_schema(self, schema: Dict[str, Any]) -> Dict[str, Any]:
+        return {name: ColType(np.dtype(object)) for name in schema}
 
 
 def _batch_table(table: Table, bounds: List[tuple]) -> Table:
@@ -46,7 +55,7 @@ def _batch_table(table: Table, bounds: List[tuple]) -> Table:
     return batched
 
 
-class FixedMiniBatchTransformer(Transformer):
+class FixedMiniBatchTransformer(_MiniBatchBase):
     """Group every ``batchSize`` consecutive rows into one batch row
     (``stages/MiniBatchTransformer.scala:139``)."""
 
@@ -64,7 +73,7 @@ class FixedMiniBatchTransformer(Transformer):
         )
 
 
-class DynamicMiniBatchTransformer(Transformer):
+class DynamicMiniBatchTransformer(_MiniBatchBase):
     """Batch whatever is available, up to ``maxBatchSize``
     (``stages/MiniBatchTransformer.scala:43``). Without a streaming queue the
     whole partition is 'available': each logical partition becomes one batch,
@@ -84,7 +93,7 @@ class DynamicMiniBatchTransformer(Transformer):
         return _batch_table(table, bounds)
 
 
-class TimeIntervalMiniBatchTransformer(Transformer):
+class TimeIntervalMiniBatchTransformer(_MiniBatchBase):
     """Batch rows arriving within ``millisToWait`` of each other
     (``stages/MiniBatchTransformer.scala:95``). Materialized Tables have no
     arrival times; an explicit ``timestampCol`` (epoch millis) partitions rows
@@ -98,6 +107,12 @@ class TimeIntervalMiniBatchTransformer(Transformer):
     )
     timestampCol = Param("Optional epoch-millis column defining arrival times",
                          default=None)
+
+    def transform_schema(self, schema: Dict[str, Any]) -> Dict[str, Any]:
+        ts_col = self.getTimestampCol()
+        if ts_col is not None:
+            require_column(schema, ts_col, type(self).__name__, numeric=True)
+        return super().transform_schema(schema)
 
     def transform(self, table: Table) -> Table:
         cap = self.getMaxBatchSize()
@@ -126,6 +141,15 @@ class TimeIntervalMiniBatchTransformer(Transformer):
 class FlattenBatch(Transformer):
     """Invert mini-batching: explode every batched column back to one row per
     element (``stages/MiniBatchTransformer.scala:159``)."""
+
+    def transform_schema(self, schema: Dict[str, Any]) -> Dict[str, Any]:
+        # batched (object) columns re-flatten to their element dtype, which
+        # is data-dependent; non-object columns are repeated unchanged
+        out: Dict[str, Any] = {}
+        for name, col in schema.items():
+            dtype = getattr(col, "dtype", None)
+            out[name] = ColType() if dtype == np.dtype(object) else col
+        return out
 
     def transform(self, table: Table) -> Table:
         if table.num_rows == 0:

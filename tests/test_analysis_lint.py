@@ -1,0 +1,440 @@
+"""graftlint rule tests: per rule a positive (violation), a negative
+(clean), and a suppressed fixture, plus driver/CLI behavior."""
+
+import subprocess
+import sys
+
+import pytest
+
+from mmlspark_tpu.analysis import all_rules
+from mmlspark_tpu.analysis.lint import lint_paths, lint_source, main
+
+
+def rules_of(violations):
+    return [v.rule for v in violations]
+
+
+# ---------------------------------------------------------------------------
+# jit-purity
+# ---------------------------------------------------------------------------
+
+
+class TestJitPurity:
+    def test_flags_time_and_print_in_jitted(self):
+        src = (
+            "import time\n"
+            "import jax\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    t = time.time()\n"
+            "    print(x)\n"
+            "    return x + t\n"
+        )
+        found = rules_of(lint_source(src, select=["jit-purity"]))
+        assert found == ["jit-purity", "jit-purity"]
+
+    def test_flags_global_mutation(self):
+        src = (
+            "import jax\n"
+            "_STATE = 0\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    global _STATE\n"
+            "    _STATE = 1\n"
+            "    return x\n"
+        )
+        assert rules_of(lint_source(src, select=["jit-purity"])) == ["jit-purity"]
+
+    def test_flags_random_in_callsite_jit(self):
+        src = (
+            "import jax, random\n"
+            "def f(x):\n"
+            "    return x * random.random()\n"
+            "g = jax.jit(f)\n"
+        )
+        assert rules_of(lint_source(src, select=["jit-purity"])) == ["jit-purity"]
+
+    def test_clean_outside_jit(self):
+        src = (
+            "import time\n"
+            "def host():\n"
+            "    print(time.time())\n"
+        )
+        assert lint_source(src, select=["jit-purity"]) == []
+
+    def test_suppressed(self):
+        src = (
+            "import time, jax\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    t = time.time()  # graftlint: disable=jit-purity\n"
+            "    return x + t\n"
+        )
+        assert lint_source(src, select=["jit-purity"]) == []
+
+
+# ---------------------------------------------------------------------------
+# numpy-in-traced-code
+# ---------------------------------------------------------------------------
+
+
+class TestNumpyInTraced:
+    def test_flags_np_in_jitted(self):
+        src = (
+            "import jax\n"
+            "import numpy as np\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    return np.sum(x)\n"
+        )
+        assert rules_of(lint_source(src, select=["numpy-in-traced-code"])) == [
+            "numpy-in-traced-code"
+        ]
+
+    def test_flags_np_reached_through_call_chain(self):
+        src = (
+            "import jax\n"
+            "import numpy as np\n"
+            "def helper(x):\n"
+            "    return np.abs(x)\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    return helper(x)\n"
+        )
+        assert rules_of(lint_source(src, select=["numpy-in-traced-code"])) == [
+            "numpy-in-traced-code"
+        ]
+
+    def test_lru_cache_is_a_host_boundary(self):
+        src = (
+            "import functools, jax\n"
+            "import numpy as np\n"
+            "import jax.numpy as jnp\n"
+            "@functools.lru_cache(maxsize=8)\n"
+            "def table(n):\n"
+            "    return np.arange(n)\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    return x + jnp.asarray(table(4))\n"
+        )
+        assert lint_source(src, select=["numpy-in-traced-code"]) == []
+
+    def test_dtype_accessors_allowed(self):
+        src = (
+            "import jax\n"
+            "import numpy as np\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    return x.astype(np.float32)\n"
+        )
+        assert lint_source(src, select=["numpy-in-traced-code"]) == []
+
+    def test_pallas_kernel_covered(self):
+        src = (
+            "from jax.experimental import pallas as pl\n"
+            "import numpy as np\n"
+            "import jax, jax.numpy as jnp\n"
+            "def kern(x_ref, o_ref):\n"
+            "    o_ref[...] = np.maximum(x_ref[...], 0)\n"
+            "def run(x):\n"
+            "    return pl.pallas_call(kern, out_shape=x)(x)\n"
+        )
+        assert rules_of(lint_source(src, select=["numpy-in-traced-code"])) == [
+            "numpy-in-traced-code"
+        ]
+
+    def test_suppressed(self):
+        src = (
+            "import jax\n"
+            "import numpy as np\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    return np.sum(x)  # graftlint: disable=numpy-in-traced-code\n"
+        )
+        assert lint_source(src, select=["numpy-in-traced-code"]) == []
+
+
+# ---------------------------------------------------------------------------
+# pallas-tile-alignment
+# ---------------------------------------------------------------------------
+
+
+class TestPallasTileAlignment:
+    def test_flags_misaligned_lane(self):
+        src = (
+            "from jax.experimental import pallas as pl\n"
+            "spec = pl.BlockSpec((8, 100), lambda i: (i, 0))\n"
+        )
+        assert rules_of(
+            lint_source(src, select=["pallas-tile-alignment"])
+        ) == ["pallas-tile-alignment"]
+
+    def test_flags_misaligned_sublane(self):
+        src = (
+            "from jax.experimental import pallas as pl\n"
+            "spec = pl.BlockSpec(block_shape=(5, 128), index_map=lambda i: (i, 0))\n"
+        )
+        assert rules_of(
+            lint_source(src, select=["pallas-tile-alignment"])
+        ) == ["pallas-tile-alignment"]
+
+    def test_aligned_and_constant_resolution(self):
+        src = (
+            "from jax.experimental import pallas as pl\n"
+            "_LANE = 128\n"
+            "_SUB = 8\n"
+            "def build():\n"
+            "    tn = _LANE * 2\n"
+            "    return pl.BlockSpec((_SUB, tn), lambda i: (i, 0))\n"
+        )
+        assert lint_source(src, select=["pallas-tile-alignment"]) == []
+
+    def test_size_one_dims_allowed(self):
+        src = (
+            "from jax.experimental import pallas as pl\n"
+            "spec = pl.BlockSpec((1, 128), lambda i: (i, 0))\n"
+        )
+        assert lint_source(src, select=["pallas-tile-alignment"]) == []
+
+    def test_unresolved_dims_not_flagged(self):
+        src = (
+            "from jax.experimental import pallas as pl\n"
+            "def build(bw):\n"
+            "    return pl.BlockSpec((8, bw), lambda i: (i, 0))\n"
+        )
+        assert lint_source(src, select=["pallas-tile-alignment"]) == []
+
+    def test_suppressed(self):
+        src = (
+            "from jax.experimental import pallas as pl\n"
+            "spec = pl.BlockSpec((8, 3), lambda i: (i, 0))"
+            "  # graftlint: disable=pallas-tile-alignment\n"
+        )
+        assert lint_source(src, select=["pallas-tile-alignment"]) == []
+
+
+# ---------------------------------------------------------------------------
+# lock-discipline
+# ---------------------------------------------------------------------------
+
+
+class TestLockDiscipline:
+    PATH = "mmlspark_tpu/runtime/fake.py"  # rule only applies there
+
+    def test_flags_sleep_under_lock(self):
+        src = (
+            "import threading, time\n"
+            "lock = threading.Lock()\n"
+            "def f():\n"
+            "    with lock:\n"
+            "        time.sleep(1)\n"
+        )
+        assert rules_of(
+            lint_source(src, path=self.PATH, select=["lock-discipline"])
+        ) == ["lock-discipline"]
+
+    def test_flags_join_and_queue_get(self):
+        src = (
+            "import threading\n"
+            "lock = threading.Lock()\n"
+            "def f(t, q):\n"
+            "    with lock:\n"
+            "        t.join()\n"
+            "        q.get(timeout=5)\n"
+        )
+        assert (
+            len(lint_source(src, path=self.PATH, select=["lock-discipline"]))
+            == 2
+        )
+
+    def test_str_join_and_dict_get_not_flagged(self):
+        src = (
+            "import threading\n"
+            "lock = threading.Lock()\n"
+            "def f(d):\n"
+            "    with lock:\n"
+            "        s = ','.join(['a', 'b'])\n"
+            "        v = d.get('key')\n"
+            "    return s, v\n"
+        )
+        assert lint_source(src, path=self.PATH, select=["lock-discipline"]) == []
+
+    def test_outside_runtime_serving_not_flagged(self):
+        src = (
+            "import threading, time\n"
+            "lock = threading.Lock()\n"
+            "def f():\n"
+            "    with lock:\n"
+            "        time.sleep(1)\n"
+        )
+        assert (
+            lint_source(
+                src, path="mmlspark_tpu/ops/fake.py", select=["lock-discipline"]
+            )
+            == []
+        )
+
+    def test_suppressed(self):
+        src = (
+            "import threading, time\n"
+            "lock = threading.Lock()\n"
+            "def f():\n"
+            "    with lock:\n"
+            "        time.sleep(1)  # graftlint: disable=lock-discipline\n"
+        )
+        assert lint_source(src, path=self.PATH, select=["lock-discipline"]) == []
+
+
+# ---------------------------------------------------------------------------
+# bare-except-policy
+# ---------------------------------------------------------------------------
+
+
+class TestBareExceptPolicy:
+    def test_flags_silent_swallow(self):
+        src = (
+            "def f():\n"
+            "    try:\n"
+            "        work()\n"
+            "    except Exception:\n"
+            "        pass\n"
+        )
+        assert rules_of(lint_source(src, select=["bare-except-policy"])) == [
+            "bare-except-policy"
+        ]
+
+    def test_reraise_ok(self):
+        src = (
+            "def f():\n"
+            "    try:\n"
+            "        work()\n"
+            "    except Exception:\n"
+            "        cleanup()\n"
+            "        raise\n"
+        )
+        assert lint_source(src, select=["bare-except-policy"]) == []
+
+    def test_logging_ok(self):
+        src = (
+            "import logging\n"
+            "logger = logging.getLogger(__name__)\n"
+            "def f():\n"
+            "    try:\n"
+            "        work()\n"
+            "    except Exception as e:\n"
+            "        logger.warning('failed: %s', e)\n"
+        )
+        assert lint_source(src, select=["bare-except-policy"]) == []
+
+    def test_narrow_exception_ok(self):
+        src = (
+            "def f():\n"
+            "    try:\n"
+            "        work()\n"
+            "    except ValueError:\n"
+            "        pass\n"
+        )
+        assert lint_source(src, select=["bare-except-policy"]) == []
+
+    def test_noqa_justification(self):
+        src = (
+            "def f():\n"
+            "    try:\n"
+            "        work()\n"
+            "    except Exception:  # noqa: BLE001 — best-effort cleanup\n"
+            "        pass\n"
+        )
+        assert lint_source(src, select=["bare-except-policy"]) == []
+
+    def test_graftlint_suppression(self):
+        src = (
+            "def f():\n"
+            "    try:\n"
+            "        work()\n"
+            "    except Exception:  # graftlint: disable=bare-except-policy\n"
+            "        pass\n"
+        )
+        assert lint_source(src, select=["bare-except-policy"]) == []
+
+
+# ---------------------------------------------------------------------------
+# driver / registry / CLI
+# ---------------------------------------------------------------------------
+
+
+class TestDriver:
+    def test_all_five_rules_registered(self):
+        assert set(all_rules()) == {
+            "jit-purity",
+            "numpy-in-traced-code",
+            "pallas-tile-alignment",
+            "lock-discipline",
+            "bare-except-policy",
+        }
+
+    def test_bare_disable_silences_all(self):
+        src = (
+            "import jax, time\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    return x + time.time()  # graftlint: disable\n"
+        )
+        assert lint_source(src) == []
+
+    def test_unknown_rule_rejected(self):
+        with pytest.raises(KeyError):
+            lint_source("x = 1\n", select=["no-such-rule"])
+
+    def test_parse_error_reported_not_raised(self, tmp_path):
+        bad = tmp_path / "bad.py"
+        bad.write_text("def broken(:\n")
+        violations, suppressed, errors = lint_paths([str(bad)])
+        assert violations == [] and len(errors) == 1
+
+    def test_main_exit_codes(self, tmp_path):
+        clean = tmp_path / "clean.py"
+        clean.write_text("x = 1\n")
+        dirty = tmp_path / "dirty.py"
+        dirty.write_text(
+            "import jax, time\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    return x + time.time()\n"
+        )
+        assert main([str(clean)]) == 0
+        assert main([str(dirty), "--fail-on-violation", "-q"]) == 1
+        assert main([]) == 2
+
+    @pytest.mark.slow
+    def test_module_cli_on_package_is_clean(self):
+        proc = subprocess.run(
+            [sys.executable, "-m", "mmlspark_tpu.analysis.lint", "mmlspark_tpu/"],
+            capture_output=True,
+            text=True,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+class TestCrossModule:
+    def test_jit_reaches_imported_module(self, tmp_path):
+        pkg = tmp_path / "mmlspark_tpu"
+        pkg.mkdir()
+        (pkg / "__init__.py").write_text("")
+        (pkg / "kernels.py").write_text(
+            "import numpy as np\n"
+            "def inner(x):\n"
+            "    return np.sum(x)\n"
+        )
+        (pkg / "driver.py").write_text(
+            "import jax\n"
+            "from mmlspark_tpu.kernels import inner\n"
+            "@jax.jit\n"
+            "def step(x):\n"
+            "    return inner(x)\n"
+        )
+        violations, _, errors = lint_paths(
+            [str(pkg)], select=["numpy-in-traced-code"]
+        )
+        assert errors == []
+        assert [v.rule for v in violations] == ["numpy-in-traced-code"]
+        assert violations[0].path.endswith("kernels.py")

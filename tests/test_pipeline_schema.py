@@ -1,0 +1,163 @@
+"""Static pipeline schema validation: ``Pipeline.validate`` rejects
+mis-wired stage graphs before any stage executes (the SparkML
+``transformSchema`` contract)."""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core.pipeline import Pipeline, PipelineModel
+from mmlspark_tpu.core.schema import (
+    DTYPE_MISMATCH,
+    DUPLICATE_OUTPUT_COL,
+    MISSING_INPUT_COL,
+    ColType,
+    SchemaError,
+    as_schema,
+    schema_of_table,
+)
+from mmlspark_tpu.data.table import Table
+from mmlspark_tpu.featurize.clean import CleanMissingData
+from mmlspark_tpu.featurize.featurize import AssembleFeatures
+from mmlspark_tpu.featurize.indexers import ValueIndexer
+from mmlspark_tpu.featurize.text import TextFeaturizer
+from mmlspark_tpu.stages.basic import (
+    DropColumns,
+    RenameColumn,
+    SelectColumns,
+    UDFTransformer,
+)
+from mmlspark_tpu.stages.batching import FixedMiniBatchTransformer, FlattenBatch
+
+
+@pytest.fixture
+def table():
+    return Table(
+        {
+            "a": np.arange(4.0),
+            "b": np.arange(4).astype(np.int32),
+            "vec": np.ones((4, 3), dtype=np.float32),
+            "txt": np.array(["x y", "y z", "z w", "w"], dtype=object),
+        }
+    )
+
+
+class ExplodingStage(DropColumns):
+    """Any execution during validate() is a test failure."""
+
+    def transform(self, table):
+        raise AssertionError("validate() must not execute stages")
+
+    def _fit(self, table):
+        raise AssertionError("validate() must not fit stages")
+
+
+class TestSchemaOfTable:
+    def test_dtypes_and_shapes(self, table):
+        s = schema_of_table(table)
+        assert s["a"] == ColType(np.dtype(np.float64), ())
+        assert s["vec"] == ColType(np.dtype(np.float32), (3,))
+        assert s["txt"].dtype == np.dtype(object)
+
+    def test_as_schema_accepts_dtype_mapping(self):
+        s = as_schema({"a": np.float32, "b": None})
+        assert s["a"].dtype == np.dtype(np.float32)
+        assert s["b"] == ColType()
+
+
+class TestValidChains:
+    def test_valid_chain_passes_and_propagates(self, table):
+        p = Pipeline(
+            stages=[
+                RenameColumn(inputCol="a", outputCol="a2"),
+                CleanMissingData(inputCols=["a2"]),
+                AssembleFeatures(inputCols=["a2", "b", "vec"], outputCol="features"),
+                DropColumns(cols=["txt"]),
+            ]
+        )
+        out = p.validate(table)
+        assert set(out) == {"a2", "b", "vec", "features"}
+        # widths add up statically: 1 (a2) + 1 (b) + 3 (vec)
+        assert out["features"] == ColType(np.dtype(np.float32), (5,))
+
+    def test_accepts_plain_schema_without_table(self):
+        p = Pipeline(stages=[SelectColumns(cols=["a"])])
+        out = p.validate({"a": np.float64, "b": np.int32})
+        assert set(out) == {"a"}
+
+    def test_batching_roundtrip_schema(self, table):
+        p = Pipeline(stages=[FixedMiniBatchTransformer(batchSize=2), FlattenBatch()])
+        out = p.validate(table)
+        assert set(out) == {"a", "b", "vec", "txt"}
+
+    def test_text_featurizer_width(self, table):
+        p = Pipeline(
+            stages=[TextFeaturizer(inputCol="txt", outputCol="tf", numFeatures=64)]
+        )
+        out = p.validate(table)
+        assert out["tf"] == ColType(np.dtype(np.float32), (64,))
+
+    def test_pipeline_model_transform_schema(self, table):
+        pm = PipelineModel(
+            stages=[RenameColumn(inputCol="a", outputCol="a2")]
+        )
+        out = pm.transform_schema(schema_of_table(table))
+        assert "a2" in out and "a" not in out
+
+
+class TestWiringErrors:
+    def test_missing_input_col_names_stage(self, table):
+        p = Pipeline(
+            stages=[
+                DropColumns(cols=["txt"]),
+                SelectColumns(cols=["txt", "a"]),  # txt was just dropped
+            ]
+        )
+        with pytest.raises(SchemaError) as ei:
+            p.validate(table)
+        assert ei.value.kind == MISSING_INPUT_COL
+        assert ei.value.column == "txt"
+        assert "SelectColumns" in str(ei.value) and "1" in ei.value.stage
+
+    def test_dtype_mismatch_names_stage(self):
+        p = Pipeline(stages=[AssembleFeatures(inputCols=["s"], outputCol="f")])
+        with pytest.raises(SchemaError) as ei:
+            p.validate({"s": np.dtype("U16")})
+        assert ei.value.kind == DTYPE_MISMATCH
+        assert "AssembleFeatures" in ei.value.stage
+
+    def test_duplicate_output_col_names_stage(self, table):
+        p = Pipeline(
+            stages=[
+                ValueIndexer(inputCol="txt", outputCol="idx"),
+                RenameColumn(inputCol="a", outputCol="idx"),  # collides
+            ]
+        )
+        with pytest.raises(SchemaError) as ei:
+            p.validate(table)
+        assert ei.value.kind == DUPLICATE_OUTPUT_COL
+        assert "RenameColumn" in ei.value.stage
+        assert ei.value.column == "idx"
+
+    def test_validate_executes_nothing(self, table):
+        p = Pipeline(stages=[ExplodingStage(cols=["nope"])])
+        with pytest.raises(SchemaError) as ei:
+            p.validate(table)
+        assert ei.value.kind == MISSING_INPUT_COL
+
+    def test_fit_validates_before_executing(self, table):
+        p = Pipeline(stages=[ExplodingStage(cols=["nope"])])
+        with pytest.raises(SchemaError):
+            p.fit(table)  # SchemaError, not the stage's AssertionError
+
+    def test_fit_still_works_on_valid_pipeline(self, table):
+        p = Pipeline(
+            stages=[
+                UDFTransformer(
+                    inputCol="a", outputCol="a3", udf=lambda c: c * 3
+                ),
+                DropColumns(cols=["txt"]),
+            ]
+        )
+        out = p.fit(table).transform(table)
+        np.testing.assert_allclose(out.column("a3"), table.column("a") * 3)
+        assert "txt" not in out.columns

@@ -1,0 +1,7 @@
+#!/usr/bin/env bash
+# graftlint CI wrapper (docs/static_analysis.md). Lints the package tree
+# with the framework-aware rule set; extra args are passed through, so
+# `tools/lint.sh --select jit-purity` or `tools/lint.sh tests/` work too.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec python -m mmlspark_tpu.analysis.lint mmlspark_tpu/ --fail-on-violation "$@"
