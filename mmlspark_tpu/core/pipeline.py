@@ -8,10 +8,37 @@ dispatching heavy compute to jitted JAX programs on the TPU mesh.
 
 from __future__ import annotations
 
+import itertools
+import threading
+import time
 from typing import Any, Dict, List, Optional
 
 from mmlspark_tpu.core.params import Param, Params
 from mmlspark_tpu.data.table import Table
+
+# pipeline-fit ids for the event log (the SparkListenerJobStart analogue
+# at pipeline granularity); process-global so concurrent fits don't collide
+_FIT_IDS = itertools.count()
+_FIT_ID_LOCK = threading.Lock()
+
+
+def _next_fit_id() -> int:
+    with _FIT_ID_LOCK:
+        return next(_FIT_IDS)
+
+
+_TRACER = None
+
+
+def _tracer():
+    # cached process-global tracer: PipelineModel.transform is the serving
+    # hot path and must not pay import-machinery cost per call
+    global _TRACER
+    if _TRACER is None:
+        from mmlspark_tpu.observability.tracing import get_tracer
+
+        _TRACER = get_tracer()
+    return _TRACER
 
 
 class PipelineStage(Params):
@@ -99,24 +126,57 @@ class Pipeline(Estimator):
         return _chain_schema(self.getStages(), schema)
 
     def _fit(self, table: Table) -> "PipelineModel":
+        from mmlspark_tpu.observability.events import (
+            ModelCommitted, StageCompleted, StageStarted, get_bus,
+        )
+        from mmlspark_tpu.observability.tracing import get_tracer
+
         self.validate(table)
+        bus, tracer = get_bus(), get_tracer()
+        fit_id = _next_fit_id()
         fitted: List[Transformer] = []
         cur = table
         stages = self.getStages()
         for i, stage in enumerate(stages):
-            if isinstance(stage, Estimator):
-                model = stage.fit(cur)
-                fitted.append(model)
-                if i < len(stages) - 1:
-                    cur = model.transform(cur)
-            elif isinstance(stage, Transformer):
-                fitted.append(stage)
-                if i < len(stages) - 1:
-                    cur = stage.transform(cur)
-            else:
-                raise TypeError(f"stage {stage!r} is neither Estimator nor Transformer")
+            name = type(stage).__name__
+            if bus.active:
+                bus.publish(StageStarted(
+                    job_id=fit_id, stage_id=i, name=name, phase="fit"
+                ))
+            t0 = time.monotonic()
+            status = "ok"
+            try:
+                with tracer.span(f"fit:{name}", stage=i):
+                    if isinstance(stage, Estimator):
+                        model = stage.fit(cur)
+                        fitted.append(model)
+                        if i < len(stages) - 1:
+                            cur = model.transform(cur)
+                    elif isinstance(stage, Transformer):
+                        fitted.append(stage)
+                        if i < len(stages) - 1:
+                            cur = stage.transform(cur)
+                    else:
+                        raise TypeError(
+                            f"stage {stage!r} is neither Estimator nor Transformer"
+                        )
+            except BaseException as e:
+                status = type(e).__name__
+                raise
+            finally:
+                if bus.active:
+                    bus.publish(StageCompleted(
+                        job_id=fit_id, stage_id=i, name=name,
+                        duration=time.monotonic() - t0, phase="fit",
+                        status=status,
+                    ))
         model = PipelineModel(stages=fitted)
         model.parent = self
+        if bus.active:
+            bus.publish(ModelCommitted(
+                model=type(model).__name__, version=fit_id,
+                detail=f"{len(fitted)} stages",
+            ))
         return model
 
 
@@ -124,8 +184,18 @@ class PipelineModel(Model):
     stages = Param("The fitted pipeline stages", default=[], is_complex=True)
 
     def transform(self, table: Table) -> Table:
-        for stage in self.getStages():
-            table = stage.transform(table)
+        # stage spans open only when an ambient span exists to join (a
+        # serving request's apply span, a fit span, an explicit
+        # tracer.span(...) around the call) — a bare untraced transform
+        # pays one contextvar read, nothing more
+        tracer = _tracer()
+        if tracer.current() is None:
+            for stage in self.getStages():
+                table = stage.transform(table)
+            return table
+        for i, stage in enumerate(self.getStages()):
+            with tracer.span(f"transform:{type(stage).__name__}", stage=i):
+                table = stage.transform(table)
         return table
 
     def transform_schema(self, schema: Dict[str, Any]) -> Dict[str, Any]:

@@ -82,9 +82,14 @@ class StopWatch:
         try:
             yield
         finally:
-            self._totals[phase] = self._totals.get(phase, 0.0) + (
-                time.perf_counter() - t0
-            )
+            self.add(phase, time.perf_counter() - t0)
+
+    def add(self, phase: str, seconds: float) -> None:
+        """Fold an externally-timed duration into ``phase`` — the public
+        form of what :meth:`measure` records, for callers that already
+        hold a measured interval (e.g. the scheduler's queue-wait/run
+        times, which are timestamp differences across threads)."""
+        self._totals[phase] = self._totals.get(phase, 0.0) + seconds
 
     def summary(self) -> Dict[str, float]:
         return dict(self._totals)

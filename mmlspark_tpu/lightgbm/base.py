@@ -349,10 +349,15 @@ class LightGBMBase(LightGBMParams, Estimator):
         pol = ambient or runtime.SchedulerPolicy(
             max_workers=self.getNumExecutors(), seed=self.getSeed()
         )
+        from mmlspark_tpu.observability.tracing import get_tracer
+
         self._runtime_metrics = runtime.RuntimeMetrics()
-        bins, mapper = bin_dataset_partitioned(
-            X, policy=pol, metrics=self._runtime_metrics, **kwargs
-        )
+        with get_tracer().span(
+            "lightgbm.binning", rows=int(getattr(X, "shape", (0,))[0])
+        ):
+            bins, mapper = bin_dataset_partitioned(
+                X, policy=pol, metrics=self._runtime_metrics, **kwargs
+            )
         self._runtime_metrics.log(prefix="binning: ")
         return bins, mapper
 
@@ -439,6 +444,15 @@ class LightGBMBase(LightGBMParams, Estimator):
         # isProvideTrainingMetric) — transient, like the reference's
         # delegate-observed metrics
         model._train_evals = result.evals
+        from mmlspark_tpu.observability.events import ModelCommitted, get_bus
+
+        bus = get_bus()
+        if bus.active:
+            bus.publish(ModelCommitted(
+                model=type(model).__name__,
+                detail=f"{result.booster.num_trees} trees"
+                if getattr(result, "booster", None) is not None else "",
+            ))
         return model
 
     def _fit_batches(

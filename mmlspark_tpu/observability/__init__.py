@@ -1,0 +1,84 @@
+"""mmlspark_tpu.observability — the unified observability plane.
+
+The reference framework leaned on Spark's ListenerBus/event-log/UI and
+metrics system for every operational question; this package is the
+self-owned replacement (``docs/observability.md``), three cooperating
+pieces wired through core, runtime, serving, stages, and lightgbm fit:
+
+- :mod:`~mmlspark_tpu.observability.events`  — typed event bus with a
+  JSON-lines event-log sink (``MMLSPARK_TPU_EVENT_LOG=/path``), replayable
+  into a timeline summary;
+- :mod:`~mmlspark_tpu.observability.tracing` — Dapper-style Span/Tracer
+  with ``contextvars`` propagation and deterministic span ids; serving
+  propagates one trace id request -> batch -> model-apply across threads;
+- :mod:`~mmlspark_tpu.observability.registry` — Prometheus-style
+  counters/gauges/latency-histograms with text exposition, served live at
+  ``GET /metrics`` (and ``GET /healthz``) on every serving endpoint.
+
+Quick start::
+
+    import os
+    os.environ["MMLSPARK_TPU_EVENT_LOG"] = "/tmp/events.jsonl"
+
+    model = pipeline.fit(table)          # stage events + spans recorded
+    with ServingServer(model) as srv:    # GET /metrics, GET /healthz live
+        ...
+
+    from mmlspark_tpu import observability as obs
+    print(obs.format_timeline(obs.timeline(obs.replay("/tmp/events.jsonl"))))
+    print(obs.get_registry().exposition())
+"""
+
+from mmlspark_tpu.observability.events import (
+    BatchFormed,
+    Event,
+    EventBus,
+    EventLogSink,
+    ModelCommitted,
+    RequestServed,
+    StageCompleted,
+    StageStarted,
+    TaskDispatched,
+    TaskFailed,
+    TaskRetried,
+    format_timeline,
+    from_record,
+    get_bus,
+    replay,
+    timeline,
+)
+from mmlspark_tpu.observability.registry import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    get_registry,
+)
+from mmlspark_tpu.observability.tracing import Span, Tracer, get_tracer
+
+__all__ = [
+    "BatchFormed",
+    "Counter",
+    "Event",
+    "EventBus",
+    "EventLogSink",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "ModelCommitted",
+    "RequestServed",
+    "Span",
+    "StageCompleted",
+    "StageStarted",
+    "TaskDispatched",
+    "TaskFailed",
+    "TaskRetried",
+    "Tracer",
+    "format_timeline",
+    "from_record",
+    "get_bus",
+    "get_registry",
+    "get_tracer",
+    "replay",
+    "timeline",
+]

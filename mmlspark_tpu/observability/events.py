@@ -1,0 +1,360 @@
+"""Typed event bus — the ListenerBus/event-log analogue (SURVEY.md §5).
+
+Spark answers "what happened during this job" with its ListenerBus: every
+subsystem posts typed events, listeners subscribe, and the event log
+persists the stream for post-hoc replay in the UI. This module is that
+plane for the TPU framework:
+
+- typed events (:class:`StageStarted` .. :class:`ModelCommitted`) carry
+  monotonic timestamps plus job/stage/task ids;
+- :class:`EventBus` publishes synchronously to registered listeners
+  (listener errors are logged, never propagated — a misbehaving listener
+  must not fail a fit);
+- :class:`EventLogSink` appends each event as one JSON line; setting
+  ``MMLSPARK_TPU_EVENT_LOG=/path`` attaches it to the process-global bus;
+- :func:`replay` reads a log back into events, and :func:`timeline`
+  folds them into the summary the Spark UI would have drawn (per-stage
+  durations, task retry/failure counts, request latency stats).
+
+Publishing is engineered to be near-free when nobody listens: call sites
+guard on ``bus.active`` so disabled runs don't even construct the event.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import threading
+import time
+from typing import Any, Callable, Dict, IO, Iterable, List, Optional, Type
+
+from mmlspark_tpu.core.profiling import get_logger
+
+logger = get_logger("mmlspark_tpu.observability")
+
+_EVENT_TYPES: Dict[str, Type["Event"]] = {}
+
+
+def _event(cls):
+    """Register an event dataclass in the replay registry."""
+    cls = dataclasses.dataclass(cls)
+    _EVENT_TYPES[cls.__name__] = cls
+    return cls
+
+
+@dataclasses.dataclass
+class Event:
+    """Base event: ``t`` is ``time.monotonic()`` at publish (durations and
+    ordering within one process; wall-clock does not survive NTP steps)."""
+
+    t: float = dataclasses.field(default=0.0, kw_only=True)
+
+    def __post_init__(self) -> None:
+        if not self.t:
+            self.t = time.monotonic()
+
+    def to_record(self) -> Dict[str, Any]:
+        rec: Dict[str, Any] = {"event": type(self).__name__}
+        rec.update(dataclasses.asdict(self))
+        return rec
+
+
+# -- pipeline ----------------------------------------------------------------
+
+
+@_event
+class StageStarted(Event):
+    """``Pipeline.fit``/``transform`` entered a stage (SparkListenerStageSubmitted)."""
+
+    job_id: int
+    stage_id: int
+    name: str
+    phase: str = "fit"  # "fit" | "transform"
+
+
+@_event
+class StageCompleted(Event):
+    """A stage finished (SparkListenerStageCompleted); ``status`` is "ok" or
+    the exception class name."""
+
+    job_id: int
+    stage_id: int
+    name: str
+    duration: float
+    phase: str = "fit"
+    status: str = "ok"
+
+
+# -- runtime scheduler -------------------------------------------------------
+
+
+@_event
+class TaskDispatched(Event):
+    """The scheduler handed an attempt to the executor pool."""
+
+    job_id: int
+    task_id: int
+    attempt: int
+    queue_depth: int
+
+
+@_event
+class TaskRetried(Event):
+    """An attempt failed within the retry budget; the task was re-queued."""
+
+    job_id: int
+    task_id: int
+    failures: int
+    reason: str
+
+
+@_event
+class TaskFailed(Event):
+    """An attempt failed; ``permanent`` marks retry-budget exhaustion."""
+
+    job_id: int
+    task_id: int
+    reason: str
+    permanent: bool = False
+
+
+# -- serving -----------------------------------------------------------------
+
+
+@_event
+class BatchFormed(Event):
+    """The micro-batch loop gathered one batch (epoch = batch id)."""
+
+    epoch: int
+    size: int
+    trace_id: str = ""
+
+
+@_event
+class RequestServed(Event):
+    """One HTTP request was answered (status 499 = client disconnected
+    before the reply could be written)."""
+
+    rid: str
+    status: int
+    latency: float
+    trace_id: str = ""
+
+
+@_event
+class ModelCommitted(Event):
+    """A fitted model became current (end of ``fit`` / model swap)."""
+
+    model: str
+    version: int = 0
+    detail: str = ""
+
+
+# -- bus ---------------------------------------------------------------------
+
+
+class EventBus:
+    """Synchronous typed event bus (the ListenerBus analogue).
+
+    Listeners are plain callables ``listener(event)``. ``publish`` runs
+    them in registration order on the publishing thread; a listener that
+    raises is logged at DEBUG and skipped — observability must never fail
+    the observed workload.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._listeners: List[Callable[[Event], None]] = []
+
+    @property
+    def active(self) -> bool:
+        """True when at least one listener is attached. Hot call sites
+        guard event construction on this, so a quiet bus costs one
+        attribute read."""
+        return bool(self._listeners)
+
+    def add_listener(self, listener: Callable[[Event], None]) -> None:
+        with self._lock:
+            if listener not in self._listeners:
+                self._listeners = self._listeners + [listener]
+
+    def remove_listener(self, listener: Callable[[Event], None]) -> None:
+        # equality, not identity: a bound method (``obj.method``) is a new
+        # object on every attribute access, but compares == to itself
+        with self._lock:
+            self._listeners = [l for l in self._listeners if l != listener]
+
+    def publish(self, event: Event) -> None:
+        for listener in self._listeners:  # snapshot semantics: list is replaced, not mutated
+            try:
+                listener(event)
+            except Exception as e:  # noqa: BLE001 - listeners must not break the workload
+                logger.debug("event listener %r failed: %s", listener, e)
+
+
+class EventLogSink:
+    """JSON-lines event log: one ``{"event": <type>, ...}`` object per
+    line, appended and flushed per event so a crash loses at most the
+    in-flight record (the Spark event-log posture)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._lock = threading.Lock()
+        self._fh: Optional[IO[str]] = open(path, "a", encoding="utf-8")
+
+    def __call__(self, event: Event) -> None:
+        with self._lock:
+            if self._fh is None:
+                return
+            self._fh.write(json.dumps(event.to_record()) + "\n")
+            self._fh.flush()
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
+
+
+# -- process-global bus + env-driven sink ------------------------------------
+
+_BUS = EventBus()
+_ENV_SINK: Optional[EventLogSink] = None
+_ENV_LOCK = threading.Lock()
+
+
+def get_bus() -> EventBus:
+    """The process-global bus. Each call re-syncs the env-driven sink:
+    setting ``MMLSPARK_TPU_EVENT_LOG=/path`` before a component grabs the
+    bus attaches the JSON-lines sink; unsetting it detaches."""
+    _sync_env_sink()
+    return _BUS
+
+
+def _sync_env_sink() -> None:
+    global _ENV_SINK
+    import os
+
+    path = os.environ.get("MMLSPARK_TPU_EVENT_LOG")
+    current = _ENV_SINK.path if _ENV_SINK is not None else None
+    if path == current:
+        return
+    with _ENV_LOCK:
+        if _ENV_SINK is not None:
+            _BUS.remove_listener(_ENV_SINK)
+            _ENV_SINK.close()
+            _ENV_SINK = None
+        if path:
+            try:
+                _ENV_SINK = EventLogSink(path)
+            except OSError as e:
+                logger.warning("MMLSPARK_TPU_EVENT_LOG=%s unusable: %s", path, e)
+                return
+            _BUS.add_listener(_ENV_SINK)
+
+
+# -- replay + timeline -------------------------------------------------------
+
+
+def from_record(rec: Dict[str, Any]) -> Event:
+    """Rebuild a typed event from one decoded JSON-lines record."""
+    kind = rec.get("event")
+    cls = _EVENT_TYPES.get(kind or "")
+    if cls is None:
+        raise ValueError(f"unknown event type {kind!r}")
+    fields = {f.name for f in dataclasses.fields(cls)}
+    return cls(**{k: v for k, v in rec.items() if k in fields})
+
+
+def replay(path: str) -> List[Event]:
+    """Read an event log back into typed events (skips blank lines)."""
+    out: List[Event] = []
+    with open(path, "r", encoding="utf-8") as fh:
+        for line in fh:
+            line = line.strip()
+            if line:
+                out.append(from_record(json.loads(line)))
+    return out
+
+
+def timeline(events: Iterable[Event]) -> Dict[str, Any]:
+    """Fold an event stream into the summary the Spark UI would draw:
+    per-stage wall times, task dispatch/retry/failure counts, serving
+    batch/request stats, committed models."""
+    stages: Dict[Any, Dict[str, Any]] = {}
+    tasks = {"dispatched": 0, "retried": 0, "failed": 0, "failed_permanent": 0}
+    retry_reasons: Dict[str, int] = {}
+    batches = {"count": 0, "rows": 0}
+    latencies: List[float] = []
+    statuses: Dict[int, int] = {}
+    models: List[str] = []
+    for ev in events:
+        if isinstance(ev, StageStarted):
+            stages.setdefault(
+                (ev.job_id, ev.stage_id, ev.phase),
+                {"name": ev.name, "phase": ev.phase, "start": ev.t},
+            )
+        elif isinstance(ev, StageCompleted):
+            rec = stages.setdefault(
+                (ev.job_id, ev.stage_id, ev.phase),
+                {"name": ev.name, "phase": ev.phase, "start": ev.t - ev.duration},
+            )
+            rec["duration"] = ev.duration
+            rec["status"] = ev.status
+        elif isinstance(ev, TaskDispatched):
+            tasks["dispatched"] += 1
+        elif isinstance(ev, TaskRetried):
+            tasks["retried"] += 1
+            retry_reasons[ev.reason] = retry_reasons.get(ev.reason, 0) + 1
+        elif isinstance(ev, TaskFailed):
+            tasks["failed"] += 1
+            if ev.permanent:
+                tasks["failed_permanent"] += 1
+        elif isinstance(ev, BatchFormed):
+            batches["count"] += 1
+            batches["rows"] += ev.size
+        elif isinstance(ev, RequestServed):
+            latencies.append(ev.latency)
+            statuses[ev.status] = statuses.get(ev.status, 0) + 1
+        elif isinstance(ev, ModelCommitted):
+            models.append(ev.model)
+    requests: Dict[str, Any] = {"count": len(latencies), "statuses": statuses}
+    if latencies:
+        ordered = sorted(latencies)
+        requests["latency_p50"] = ordered[len(ordered) // 2]
+        requests["latency_max"] = ordered[-1]
+    return {
+        "stages": [stages[k] for k in sorted(stages)],
+        "tasks": dict(tasks, retry_reasons=retry_reasons),
+        "batches": batches,
+        "requests": requests,
+        "models": models,
+    }
+
+
+def format_timeline(summary: Dict[str, Any]) -> str:
+    """Render a :func:`timeline` summary as the one-screen text report."""
+    lines = ["== stages =="]
+    for s in summary["stages"]:
+        dur = s.get("duration")
+        lines.append(
+            f"  [{s['phase']}] {s['name']}: "
+            + (f"{dur:.4f}s" if dur is not None else "unfinished")
+            + (f" ({s['status']})" if s.get("status", "ok") != "ok" else "")
+        )
+    t = summary["tasks"]
+    lines.append(
+        f"== tasks == dispatched={t['dispatched']} retried={t['retried']} "
+        f"failed={t['failed']} permanent={t['failed_permanent']}"
+    )
+    b, r = summary["batches"], summary["requests"]
+    lines.append(f"== serving == batches={b['count']} rows={b['rows']} "
+                 f"requests={r['count']}")
+    if "latency_p50" in r:
+        lines.append(
+            f"   latency p50={r['latency_p50'] * 1e3:.2f}ms "
+            f"max={r['latency_max'] * 1e3:.2f}ms"
+        )
+    if summary["models"]:
+        lines.append("== models == " + ", ".join(summary["models"]))
+    return "\n".join(lines)

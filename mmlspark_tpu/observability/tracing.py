@@ -1,0 +1,223 @@
+"""Request/stage tracing — Dapper-style spans with ``contextvars`` propagation.
+
+Spark's UI reconstructs "what ran inside what" from listener events; a
+serving stack needs the stronger form: a trace id minted at the request
+edge that survives thread hops (HTTP handler -> micro-batch loop ->
+model apply) so one request's full span tree can be read back. This
+module is that layer:
+
+- :class:`Span` — name, ids, monotonic start/end, tags, status;
+- :class:`Tracer` — ``with tracer.span("stage"):`` opens a child of the
+  ambient span (a ``contextvars.ContextVar``, so nesting follows the
+  call stack and is async/thread-correct); ``start_span``/``finish``
+  are the manual form for spans that cross threads (the scheduler's
+  attempts, the serving batch loop);
+- ids are **deterministic**: process-wide counters, not random — two
+  identical single-threaded runs produce identical span ids, which is
+  what replay-based tests want;
+- every span entered through the context manager is bridged into
+  :func:`mmlspark_tpu.core.profiling.annotate`, so an active xprof
+  device trace shows the same names as the exported span tree.
+
+Finished spans accumulate in a bounded ring (default 4096) and export
+to JSON via :meth:`Tracer.export`.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import contextvars
+import dataclasses
+import json
+import threading
+import time
+from typing import Any, Dict, Iterator, List, Optional
+
+
+@dataclasses.dataclass
+class Span:
+    name: str
+    trace_id: str
+    span_id: str
+    parent_id: Optional[str]
+    start: float
+    end: Optional[float] = None
+    status: str = "ok"
+    tags: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    @property
+    def duration(self) -> Optional[float]:
+        return None if self.end is None else self.end - self.start
+
+    def to_record(self) -> Dict[str, Any]:
+        return {
+            "name": self.name,
+            "trace_id": self.trace_id,
+            "span_id": self.span_id,
+            "parent_id": self.parent_id,
+            "start": self.start,
+            "end": self.end,
+            "duration": self.duration,
+            "status": self.status,
+            "tags": dict(self.tags),
+        }
+
+
+class Tracer:
+    """Span factory + ambient-span propagation + finished-span ring.
+
+    ``xprof=True`` (the default) mirrors context-managed spans into
+    ``core.profiling.annotate`` so device traces carry the same names;
+    the bridge is skipped silently when jax is unavailable.
+    """
+
+    def __init__(self, max_spans: int = 4096, xprof: bool = True):
+        self._lock = threading.Lock()
+        self._trace_seq = 0
+        self._span_seq = 0
+        self._finished: "collections.deque[Span]" = collections.deque(
+            maxlen=max_spans
+        )
+        self._current: "contextvars.ContextVar[Optional[Span]]" = (
+            contextvars.ContextVar("mmlspark_tpu_span", default=None)
+        )
+        self._xprof = xprof
+
+    # -- ids (deterministic: counters, not random) ---------------------------
+
+    def _next_ids(self, parent: Optional[Span]) -> tuple:
+        with self._lock:
+            self._span_seq += 1
+            span_id = f"{self._span_seq:08x}"
+            if parent is not None:
+                return parent.trace_id, span_id
+            self._trace_seq += 1
+            return f"t{self._trace_seq:08x}", span_id
+
+    # -- ambient span --------------------------------------------------------
+
+    def current(self) -> Optional[Span]:
+        return self._current.get()
+
+    @contextlib.contextmanager
+    def attach(self, span: Optional[Span]) -> Iterator[None]:
+        """Make ``span`` ambient for the body — how a worker thread joins
+        a trace started elsewhere (pass the parent captured at submit)."""
+        token = self._current.set(span)
+        try:
+            yield
+        finally:
+            self._current.reset(token)
+
+    # -- manual spans (cross-thread lifecycles) ------------------------------
+
+    def start_span(
+        self,
+        name: str,
+        parent: Optional[Span] = None,
+        **tags: Any,
+    ) -> Span:
+        """Open a span without making it ambient. ``parent=None`` uses the
+        ambient span; a detached root needs an explicit ``parent`` of a
+        fresh trace (or no ambient span)."""
+        parent = parent if parent is not None else self.current()
+        trace_id, span_id = self._next_ids(parent)
+        return Span(
+            name=name,
+            trace_id=trace_id,
+            span_id=span_id,
+            parent_id=parent.span_id if parent is not None else None,
+            start=time.monotonic(),
+            tags=dict(tags),
+        )
+
+    def finish(self, span: Span, status: str = "ok", **tags: Any) -> Span:
+        span.end = time.monotonic()
+        span.status = status
+        if tags:
+            span.tags.update(tags)
+        with self._lock:
+            self._finished.append(span)
+        return span
+
+    # -- context-managed spans (the common form) -----------------------------
+
+    @contextlib.contextmanager
+    def span(
+        self,
+        name: str,
+        parent: Optional[Span] = None,
+        **tags: Any,
+    ) -> Iterator[Span]:
+        """Open a span as a child of ``parent`` (default: the ambient
+        span), make it ambient for the body, finish it on exit (status =
+        exception class name on error), and mirror the name into any
+        active xprof trace."""
+        sp = self.start_span(name, parent=parent, **tags)
+        token = self._current.set(sp)
+        try:
+            with self._annotate(name):
+                yield sp
+        except BaseException as e:
+            self.finish(sp, status=type(e).__name__)
+            raise
+        else:
+            self.finish(sp)
+        finally:
+            self._current.reset(token)
+
+    @contextlib.contextmanager
+    def _annotate(self, name: str) -> Iterator[None]:
+        if not self._xprof:
+            yield
+            return
+        try:
+            from mmlspark_tpu.core.profiling import annotate
+        except ImportError:  # pragma: no cover - jax is a hard dep in practice
+            yield
+            return
+        with annotate(name):
+            yield
+
+    # -- export --------------------------------------------------------------
+
+    def export(self, trace_id: Optional[str] = None) -> List[Dict[str, Any]]:
+        """Finished spans as JSON-able records, oldest first; optionally
+        filtered to one trace."""
+        with self._lock:
+            spans = list(self._finished)
+        return [
+            s.to_record()
+            for s in spans
+            if trace_id is None or s.trace_id == trace_id
+        ]
+
+    def to_json(self, trace_id: Optional[str] = None) -> str:
+        return json.dumps(self.export(trace_id), indent=2)
+
+    def span_tree(self, trace_id: str) -> Dict[str, Any]:
+        """One trace as a nested dict (children under "children"), the
+        shape the acceptance check reads: request -> batch -> apply."""
+        records = self.export(trace_id)
+        by_id = {r["span_id"]: dict(r, children=[]) for r in records}
+        roots = []
+        for r in by_id.values():
+            parent = by_id.get(r["parent_id"])
+            if parent is not None:
+                parent["children"].append(r)
+            else:
+                roots.append(r)
+        return {"trace_id": trace_id, "roots": roots}
+
+    def clear(self) -> None:
+        with self._lock:
+            self._finished.clear()
+
+
+_TRACER = Tracer()
+
+
+def get_tracer() -> Tracer:
+    """The process-global tracer every instrumented layer shares."""
+    return _TRACER

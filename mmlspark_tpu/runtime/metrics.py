@@ -5,6 +5,13 @@ Follows ``core/profiling.py`` conventions: an accumulating object with
 through :func:`~mmlspark_tpu.core.profiling.get_logger`, exactly like
 :class:`~mmlspark_tpu.core.profiling.StopWatch` (aggregate queue-wait/run
 phase times ride an embedded StopWatch, so existing log tooling applies).
+
+Every ``note_*`` also feeds the process-global
+:class:`~mmlspark_tpu.observability.registry.MetricsRegistry` (counters
+named ``scheduler_*``, queue-wait/run latency histograms), so a serving
+endpoint's ``GET /metrics`` scrape carries scheduler state without any
+extra wiring; pass an explicit ``registry`` for an isolated one (tests
+assert registry counters equal :meth:`summary` exactly).
 """
 
 from __future__ import annotations
@@ -15,13 +22,14 @@ import threading
 from typing import Dict, Optional
 
 from mmlspark_tpu.core.profiling import StopWatch, get_logger
+from mmlspark_tpu.observability.registry import MetricsRegistry, get_registry
 
 
 class RuntimeMetrics:
     """Thread-safe counters/timings for one scheduler (accumulates across
     jobs when the scheduler is reused, e.g. the serving dispatch loop)."""
 
-    def __init__(self) -> None:
+    def __init__(self, registry: Optional[MetricsRegistry] = None) -> None:
         self._lock = threading.Lock()
         self.stopwatch = StopWatch()  # aggregate "queue_wait"/"run" phases
         #: task index -> {"queue_wait": s, "run": s, "attempts": n}
@@ -29,6 +37,39 @@ class RuntimeMetrics:
         self.retries: "collections.Counter[int]" = collections.Counter()
         self.counters: "collections.Counter[str]" = collections.Counter()
         self.max_queue_depth = 0
+        # registry bridge: the same counts, scrapeable (docs/observability.md)
+        reg = registry if registry is not None else get_registry()
+        self.registry = reg
+        self._reg_tasks_done = reg.counter(
+            "scheduler_tasks_done_total", "Tasks completed successfully"
+        )
+        self._reg_dispatches = reg.counter(
+            "scheduler_dispatches_total", "Attempts handed to the executor pool"
+        )
+        self._reg_retries = reg.counter(
+            "scheduler_retries_total", "Task re-dispatches after a failure"
+        )
+        self._reg_failures = reg.counter(
+            "scheduler_failures_total",
+            "Attempt failures by reason (error/executor_death/timeout/heartbeat)",
+        )
+        self._reg_recomputes = reg.counter(
+            "scheduler_lineage_recomputes_total",
+            "Lost partitions rebuilt from lineage",
+        )
+        self._reg_wasted = reg.counter(
+            "scheduler_wasted_results_total",
+            "Superseded attempts whose late result was discarded",
+        )
+        self._reg_queue_depth = reg.gauge(
+            "scheduler_max_queue_depth", "High-water executor queue depth"
+        )
+        self._reg_queue_wait = reg.histogram(
+            "scheduler_task_queue_wait_seconds", "Dispatch-to-start wait per attempt"
+        )
+        self._reg_run = reg.histogram(
+            "scheduler_task_run_seconds", "Run time of successful attempts"
+        )
 
     # -- recording (called by the scheduler/executors) ----------------------
 
@@ -36,6 +77,8 @@ class RuntimeMetrics:
         with self._lock:
             self.counters["dispatches"] += 1
             self.max_queue_depth = max(self.max_queue_depth, queue_depth)
+        self._reg_dispatches.inc()
+        self._reg_queue_depth.set_max(queue_depth)
 
     def note_start(self, index: int, queue_wait: float) -> None:
         with self._lock:
@@ -45,6 +88,7 @@ class RuntimeMetrics:
             t["queue_wait"] += queue_wait
             t["attempts"] += 1
         self._accumulate_phase("queue_wait", queue_wait)
+        self._reg_queue_wait.observe(queue_wait)
 
     def note_done(self, index: int, run_seconds: float) -> None:
         with self._lock:
@@ -54,33 +98,38 @@ class RuntimeMetrics:
             t["run"] += run_seconds
             self.counters["tasks_done"] += 1
         self._accumulate_phase("run", run_seconds)
+        self._reg_tasks_done.inc()
+        self._reg_run.observe(run_seconds)
 
     def _accumulate_phase(self, phase: str, seconds: float) -> None:
-        # StopWatch only accumulates through measure(); fold externally
-        # timed spans into the same phase table so sw.log()/summary() work
-        totals = self.stopwatch._totals
-        totals[phase] = totals.get(phase, 0.0) + seconds
+        # externally timed spans fold into the same phase table so
+        # sw.log()/summary() work (StopWatch.add is the public form)
+        self.stopwatch.add(phase, seconds)
 
     def note_retry(self, index: int) -> None:
         with self._lock:
             self.retries[index] += 1
             self.counters["retries_total"] += 1
+        self._reg_retries.inc()
 
     def note_failure(self, index: int, reason: str) -> None:
         """reason: 'error' | 'executor_death' | 'timeout' | 'heartbeat'."""
         with self._lock:
             self.counters["failures_total"] += 1
             self.counters[f"failures_{reason}"] += 1
+        self._reg_failures.labels(reason=reason).inc()
 
     def note_recompute(self, index: int) -> None:
         with self._lock:
             self.counters["lineage_recomputes"] += 1
+        self._reg_recomputes.inc()
 
     def note_wasted_result(self) -> None:
         """A superseded attempt (timeout / heartbeat loss) reported late;
         its result was discarded."""
         with self._lock:
             self.counters["wasted_results"] += 1
+        self._reg_wasted.inc()
 
     # -- reporting (core/profiling conventions) -----------------------------
 

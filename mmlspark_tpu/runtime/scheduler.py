@@ -23,6 +23,7 @@ from __future__ import annotations
 import contextlib
 import dataclasses
 import enum
+import itertools
 import threading
 import time
 from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence, Union
@@ -30,12 +31,29 @@ from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence, Unio
 import numpy as np
 
 from mmlspark_tpu.core.profiling import get_logger
+from mmlspark_tpu.observability.events import (
+    TaskDispatched,
+    TaskFailed,
+    TaskRetried,
+    get_bus,
+)
+from mmlspark_tpu.observability.tracing import get_tracer
 from mmlspark_tpu.runtime.executor import ExecutorPool
 from mmlspark_tpu.runtime.faults import FaultPlan, current_faults
 from mmlspark_tpu.runtime.lineage import Lineage, PartitionLostError, ShardLineage
 from mmlspark_tpu.runtime.metrics import RuntimeMetrics
 
 logger = get_logger("mmlspark_tpu.runtime")
+
+# job ids are process-global so event-log records from concurrent fits
+# never collide (the SparkListenerJobStart jobId analogue)
+_JOB_IDS = itertools.count()
+_JOB_ID_LOCK = threading.Lock()
+
+
+def _next_job_id() -> int:
+    with _JOB_ID_LOCK:
+        return next(_JOB_IDS)
 
 
 class TaskState(enum.Enum):
@@ -116,6 +134,9 @@ class _Attempt:
         self.worker = None
         self.dispatched_at = time.monotonic()
         self.started_at: Optional[float] = None
+        #: tracing span opened at dispatch; finished by whichever side
+        #: settles the attempt (success, failure, or driver supersede)
+        self.span = None
 
     # -- executor-side hooks -------------------------------------------------
 
@@ -162,6 +183,8 @@ class _Job:
         self.policy = policy
         self.metrics = metrics
         self.lineage = lineage
+        self.id = _next_job_id()
+        self.bus = get_bus()
         self.tasks = [TaskRecord(i, payload) for i, payload in enumerate(shards)]
         self.cond = threading.Condition()
         self.pending = set(range(len(self.tasks)))
@@ -197,6 +220,8 @@ class _Job:
             t.result = result
             self.done_count += 1
             self.metrics.note_done(t.index, time.monotonic() - (att.started_at or att.dispatched_at))
+            if att.span is not None:
+                get_tracer().finish(att.span)
             self.cond.notify_all()
 
     def _on_failure(self, att: _Attempt, err: BaseException, executor_died: bool) -> None:
@@ -206,9 +231,10 @@ class _Job:
                 return
             t = att.task
             del self.running[t.index]
-            self._register_failure(
-                t, err, "executor_death" if executor_died else "error"
-            )
+            reason = "executor_death" if executor_died else "error"
+            if att.span is not None:
+                get_tracer().finish(att.span, status=reason, error=str(err)[:200])
+            self._register_failure(t, err, reason)
             self.cond.notify_all()
 
     def _register_failure(self, t: TaskRecord, err: BaseException, reason: str) -> None:
@@ -216,13 +242,19 @@ class _Job:
         Caller holds ``self.cond``."""
         t.failures += 1
         self.metrics.note_failure(t.index, reason)
+        permanent = t.failures > self.policy.max_retries
+        if self.bus.active:
+            self.bus.publish(TaskFailed(
+                job_id=self.id, task_id=t.index, reason=reason,
+                permanent=permanent,
+            ))
         if (
             isinstance(err, PartitionLostError)
             and self.lineage is not None
             and self.lineage.has(t.index)
         ):
             t.needs_recompute = True
-        if t.failures > self.policy.max_retries:
+        if permanent:
             t.state = TaskState.FAILED
             t.error = err
             self.failed.append(t)
@@ -232,6 +264,11 @@ class _Job:
             )
         else:
             self.metrics.note_retry(t.index)
+            if self.bus.active:
+                self.bus.publish(TaskRetried(
+                    job_id=self.id, task_id=t.index, failures=t.failures,
+                    reason=reason,
+                ))
             t.state = TaskState.PENDING
             t.not_before = time.monotonic() + self.policy.backoff(t.index, t.failures)
             self.pending.add(t.index)
@@ -281,26 +318,32 @@ class Scheduler:
         if not shards:
             return []
         job = _Job(fn, shards, self.policy, self.metrics, lineage)
-        while True:
-            with job.cond:
-                if job.finished():
-                    break
-                now = time.monotonic()
-                self._dispatch_due(job, now)
-                self._monitor(job, now)
-                timeout = self._wait_timeout(job, now)
-                job.cond.wait(timeout)
-            # Replace any executor that died (ExecutorDeathError exit) or
-            # was declared lost (stale heartbeat) — outside the job lock,
-            # since spawning threads under it serves nothing.
-            if self.pool.alive_count < self.pool.target_workers:
-                self.pool.ensure_capacity()
-        if job.failed:
-            first = job.failed[0]
-            raise JobFailedError(
-                f"{len(job.failed)}/{len(job.tasks)} tasks failed permanently; "
-                f"first: task {first.index} after {first.failures} attempts"
-            ) from first.error
+        # the job span parents every attempt span (attempts are children,
+        # retries siblings); under a pipeline-stage or serving-apply span
+        # the whole tree hangs off one trace id
+        with get_tracer().span(
+            "scheduler.job", job_id=job.id, tasks=len(job.tasks)
+        ):
+            while True:
+                with job.cond:
+                    if job.finished():
+                        break
+                    now = time.monotonic()
+                    self._dispatch_due(job, now)
+                    self._monitor(job, now)
+                    timeout = self._wait_timeout(job, now)
+                    job.cond.wait(timeout)
+                # Replace any executor that died (ExecutorDeathError exit) or
+                # was declared lost (stale heartbeat) — outside the job lock,
+                # since spawning threads under it serves nothing.
+                if self.pool.alive_count < self.pool.target_workers:
+                    self.pool.ensure_capacity()
+            if job.failed:
+                first = job.failed[0]
+                raise JobFailedError(
+                    f"{len(job.failed)}/{len(job.tasks)} tasks failed permanently; "
+                    f"first: task {first.index} after {first.failures} attempts"
+                ) from first.error
         return [t.result for t in job.tasks]
 
     def _dispatch_due(self, job: _Job, now: float) -> None:
@@ -320,7 +363,19 @@ class Scheduler:
             t.attempt = att.id
             t.state = TaskState.RUNNING
             job.running[index] = att
-            self.metrics.note_dispatch(index, self.pool.queue_depth() + 1)
+            depth = self.pool.queue_depth() + 1
+            self.metrics.note_dispatch(index, depth)
+            # attempt spans: children of scheduler.job; a retry opens a
+            # NEW span, so failed attempts read as siblings tagged with
+            # their failure reason
+            att.span = get_tracer().start_span(
+                f"task-{index}", job_id=job.id, attempt=t.failures
+            )
+            if job.bus.active:
+                job.bus.publish(TaskDispatched(
+                    job_id=job.id, task_id=index, attempt=t.failures,
+                    queue_depth=depth,
+                ))
             self.pool.submit(att)
 
     def _monitor(self, job: _Job, now: float) -> bool:
@@ -338,6 +393,8 @@ class Scheduler:
             ):
                 att.superseded.set()
                 del job.running[index]
+                if att.span is not None:
+                    get_tracer().finish(att.span, status="timeout")
                 job._register_failure(
                     t,
                     TaskLostError(
@@ -352,6 +409,8 @@ class Scheduler:
             ):
                 att.superseded.set()
                 del job.running[index]
+                if att.span is not None:
+                    get_tracer().finish(att.span, status="heartbeat")
                 self.pool.declare_lost(att.worker)
                 lost = True
                 job._register_failure(

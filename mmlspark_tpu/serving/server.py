@@ -43,8 +43,15 @@ import numpy as np
 
 from mmlspark_tpu.core.pipeline import Transformer
 from mmlspark_tpu.data.table import Table
+from mmlspark_tpu.observability.events import BatchFormed, RequestServed, get_bus
+from mmlspark_tpu.observability.registry import get_registry
+from mmlspark_tpu.observability.tracing import Span, get_tracer
 
 logger = logging.getLogger("mmlspark_tpu.serving")
+
+#: micro-batch sizes are small integers; latency-style buckets would put
+#: every batch in the first bucket
+_BATCH_SIZE_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0, 256.0)
 
 
 class _Server(ThreadingHTTPServer):
@@ -62,6 +69,11 @@ class _PendingRequest:
     status: int = 200
     epoch: int = -1
     retries: int = 0
+    # observability: contextvars don't cross the listener->loop thread hop,
+    # so the request's root span rides the request object itself
+    t_submit: float = 0.0
+    span: Optional[Span] = None
+    trace_id: str = ""
 
 
 @dataclass
@@ -95,6 +107,7 @@ class _BatchLoop:
         max_latency_ms: float,
         max_retries: int = 1,
         scheduler=None,
+        registry=None,
     ):
         self.model = model
         self.input_col = input_col
@@ -112,10 +125,39 @@ class _BatchLoop:
         self._lock = threading.Lock()
         self._stopping = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        #: monotonic time of the last processed batch (healthz freshness)
+        self.last_batch_at: Optional[float] = None
+        # metrics plane (docs/observability.md); pass a registry for isolation
+        reg = registry if registry is not None else get_registry()
+        self.registry = reg
+        self._reg_requests = reg.counter(
+            "serving_requests_total", "Requests answered by the batch loop"
+        )
+        self._reg_replies_failed = reg.counter(
+            "serving_replies_failed_total",
+            "Replies lost because the client disconnected before the write",
+        )
+        self._reg_batches = reg.counter(
+            "serving_batches_total", "Micro-batches evaluated"
+        )
+        self._reg_queue_wait = reg.histogram(
+            "serving_queue_wait_seconds",
+            "Submit-to-batch wait per request",
+        )
+        self._reg_batch_size = reg.histogram(
+            "serving_batch_size", "Requests per micro-batch",
+            buckets=_BATCH_SIZE_BUCKETS,
+        )
+        self._reg_apply = reg.histogram(
+            "serving_apply_latency_seconds",
+            "Model apply time per micro-batch",
+        )
 
     # -- intake / reply ------------------------------------------------------
 
     def submit(self, req: _PendingRequest) -> None:
+        if not req.t_submit:
+            req.t_submit = time.monotonic()
         self.queue.put(req)
 
     def _reply(self, req: _PendingRequest, value: Any, status: int = 200) -> None:
@@ -127,6 +169,20 @@ class _BatchLoop:
         req.response = json.dumps({self.output_col: value}).encode("utf-8")
         req.status = status
         req.event.set()
+
+    def note_reply_failure(self, rid: str, exc: BaseException) -> None:
+        """The answer existed but the client hung up before the write — a
+        visibility gap in the reference (a dropped keep-alive connection
+        surfaced only as a stack trace). Status 499 follows nginx's
+        'client closed request' convention."""
+        self._reg_replies_failed.inc()
+        bus = get_bus()
+        if bus.active:
+            bus.publish(RequestServed(rid=rid, status=499, latency=0.0))
+        logger.debug(
+            "reply to %s lost, client disconnected (%s: %s)",
+            rid, type(exc).__name__, exc,
+        )
 
     # -- batching ------------------------------------------------------------
 
@@ -183,6 +239,24 @@ class _BatchLoop:
             r.epoch = epoch
         with self._lock:
             self._history[epoch] = batch  # re-hydration bookkeeping
+        now = time.monotonic()
+        self.last_batch_at = now
+        self._reg_batches.inc()
+        self._reg_batch_size.observe(len(batch))
+        for r in batch:
+            if r.t_submit:
+                self._reg_queue_wait.observe(now - r.t_submit)
+        # The batch joins the FIRST request's trace (a batch has one parent;
+        # the remaining requests keep their own root spans), so at least one
+        # request's trace id threads request -> batch -> apply -> reply.
+        tracer = get_tracer()
+        parent = next((r.span for r in batch if r.span is not None), None)
+        bus = get_bus()
+        if bus.active:
+            bus.publish(BatchFormed(
+                epoch=epoch, size=len(batch),
+                trace_id=parent.trace_id if parent else "",
+            ))
         try:
             payloads = np.empty(len(batch), dtype=object)
             for i, r in enumerate(batch):
@@ -192,10 +266,17 @@ class _BatchLoop:
                 col = np.stack(payloads)  # rectangular -> fast path
             except (ValueError, TypeError):
                 col = payloads  # ragged payloads stay an object column
-            out = self._apply_model(Table({self.input_col: col}))
+            t0 = time.perf_counter()
+            with tracer.span(
+                "serving.batch", parent=parent, epoch=epoch, size=len(batch)
+            ):
+                with tracer.span("serving.apply"):
+                    out = self._apply_model(Table({self.input_col: col}))
+            self._reg_apply.observe(time.perf_counter() - t0)
             values = out.column(self.output_col)
             for r, v in zip(batch, values):
                 self._reply(r, v)
+                self._reg_requests.inc()
             self.commit(epoch)
         except Exception as e:
             logger.warning(
@@ -217,6 +298,7 @@ class _BatchLoop:
                 r.response = err
                 r.status = 500
                 r.event.set()
+                self._reg_requests.inc()
 
     def _serve_loop(self) -> None:
         while not self._stopping.is_set():
@@ -267,7 +349,25 @@ class _BatchLoop:
 class _ListenerMixin:
     """HTTP edge shared by the serving classes: parse, submit, await."""
 
+    def health(self) -> Dict[str, Any]:
+        """Liveness snapshot served at ``GET /healthz``."""
+        loop: _BatchLoop = self.loop  # type: ignore[attr-defined]
+        last = loop.last_batch_at
+        now = time.monotonic()
+        return {
+            "status": "ok",
+            "name": getattr(self, "name", "serving"),
+            "uptime_seconds": round(now - self._started_at, 3),
+            "model_epoch": loop._epoch,
+            "last_batch_age_seconds": (
+                round(now - last, 3) if last is not None else None
+            ),
+            "uncommitted_epochs": len(loop.uncommitted_epochs),
+        }
+
     def _make_handler(self, loop: _BatchLoop, input_col: str):
+        server = self
+
         class Handler(BaseHTTPRequestHandler):
             # HTTP/1.1: connections persist across requests, so steady-state
             # clients skip TCP setup per call — the "sub-millisecond" serving
@@ -280,12 +380,27 @@ class _ListenerMixin:
             protocol_version = "HTTP/1.1"
             disable_nagle_algorithm = True
 
-            def _reply_bytes(self, status: int, data: bytes) -> None:
+            def _reply_bytes(
+                self, status: int, data: bytes,
+                content_type: str = "application/json",
+            ) -> None:
                 self.send_response(status)
-                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Type", content_type)
                 self.send_header("Content-Length", str(len(data)))
                 self.end_headers()
                 self.wfile.write(data)
+
+            def do_GET(self):  # noqa: N802 (http.server API)
+                if self.path == "/metrics":
+                    body = loop.registry.exposition().encode("utf-8")
+                    self._reply_bytes(
+                        200, body,
+                        content_type="text/plain; version=0.0.4; charset=utf-8",
+                    )
+                elif self.path == "/healthz":
+                    self._reply_bytes(200, json.dumps(server.health()).encode())
+                else:
+                    self._reply_bytes(404, b'{"error": "not found"}')
 
             def do_POST(self):  # noqa: N802 (http.server API)
                 length = int(self.headers.get("Content-Length", 0))
@@ -298,12 +413,38 @@ class _ListenerMixin:
                 if isinstance(payload, dict) and input_col in payload:
                     payload = payload[input_col]
                 req = _PendingRequest(rid=uuid.uuid4().hex, payload=payload)
+                tracer = get_tracer()
+                # listener threads carry no ambient span, so this is a trace
+                # root: the request mints the trace id the batch loop joins
+                span = tracer.start_span("serving.request", rid=req.rid)
+                # honor a caller-supplied trace id (cross-service stitching)
+                upstream = self.headers.get("X-Trace-Id")
+                if upstream:
+                    span.tags["upstream_trace_id"] = upstream
+                req.span, req.trace_id = span, span.trace_id
                 loop.submit(req)
                 req.event.wait(timeout=30.0)
                 if req.response is None:
-                    self._reply_bytes(504, b'{"error": "timeout"}')
+                    status, data = 504, b'{"error": "timeout"}'
+                else:
+                    status, data = req.status, req.response
+                try:
+                    self._reply_bytes(status, data)
+                except OSError as e:
+                    # client disconnect on the reply path: answer computed
+                    # but unwritable — count it, don't stack-trace (the
+                    # satellite fix; see docs/observability.md)
+                    loop.note_reply_failure(req.rid, e)
+                    tracer.finish(span, status="disconnect")
                     return
-                self._reply_bytes(req.status, req.response)
+                tracer.finish(span, status=str(status))
+                bus = get_bus()
+                if bus.active:
+                    bus.publish(RequestServed(
+                        rid=req.rid, status=status,
+                        latency=time.monotonic() - req.t_submit,
+                        trace_id=req.trace_id,
+                    ))
 
             def log_message(self, *args):  # silence default stderr logging
                 pass
@@ -333,14 +474,16 @@ class ServingServer(_ListenerMixin):
         max_retries: int = 1,
         name: str = "serving",
         loop: Optional[_BatchLoop] = None,
+        registry=None,
     ):
         self.input_col = input_col
         self.output_col = output_col
         self.name = name
         self._owns_loop = loop is None
+        self._started_at = time.monotonic()
         self.loop = loop or _BatchLoop(
             model, input_col, output_col, max_batch_size, max_latency_ms,
-            max_retries,
+            max_retries, registry=registry,
         )
         self._httpd = _Server((host, port), self._make_handler(self.loop, input_col))
         self.info = ServiceInfo(name, host, self._httpd.server_address[1])
@@ -377,6 +520,7 @@ class RegistrationService:
     def __init__(self, host: str = "127.0.0.1", port: int = 0):
         self._services: Dict[str, ServiceInfo] = {}
         self._lock = threading.Lock()
+        self._started_at = time.monotonic()
         registry = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -400,16 +544,31 @@ class RegistrationService:
                 self.end_headers()
 
             def do_GET(self):  # noqa: N802
-                if self.path != "/services":
+                ctype = "application/json"
+                if self.path == "/services":
+                    with registry._lock:
+                        body = json.dumps(
+                            [vars(s) for s in registry._services.values()]
+                        ).encode()
+                elif self.path == "/metrics":
+                    body = get_registry().exposition().encode("utf-8")
+                    ctype = "text/plain; version=0.0.4; charset=utf-8"
+                elif self.path == "/healthz":
+                    with registry._lock:
+                        n = len(registry._services)
+                    body = json.dumps({
+                        "status": "ok",
+                        "uptime_seconds": round(
+                            time.monotonic() - registry._started_at, 3
+                        ),
+                        "registered_services": n,
+                    }).encode()
+                else:
                     self.send_response(404)
                     self.end_headers()
                     return
-                with registry._lock:
-                    body = json.dumps(
-                        [vars(s) for s in registry._services.values()]
-                    ).encode()
                 self.send_response(200)
-                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)

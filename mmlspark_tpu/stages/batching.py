@@ -43,6 +43,8 @@ class _MiniBatchBase(Transformer):
 
 
 def _batch_table(table: Table, bounds: List[tuple]) -> Table:
+    from mmlspark_tpu.observability.events import BatchFormed, get_bus
+
     cols: Dict[str, np.ndarray] = {}
     for name in table.columns:
         col = table.column(name)
@@ -50,6 +52,10 @@ def _batch_table(table: Table, bounds: List[tuple]) -> Table:
         for i, (lo, hi) in enumerate(bounds):
             out[i] = col[lo:hi]
         cols[name] = out
+    bus = get_bus()
+    if bus.active:
+        for i, (lo, hi) in enumerate(bounds):
+            bus.publish(BatchFormed(epoch=i, size=hi - lo))
     batched = Table(cols)
     batched.num_partitions = table.num_partitions
     return batched

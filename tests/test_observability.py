@@ -1,0 +1,558 @@
+"""observability/ tests — event bus, tracing, metrics registry, and the
+bridges into the runtime scheduler and serving layers.
+
+The registry tests use FRESH ``MetricsRegistry`` instances (never the
+process-global one) so they cannot interfere with other tests feeding the
+shared plane; the fault-injection bridge test pins
+``MMLSPARK_TPU_FAULT_SEED`` so the recovery sequence — and therefore every
+counter — is identical on every run.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu import runtime
+from mmlspark_tpu.core.pipeline import Pipeline, Transformer
+from mmlspark_tpu.core.profiling import StopWatch
+from mmlspark_tpu.data import Table
+from mmlspark_tpu.observability import (
+    BatchFormed,
+    EventBus,
+    EventLogSink,
+    MetricsRegistry,
+    ModelCommitted,
+    RequestServed,
+    StageCompleted,
+    StageStarted,
+    TaskDispatched,
+    TaskFailed,
+    TaskRetried,
+    Tracer,
+    format_timeline,
+    from_record,
+    get_bus,
+    get_tracer,
+    replay,
+    timeline,
+)
+from mmlspark_tpu.serving import ServingServer
+from mmlspark_tpu.serving.server import _BatchLoop
+
+
+class _Doubler(Transformer):
+    def transform(self, table):
+        x = np.asarray(table.column("input"), dtype=np.float64)
+        return table.with_column("prediction", x * 2)
+
+
+def _get(url, timeout=10):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.status, r.headers.get("Content-Type", ""), r.read().decode()
+
+
+def _post(url, payload, timeout=10):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(), method="POST",
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read())
+
+
+# ---------------------------------------------------------------------------
+# metrics registry
+# ---------------------------------------------------------------------------
+
+
+class TestRegistry:
+    def test_counter_get_or_create_and_monotonic(self):
+        reg = MetricsRegistry()
+        c = reg.counter("requests_total", "Requests")
+        assert reg.counter("requests_total") is c
+        c.inc()
+        c.inc(2.5)
+        assert c.value == 3.5
+        with pytest.raises(ValueError):
+            c.inc(-1)
+
+    def test_type_collision_is_an_error(self):
+        reg = MetricsRegistry()
+        reg.counter("x_total")
+        with pytest.raises(ValueError, match="already registered"):
+            reg.gauge("x_total")
+
+    def test_gauge_set_max(self):
+        reg = MetricsRegistry()
+        g = reg.gauge("depth")
+        g.set_max(3)
+        g.set_max(1)
+        assert g.value == 3
+        g.set(0.5)
+        g.dec(0.25)
+        assert g.value == 0.25
+
+    def test_labels_render_as_child_series(self):
+        reg = MetricsRegistry()
+        c = reg.counter("failures_total", "By reason")
+        c.labels(reason="timeout").inc(2)
+        c.labels(reason="timeout").inc()
+        c.labels(reason='we"ird\\').inc()
+        text = reg.exposition()
+        assert '# TYPE failures_total counter' in text
+        assert 'failures_total{reason="timeout"} 3' in text
+        assert 'failures_total{reason="we\\"ird\\\\"} 1' in text
+
+    def test_histogram_percentiles_and_exposition(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("lat_seconds", "Latency", buckets=(0.01, 0.1, 1.0))
+        for v in (0.005,) * 50 + (0.05,) * 45 + (0.5,) * 5:
+            h.observe(v)
+        s = h.summary()
+        assert s["count"] == 100
+        assert 0.0 < s["p50"] <= 0.01
+        assert 0.01 < s["p95"] <= 0.1
+        assert 0.1 < s["p99"] <= 1.0
+        text = reg.exposition()
+        assert "# TYPE lat_seconds histogram" in text
+        assert 'lat_seconds_bucket{le="0.01"} 50' in text
+        assert 'lat_seconds_bucket{le="0.1"} 95' in text
+        assert 'lat_seconds_bucket{le="+Inf"} 100' in text
+        assert "lat_seconds_count 100" in text
+
+    def test_histogram_overflow_clamps_to_last_bucket(self):
+        h = MetricsRegistry().histogram("h", buckets=(1.0, 2.0))
+        h.observe(50.0)
+        assert h.percentile(0.99) == 2.0
+
+    def test_summary_shape(self):
+        reg = MetricsRegistry()
+        reg.counter("a_total").inc()
+        reg.counter("b_total").labels(kind="x").inc(2)
+        reg.histogram("h_seconds").observe(0.2)
+        s = reg.summary()
+        assert s["a_total"] == 1
+        assert s["b_total"]["kind=x"] == 2
+        assert s["h_seconds"]["count"] == 1
+
+
+# ---------------------------------------------------------------------------
+# event bus + event log
+# ---------------------------------------------------------------------------
+
+
+class TestEventBus:
+    def test_publish_reaches_listeners_in_order(self):
+        bus = EventBus()
+        seen = []
+        bus.add_listener(lambda e: seen.append(("first", e)))
+        bus.add_listener(lambda e: seen.append(("second", e)))
+        assert bus.active
+        ev = BatchFormed(epoch=0, size=4)
+        bus.publish(ev)
+        assert [tag for tag, _ in seen] == ["first", "second"]
+        assert all(e is ev for _, e in seen)
+
+    def test_inactive_without_listeners(self):
+        assert not EventBus().active
+
+    def test_listener_errors_never_propagate(self):
+        bus = EventBus()
+        seen = []
+        bus.add_listener(lambda e: (_ for _ in ()).throw(RuntimeError("boom")))
+        bus.add_listener(seen.append)
+        bus.publish(ModelCommitted(model="M"))
+        assert len(seen) == 1  # the broken listener was skipped, not fatal
+
+    def test_events_carry_monotonic_timestamps(self):
+        a = StageStarted(job_id=0, stage_id=0, name="s")
+        b = StageCompleted(job_id=0, stage_id=0, name="s", duration=0.1)
+        assert 0 < a.t <= b.t
+
+    def test_record_round_trip(self):
+        ev = TaskRetried(job_id=1, task_id=2, failures=1, reason="timeout")
+        back = from_record(ev.to_record())
+        assert back == ev
+        with pytest.raises(ValueError, match="unknown event"):
+            from_record({"event": "NotAnEvent"})
+
+    def test_env_sink_replay_and_timeline(self, tmp_path, monkeypatch):
+        path = tmp_path / "events.jsonl"
+        monkeypatch.setenv("MMLSPARK_TPU_EVENT_LOG", str(path))
+        bus = get_bus()
+        try:
+            assert bus.active
+            bus.publish(StageStarted(job_id=0, stage_id=0, name="Scale"))
+            bus.publish(StageCompleted(
+                job_id=0, stage_id=0, name="Scale", duration=0.5
+            ))
+            bus.publish(TaskDispatched(
+                job_id=0, task_id=0, attempt=0, queue_depth=1
+            ))
+            bus.publish(TaskFailed(job_id=0, task_id=0, reason="error"))
+            bus.publish(RequestServed(rid="r1", status=200, latency=0.002))
+            bus.publish(ModelCommitted(model="PipelineModel", version=3))
+        finally:
+            monkeypatch.delenv("MMLSPARK_TPU_EVENT_LOG")
+            get_bus()  # re-sync detaches + closes the sink
+        events = replay(str(path))
+        assert [type(e).__name__ for e in events] == [
+            "StageStarted", "StageCompleted", "TaskDispatched", "TaskFailed",
+            "RequestServed", "ModelCommitted",
+        ]
+        summary = timeline(events)
+        assert summary["stages"][0]["duration"] == 0.5
+        assert summary["tasks"] == {
+            "dispatched": 1, "retried": 0, "failed": 1, "failed_permanent": 0,
+            "retry_reasons": {},
+        }
+        assert summary["requests"]["statuses"] == {200: 1}
+        assert summary["models"] == ["PipelineModel"]
+        text = format_timeline(summary)
+        assert "Scale" in text and "dispatched=1" in text
+
+    def test_sink_is_json_lines(self, tmp_path):
+        sink = EventLogSink(str(tmp_path / "ev.jsonl"))
+        sink(BatchFormed(epoch=1, size=2, trace_id="t01"))
+        sink.close()
+        [line] = (tmp_path / "ev.jsonl").read_text().splitlines()
+        rec = json.loads(line)
+        assert rec["event"] == "BatchFormed"
+        assert rec["epoch"] == 1 and rec["size"] == 2
+
+
+# ---------------------------------------------------------------------------
+# tracing
+# ---------------------------------------------------------------------------
+
+
+class TestTracing:
+    def test_nesting_follows_call_stack(self):
+        tr = Tracer(xprof=False)
+        with tr.span("outer") as outer:
+            with tr.span("inner") as inner:
+                assert tr.current() is inner
+            assert tr.current() is outer
+        assert tr.current() is None
+        assert inner.trace_id == outer.trace_id
+        assert inner.parent_id == outer.span_id
+        assert outer.parent_id is None
+
+    def test_ids_are_deterministic(self):
+        # counter-based ids: two fresh tracers mint identical sequences
+        tr1, tr2 = Tracer(xprof=False), Tracer(xprof=False)
+        ids1 = [(s.trace_id, s.span_id)
+                for s in (tr1.start_span("a") for _ in range(3))]
+        ids2 = [(s.trace_id, s.span_id)
+                for s in (tr2.start_span("a") for _ in range(3))]
+        assert ids1 == ids2
+        assert len(set(ids1)) == 3
+
+    def test_exception_sets_status(self):
+        tr = Tracer(xprof=False)
+        with pytest.raises(KeyError):
+            with tr.span("doomed"):
+                raise KeyError("k")
+        [rec] = tr.export()
+        assert rec["status"] == "KeyError"
+
+    def test_cross_thread_propagation_via_attach(self):
+        tr = Tracer(xprof=False)
+        root = tr.start_span("request")
+        child_ids = []
+
+        def worker():
+            with tr.attach(root):
+                with tr.span("batch"):
+                    child_ids.append(tr.current().parent_id)
+
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join()
+        tr.finish(root)
+        assert child_ids == [root.span_id]
+        tree = tr.span_tree(root.trace_id)
+        assert tree["roots"][0]["name"] == "request"
+        assert tree["roots"][0]["children"][0]["name"] == "batch"
+
+    def test_export_filters_by_trace(self):
+        tr = Tracer(xprof=False)
+        with tr.span("a") as a:
+            pass
+        with tr.span("b"):
+            pass
+        assert [r["name"] for r in tr.export(a.trace_id)] == ["a"]
+        assert len(tr.export()) == 2
+        tr.clear()
+        assert tr.export() == []
+
+
+# ---------------------------------------------------------------------------
+# profiling satellite: StopWatch.add
+# ---------------------------------------------------------------------------
+
+
+class TestStopWatchAdd:
+    def test_add_is_the_public_form_of_measure(self):
+        sw = StopWatch()
+        sw.add("run", 1.5)
+        sw.add("run", 0.5)
+        with sw.measure("other"):
+            pass
+        s = sw.summary()
+        assert s["run"] == 2.0
+        assert s["other"] >= 0.0
+
+    def test_runtime_metrics_uses_public_api(self):
+        # the encapsulation leak (reaching into StopWatch._totals) is gone
+        m = runtime.RuntimeMetrics(registry=MetricsRegistry())
+        m.note_start(0, 0.25)
+        m.note_done(0, 1.0)
+        assert m.stopwatch.summary() == {"queue_wait": 0.25, "run": 1.0}
+
+
+# ---------------------------------------------------------------------------
+# scheduler bridge: registry counters == RuntimeMetrics.summary() EXACTLY,
+# under deterministic fault injection
+# ---------------------------------------------------------------------------
+
+
+class TestSchedulerRegistryBridge:
+    def _run_chaos(self):
+        # one executor death, one heartbeat loss, one lineage recompute —
+        # every recovery path feeds the registry
+        plan = runtime.FaultPlan().kill_task(1).drop_heartbeat(0)
+        lin = runtime.Lineage()
+        for i, v in enumerate((10, 20, 30, 40)):
+            lin.record(i, (lambda v=v: v), describe=f"src{v}")
+        first = {"seen": False}
+        lock = threading.Lock()
+
+        def work(x):
+            with lock:
+                if not first["seen"]:
+                    first["seen"] = True
+                    raise runtime.PartitionLostError("buffer evicted")
+            # first dispatch hands the shard; a post-recompute retry hands
+            # the already-materialized value
+            v = x.materialize() if hasattr(x, "materialize") else x
+            return v * 2
+
+        reg = MetricsRegistry()
+        m = runtime.RuntimeMetrics(registry=reg)
+        pol = runtime.SchedulerPolicy(
+            max_workers=2, backoff_base=0.01, heartbeat_interval=0.02,
+            heartbeat_timeout=0.15, faults=plan,
+        )
+        out = runtime.run_partitioned(
+            work, list(lin._shards.values()), pol, metrics=m, lineage=lin,
+        )
+        assert out == [20, 40, 60, 80]
+        assert ("kill", 1, 0) in plan.fired
+        return reg, m
+
+    def test_counters_match_summary_exactly(self, monkeypatch):
+        monkeypatch.setenv("MMLSPARK_TPU_FAULT_SEED", "0")
+        reg, m = self._run_chaos()
+        s = m.summary()
+        r = reg.summary()
+        # chaos actually happened
+        assert s["retries_total"] >= 2
+        assert s["failures_executor_death"] == 1
+        assert s["lineage_recomputes"] == 1
+        # exact equality between the two planes, counter by counter
+        assert r["scheduler_tasks_done_total"] == s["tasks_done"]
+        assert r["scheduler_dispatches_total"] == s["dispatches"]
+        assert r["scheduler_retries_total"] == s["retries_total"]
+        assert r["scheduler_lineage_recomputes_total"] == s["lineage_recomputes"]
+        assert r["scheduler_wasted_results_total"] == s["wasted_results"]
+        assert r["scheduler_max_queue_depth"] == s["max_queue_depth"]
+        failures = r["scheduler_failures_total"]
+        for reason in ("error", "executor_death", "timeout", "heartbeat"):
+            assert failures.get(f"reason={reason}", 0) == s[f"failures_{reason}"]
+        assert sum(failures.values()) == s["failures_total"]
+        # phase totals mirror the latency histograms
+        phases = s["phases"]
+        assert r["scheduler_task_queue_wait_seconds"]["sum"] == pytest.approx(
+            phases.get("queue_wait", 0.0)
+        )
+        assert r["scheduler_task_run_seconds"]["sum"] == pytest.approx(
+            phases.get("run", 0.0)
+        )
+
+    def test_scheduler_publishes_task_events(self, monkeypatch):
+        monkeypatch.setenv("MMLSPARK_TPU_FAULT_SEED", "0")
+        events = []
+        listener = events.append
+        bus = get_bus()
+        bus.add_listener(listener)
+        try:
+            plan = runtime.FaultPlan().kill_task(0)
+            pol = runtime.SchedulerPolicy(
+                max_workers=2, backoff_base=0.01, heartbeat_interval=0.02,
+                faults=plan,
+            )
+            out = runtime.run_partitioned(lambda x: x + 1, [1, 2], pol)
+        finally:
+            bus.remove_listener(listener)
+        assert out == [2, 3]
+        kinds = [type(e).__name__ for e in events]
+        assert kinds.count("TaskDispatched") == 3  # 2 tasks + 1 retry
+        assert "TaskFailed" in kinds
+        assert "TaskRetried" in kinds
+        retried = next(e for e in events if isinstance(e, TaskRetried))
+        assert retried.reason == "executor_death"
+        failed = next(e for e in events if isinstance(e, TaskFailed))
+        assert failed.permanent is False
+
+
+# ---------------------------------------------------------------------------
+# serving bridge: endpoints, histograms, reply-failure satellite
+# ---------------------------------------------------------------------------
+
+
+class TestServingObservability:
+    def test_metrics_and_healthz_endpoints(self):
+        reg = MetricsRegistry()
+        with ServingServer(_Doubler(), max_latency_ms=1.0, registry=reg) as srv:
+            base = srv.info.url.rstrip("/")
+            for i in range(4):
+                status, out = _post(base, {"input": float(i)})
+                assert status == 200 and out["prediction"] == 2.0 * i
+            status, ctype, text = _get(base + "/metrics")
+            assert status == 200
+            assert ctype.startswith("text/plain")
+            assert "# TYPE serving_requests_total counter" in text
+            assert "serving_requests_total 4" in text
+            assert "# TYPE serving_queue_wait_seconds histogram" in text
+            assert "serving_apply_latency_seconds_count" in text
+            status, ctype, body = _get(base + "/healthz")
+            health = json.loads(body)
+            assert status == 200 and health["status"] == "ok"
+            assert health["uptime_seconds"] >= 0
+            assert health["model_epoch"] >= 1
+            assert health["last_batch_age_seconds"] is not None
+            assert health["uncommitted_epochs"] == 0
+            with pytest.raises(urllib.error.HTTPError) as err:
+                _get(base + "/nope")
+            assert err.value.code == 404
+        # histogram counts line up with the traffic
+        s = reg.summary()
+        assert s["serving_queue_wait_seconds"]["count"] == 4
+        assert s["serving_batch_size"]["count"] >= 1
+        assert s["serving_apply_latency_seconds"]["count"] >= 1
+
+    def test_request_trace_threads_into_batch_and_apply(self):
+        with ServingServer(_Doubler(), max_latency_ms=1.0,
+                           registry=MetricsRegistry()) as srv:
+            status, _ = _post(srv.info.url, {"input": 1.0})
+            assert status == 200
+        # the handler finishes the root span AFTER writing the reply, so
+        # the client can observe the response a beat before the span lands
+        tracer = get_tracer()
+        deadline = time.monotonic() + 2.0
+        root = None
+        while root is None and time.monotonic() < deadline:
+            root = next(
+                (r for r in reversed(tracer.export())
+                 if r["name"] == "serving.request"), None,
+            )
+            if root is None:
+                time.sleep(0.01)
+        assert root is not None, "request span never finished"
+        names = {r["name"] for r in tracer.export(root["trace_id"])}
+        assert {"serving.request", "serving.batch", "serving.apply"} <= names
+
+    def test_reply_failure_counts_and_logs_debug(self, caplog):
+        reg = MetricsRegistry()
+        loop = _BatchLoop(_Doubler(), "input", "prediction", 8, 1.0,
+                          registry=reg)
+        events = []
+        listener = events.append
+        bus = get_bus()
+        bus.add_listener(listener)
+        try:
+            with caplog.at_level("DEBUG", logger="mmlspark_tpu.serving"):
+                loop.note_reply_failure("rid-1", BrokenPipeError(32, "gone"))
+        finally:
+            bus.remove_listener(listener)
+        assert reg.summary()["serving_replies_failed_total"] == 1
+        served = [e for e in events if isinstance(e, RequestServed)]
+        assert served and served[0].status == 499 and served[0].rid == "rid-1"
+        assert any(
+            "client disconnected" in r.message and r.levelname == "DEBUG"
+            for r in caplog.records
+        )
+
+
+# ---------------------------------------------------------------------------
+# pipeline bridge
+# ---------------------------------------------------------------------------
+
+
+class TestPipelineEvents:
+    def test_fit_emits_stage_and_model_events(self):
+        events = []
+        listener = events.append
+        bus = get_bus()
+        bus.add_listener(listener)
+        try:
+            table = Table({"input": np.arange(4.0)})
+            model = Pipeline(stages=[_Doubler()]).fit(table)
+            out = model.transform(table)
+        finally:
+            bus.remove_listener(listener)
+        assert np.allclose(out.column("prediction"), np.arange(4.0) * 2)
+        kinds = [type(e).__name__ for e in events]
+        assert kinds[0] == "StageStarted"
+        assert "StageCompleted" in kinds
+        assert kinds[-1] == "ModelCommitted"
+        started = next(e for e in events if isinstance(e, StageStarted))
+        completed = next(e for e in events if isinstance(e, StageCompleted))
+        assert started.name == completed.name == "_Doubler"
+        assert completed.status == "ok" and completed.duration >= 0
+
+    def test_fit_failure_reports_status(self):
+        class _Boom(Transformer):
+            def transform(self, table):
+                raise RuntimeError("no")
+
+        events = []
+        listener = events.append
+        bus = get_bus()
+        bus.add_listener(listener)
+        try:
+            with pytest.raises(RuntimeError):
+                # two stages force a transform of the first stage's output
+                Pipeline(stages=[_Boom(), _Doubler()]).fit(
+                    Table({"input": np.arange(3.0)})
+                )
+        finally:
+            bus.remove_listener(listener)
+        completed = [e for e in events if isinstance(e, StageCompleted)]
+        assert completed and completed[0].status == "RuntimeError"
+
+    def test_transform_opens_stage_spans_inside_a_trace(self):
+        tracer = get_tracer()
+        model = Pipeline(stages=[_Doubler()]).fit(
+            Table({"input": np.arange(2.0)})
+        )
+        with tracer.span("request") as root:
+            model.transform(Table({"input": np.arange(2.0)}))
+        names = [r["name"] for r in tracer.export(root.trace_id)]
+        assert "transform:_Doubler" in names
+
+    def test_untraced_transform_opens_no_spans(self):
+        tracer = get_tracer()
+        model = Pipeline(stages=[_Doubler()]).fit(
+            Table({"input": np.arange(2.0)})
+        )
+        before = len(tracer.export())
+        # no ambient span: the hot path must not pay per-stage spans
+        model.transform(Table({"input": np.arange(2.0)}))
+        assert len(tracer.export()) == before

@@ -1,0 +1,111 @@
+#!/usr/bin/env python
+"""Validate a JSON-lines event log written by ``MMLSPARK_TPU_EVENT_LOG``.
+
+Checks every line against the typed-event registry
+(:mod:`mmlspark_tpu.observability.events`): the line must be a JSON
+object, name a known event type, carry every required field with a
+JSON-compatible scalar of the declared type, and carry no unknown
+fields. Timestamps must be monotonically sane (non-negative floats).
+
+    python tools/check_eventlog.py /path/to/events.jsonl
+
+Exit status 0 with a one-line summary when the log is clean; 1 with one
+diagnostic per bad line otherwise (CI gates on this; see the
+``observability`` job in .github/workflows/ci.yml).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import sys
+import typing
+
+from mmlspark_tpu.observability import events as ev
+
+#: dataclass annotation (a string under ``from __future__ import
+#: annotations``) -> the JSON types it may decode from
+_JSON_TYPES = {
+    "int": (int,),
+    "float": (int, float),
+    "str": (str,),
+    "bool": (bool,),
+}
+
+
+def _check_record(rec: object) -> typing.List[str]:
+    """Problems with one decoded line ([] when valid)."""
+    if not isinstance(rec, dict):
+        return ["line is not a JSON object"]
+    kind = rec.get("event")
+    cls = ev._EVENT_TYPES.get(kind or "")
+    if cls is None:
+        return [f"unknown event type {kind!r}"]
+    problems = []
+    fields = {f.name: f for f in dataclasses.fields(cls)}
+    for name, f in fields.items():
+        required = (
+            f.default is dataclasses.MISSING
+            and f.default_factory is dataclasses.MISSING
+        )
+        if name not in rec:
+            if required:
+                problems.append(f"{kind}: missing required field {name!r}")
+            continue
+        ann = f.type.__name__ if isinstance(f.type, type) else str(f.type)
+        want = _JSON_TYPES.get(ann)
+        got = rec[name]
+        # bool is an int subclass; an int field holding True is still a bug
+        if want is not None and (
+            not isinstance(got, want)
+            or (isinstance(got, bool) and bool not in want)
+        ):
+            problems.append(
+                f"{kind}.{name}: expected {f.type}, got {type(got).__name__}"
+            )
+    unknown = set(rec) - set(fields) - {"event"}
+    if unknown:
+        problems.append(f"{kind}: unknown fields {sorted(unknown)}")
+    t = rec.get("t")
+    if isinstance(t, (int, float)) and t < 0:
+        problems.append(f"{kind}: negative timestamp {t}")
+    return problems
+
+
+def main(argv: typing.List[str]) -> int:
+    if len(argv) != 2:
+        print(__doc__.strip().splitlines()[0], file=sys.stderr)
+        print(f"usage: {argv[0]} EVENT_LOG", file=sys.stderr)
+        return 2
+    path = argv[1]
+    counts: typing.Dict[str, int] = {}
+    bad = 0
+    with open(path, "r", encoding="utf-8") as fh:
+        for lineno, line in enumerate(fh, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError as e:
+                print(f"{path}:{lineno}: invalid JSON: {e}", file=sys.stderr)
+                bad += 1
+                continue
+            problems = _check_record(rec)
+            for p in problems:
+                print(f"{path}:{lineno}: {p}", file=sys.stderr)
+            if problems:
+                bad += 1
+            else:
+                counts[rec["event"]] = counts.get(rec["event"], 0) + 1
+    total = sum(counts.values())
+    if bad:
+        print(f"{path}: {bad} invalid line(s), {total} valid", file=sys.stderr)
+        return 1
+    breakdown = " ".join(f"{k}={v}" for k, v in sorted(counts.items()))
+    print(f"{path}: {total} events ok ({breakdown})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

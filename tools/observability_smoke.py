@@ -1,0 +1,135 @@
+#!/usr/bin/env python
+"""CI smoke for the observability layer (the ``observability`` job).
+
+Starts a real :class:`~mmlspark_tpu.serving.ServingServer` on CPU with a
+pipeline of two trivial stages, drives live HTTP traffic through it, and
+asserts the three observability planes all light up:
+
+1. ``GET /metrics`` serves Prometheus text with the serving histograms
+   and counters populated;
+2. ``GET /healthz`` reports uptime / model epoch / last-batch age;
+3. the ``MMLSPARK_TPU_EVENT_LOG`` sink wrote replayable events whose
+   timeline matches the traffic, and the request trace threads
+   request -> batch -> apply with one trace id.
+
+The event log path is printed on the last line so the CI step can feed
+it to tools/check_eventlog.py. Exits nonzero on any failed assertion.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import urllib.request
+
+import numpy as np
+
+
+def main() -> int:
+    log_path = os.path.join(
+        tempfile.mkdtemp(prefix="mmlspark-tpu-obs-"), "events.jsonl"
+    )
+    os.environ["MMLSPARK_TPU_EVENT_LOG"] = log_path
+
+    from mmlspark_tpu.core.pipeline import Estimator, Model, Pipeline
+    from mmlspark_tpu.data.table import Table
+    from mmlspark_tpu.observability import (
+        get_tracer, replay, timeline, format_timeline,
+    )
+    from mmlspark_tpu.serving import ServingServer
+
+    class _CenterModel(Model):
+        mean = 0.0
+
+        def transform(self, t: Table) -> Table:
+            col = np.asarray(t.column("input"), dtype=np.float64)
+            return Table({"prediction": col - self.mean})
+
+    class _Center(Estimator):
+        def _fit(self, t: Table) -> _CenterModel:
+            m = _CenterModel()
+            m.mean = float(np.mean(np.asarray(t.column("input"))))
+            return m
+
+    # a real (if tiny) fitted pipeline, so fit-stage events appear too
+    train = Table({"input": np.linspace(0.0, 9.0, 10)})
+    model = Pipeline(stages=[_Center()]).fit(train)
+
+    n_requests = 8
+    with ServingServer(model, max_latency_ms=1.0) as srv:
+        base = srv.info.url.rstrip("/")
+        for i in range(n_requests):
+            req = urllib.request.Request(
+                base, data=json.dumps({"input": float(i)}).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            body = json.loads(urllib.request.urlopen(req, timeout=10).read())
+            assert "prediction" in body, f"bad reply: {body}"
+
+        metrics = urllib.request.urlopen(base + "/metrics", timeout=10)
+        ctype = metrics.headers["Content-Type"]
+        assert ctype.startswith("text/plain"), ctype
+        text = metrics.read().decode()
+        for needle in (
+            "# TYPE serving_requests_total counter",
+            "# TYPE serving_queue_wait_seconds histogram",
+            "# TYPE serving_batch_size histogram",
+            "# TYPE serving_apply_latency_seconds histogram",
+            "serving_replies_failed_total 0",
+        ):
+            assert needle in text, f"/metrics missing {needle!r}"
+        served = [
+            line for line in text.splitlines()
+            if line.startswith("serving_requests_total ")
+        ]
+        assert served and float(served[0].split()[1]) == n_requests, served
+
+        health = json.loads(
+            urllib.request.urlopen(base + "/healthz", timeout=10).read()
+        )
+        assert health["status"] == "ok", health
+        assert health["uptime_seconds"] >= 0, health
+        assert health["model_epoch"] >= 1, health
+        assert health["last_batch_age_seconds"] is not None, health
+
+    # -- event log + timeline -------------------------------------------------
+    events = replay(log_path)
+    summary = timeline(events)
+    print(format_timeline(summary))
+    assert summary["requests"]["count"] == n_requests, summary["requests"]
+    assert summary["requests"]["statuses"].get(200) == n_requests
+    assert summary["batches"]["rows"] == n_requests, summary["batches"]
+    assert any(s["name"] == "_Center" for s in summary["stages"]), (
+        summary["stages"]
+    )
+    assert "PipelineModel" in summary["models"], summary["models"]
+
+    # -- trace: request -> batch -> apply under ONE trace id ------------------
+    tracer = get_tracer()
+    roots = [r for r in tracer.export() if r["name"] == "serving.request"]
+    assert len(roots) == n_requests, f"expected {n_requests} request spans"
+    threaded = 0
+    for root in roots:
+        tree = tracer.span_tree(root["trace_id"])
+        chain = {root["name"]}
+        stack = list(tree["roots"])
+        while stack:
+            node = stack.pop()
+            chain.add(node["name"])
+            stack.extend(node["children"])
+        if {"serving.request", "serving.batch", "serving.apply"} <= chain:
+            threaded += 1
+    # every batch joins its first request's trace; with micro-batching at
+    # least one request per batch must carry the full chain
+    assert threaded >= 1, "no trace threads request -> batch -> apply"
+
+    print(f"observability smoke ok: {n_requests} requests, "
+          f"{len(events)} events, {threaded} fully-threaded trace(s)")
+    print(log_path)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
