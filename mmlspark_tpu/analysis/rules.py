@@ -241,12 +241,12 @@ class LockDisciplineRule(Rule):
     name = "lock-discipline"
     description = (
         "No blocking call (thread join, sleep, queue get/put, network I/O) "
-        "while holding a threading.Lock/RLock in runtime/, serving/ or "
-        "observability/: the lock serializes every heartbeat, reply and "
-        "metrics-scrape path behind the wait."
+        "while holding a threading.Lock/RLock in runtime/, serving/, "
+        "observability/ or resilience/: the lock serializes every heartbeat, "
+        "reply, breaker-decision and metrics-scrape path behind the wait."
     )
 
-    _PATH_PARTS = ("runtime", "serving", "observability")
+    _PATH_PARTS = ("runtime", "serving", "observability", "resilience")
     _NETWORK_PREFIXES = (
         "urllib.request.urlopen", "urlopen", "requests.", "socket.",
         "http.client.",

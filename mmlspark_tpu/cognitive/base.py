@@ -98,6 +98,11 @@ class CognitiveServicesBase(_HasServiceParams, HasOutputCol, Transformer):
     typed = Param("Parse responses into the typed schema", default=False)
     pollingIntervalMs = Param("Async poll interval", default=50, converter=to_int)
     maxPollingRetries = Param("Async poll attempts", default=40, converter=to_int)
+    pollingDeadlineMs = Param(
+        "Overall wall-clock budget for one async operation's poll loop; the "
+        "retry count alone let Retry-After hints stretch the wait unboundedly",
+        default=60_000, converter=to_int,
+    )
 
     response_schema = None  # ResponseSchema subclass, set per service
     polling = False  # async Operation-Location flow
@@ -163,14 +168,26 @@ class CognitiveServicesBase(_HasServiceParams, HasOutputCol, Transformer):
 
     # -- async polling (ComputerVision.scala recognize-text flow) ------------
 
-    def _poll(self, resp, key: Optional[str]):
+    def _poll(self, resp, key: Optional[str], clock=None, sleep=None):
         """Follow the Operation-Location header until a terminal status —
         the reference's async flow where the initial 202 carries only the
-        polling URL and the result arrives from subsequent GETs."""
+        polling URL and the result arrives from subsequent GETs.
+
+        Two budgets bound the loop: ``maxPollingRetries`` (attempt count)
+        and ``pollingDeadlineMs`` (wall clock) — a tighter ambient
+        :func:`~mmlspark_tpu.resilience.budget.current_deadline` wins over
+        the param. A poll answering 429/503 with ``Retry-After`` stretches
+        that one interval to the hint (clipped to the deadline) instead of
+        hammering a throttling service. ``clock``/``sleep`` are injectable
+        for zero-sleep tests."""
         import time as _time
 
         from mmlspark_tpu.io.http.clients import HTTPClient
+        from mmlspark_tpu.resilience.budget import Deadline, current_deadline
+        from mmlspark_tpu.resilience.policy import parse_retry_after
 
+        clock = clock or _time.monotonic
+        sleep = sleep or _time.sleep
         # header names are case-insensitive on the wire (h2 hops lowercase)
         headers_ci = {k.lower(): v for k, v in resp.header_map().items()}
         loc = headers_ci.get("operation-location")
@@ -179,10 +196,31 @@ class CognitiveServicesBase(_HasServiceParams, HasOutputCol, Transformer):
         headers = [HeaderData(self._key_header, key)] if key else []
         client = HTTPClient()
         interval = self.getPollingIntervalMs() / 1000.0
+        deadline = Deadline.after(self.getPollingDeadlineMs() / 1000.0, clock=clock)
+        ambient = current_deadline()
         payload = None
+        polls = 0
         for _ in range(self.getMaxPollingRetries()):
-            _time.sleep(interval)
+            wait = interval
+            if polls:  # a Retry-After hint governs the NEXT poll's wait
+                hint = parse_retry_after(
+                    {k.lower(): v for k, v in poll.header_map().items()}
+                    .get("retry-after")
+                ) if poll.status_code in (429, 503) else None
+                if hint is not None:
+                    wait = max(wait, hint)
+            wait = min(wait, max(0.0, deadline.remaining()))
+            if ambient is not None:
+                wait = min(wait, max(0.0, ambient.remaining()))
+            sleep(wait)
+            if deadline.expired or (ambient is not None and ambient.expired):
+                raise TimeoutError(
+                    f"{type(self).__name__}: async operation at {loc} exceeded "
+                    f"its {self.getPollingDeadlineMs()} ms polling deadline "
+                    f"after {polls} polls (last: {payload!r})"
+                )
             poll = client.send(HTTPRequestData(url=loc, method="GET", headers=headers))
+            polls += 1
             payload = poll.json()
             status = (payload or {}).get("status", "")
             if str(status).lower() in ("succeeded", "failed"):

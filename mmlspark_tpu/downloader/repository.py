@@ -48,18 +48,21 @@ class ModelSchema:
 class FaultToleranceUtils:
     @staticmethod
     def retry_with_timeout(fn: Callable[[], T], times: int = 3,
-                           backoff: float = 0.5) -> T:
+                           backoff: float = 0.5,
+                           sleep: Optional[Callable[[float], None]] = None) -> T:
         """``FaultToleranceUtils.retryWithTimeout``
-        (``ModelDownloader.scala:37-52``)."""
-        last: Optional[Exception] = None
-        for attempt in range(times):
-            try:
-                return fn()
-            except Exception as e:  # noqa: BLE001 — retry any failure
-                last = e
-                if attempt < times - 1:
-                    time.sleep(backoff * (2**attempt))
-        raise last  # type: ignore[misc]
+        (``ModelDownloader.scala:37-52``), now a thin shim over the shared
+        :class:`~mmlspark_tpu.resilience.policy.RetryPolicy` — seeded
+        full-jitter backoff replaces the bare ``backoff * 2**attempt``
+        (synchronized download retries from a fleet otherwise re-collide),
+        and a tighter ambient deadline/retry budget is honored for free."""
+        from mmlspark_tpu.resilience.policy import RetryPolicy
+
+        policy = RetryPolicy(
+            max_attempts=times, base=backoff, seed=0,
+            sleep=sleep if sleep is not None else time.sleep,
+        )
+        return policy.run(fn, describe="model download")
 
 
 class Repository:

@@ -1,10 +1,29 @@
 """HTTP client stack: pooled sync client + bounded-concurrency async client
-with status-aware retry/backoff.
+with status-aware retry/backoff behind the shared resilience layer.
 
 Reference: ``io/http/Clients.scala`` (``BaseClient``/``AsyncClient`` with
 bounded-concurrency futures, ``:63``), ``io/http/HTTPClients.scala``
 (``HTTPClient`` pooled connections ``:26-62``; ``HandlingUtils.advanced``
-retry handler honoring ``Retry-After`` on 429, ``:64-151``).
+retry handler honoring ``Retry-After``, ``:64-151``).
+
+The retry loop itself now lives in
+:class:`~mmlspark_tpu.resilience.policy.RetryPolicy` (one loop for the
+whole codebase); this module adds the wire specifics the policy can't
+know:
+
+- a **per-host circuit breaker** consulted before every attempt — under a
+  down dependency the attempts stop locally (:class:`BreakerOpenError`)
+  instead of storming it, and half-open probes re-detect recovery;
+- **deadline propagation**: the ambient
+  :class:`~mmlspark_tpu.resilience.budget.Deadline` caps the socket
+  timeout and rides outbound as ``X-Deadline-Ms``, so a downstream hop
+  knows how much budget the caller has left;
+- ``Retry-After`` honored on 503 as well as 429, including HTTP-date
+  values, and retry exhaustion on a retryable status returns the last
+  response **with a warning log** (the old silent ``return last``
+  fall-through hid every terminal 5xx);
+- seeded **HTTP fault injection** (``FaultPlan.http_storm`` et al.) is
+  enacted here, before the socket, so chaos tests run with no server.
 
 urllib-based (stdlib); connection pooling comes from keep-alive handled by
 the OS — the concurrency lever here is the thread pool, mirroring the
@@ -13,11 +32,11 @@ reference's future pool per partition.
 
 from __future__ import annotations
 
-import time
+import logging
 import urllib.error
 import urllib.request
 from concurrent.futures import ThreadPoolExecutor
-from typing import Iterable, List, Optional, Sequence
+from typing import Dict, Iterable, List, Optional, Sequence
 
 from mmlspark_tpu.io.http.schema import (
     EntityData,
@@ -26,15 +45,73 @@ from mmlspark_tpu.io.http.schema import (
     HTTPResponseData,
     StatusLineData,
 )
+from mmlspark_tpu.resilience.breaker import (
+    BreakerOpenError,
+    BreakerRegistry,
+    shared_breakers,
+)
+from mmlspark_tpu.resilience.budget import (
+    DEADLINE_HEADER,
+    DeadlineExceededError,
+    RetryBudget,
+    current_deadline,
+)
+from mmlspark_tpu.resilience.policy import RETRY_STATUSES, RetryPolicy
 
-RETRY_STATUSES = (408, 429, 500, 502, 503, 504)
+logger = logging.getLogger("mmlspark_tpu.io.http")
+
+#: statuses a breaker counts as dependency failure — 429 is the dependency
+#: *protecting itself* (it is up), so throttles never trip a breaker
+BREAKER_FAILURE_STATUSES = (408, 500, 502, 503, 504)
 
 
-def _do_request(request: HTTPRequestData, timeout: float) -> HTTPResponseData:
+def _injected_fault(url: str):
+    """Enact any ambient HTTP fault directive for this request. Returns a
+    synthetic response (storm), None (no fault / after a delay), or raises
+    (reset)."""
+    from mmlspark_tpu.runtime.faults import current_faults
+
+    plan = current_faults()
+    if plan is None:
+        return None
+    directive = plan.apply_on_http(url)
+    if directive is None:
+        return None
+    kind = directive["kind"]
+    if kind == "reset":
+        raise ConnectionResetError(f"injected connection reset for {url}")
+    if kind == "delay":
+        import time
+
+        time.sleep(directive["seconds"])
+        return None
+    headers = []
+    if directive.get("retry_after") is not None:
+        headers.append(HeaderData("Retry-After", str(directive["retry_after"])))
+    return HTTPResponseData(
+        statusLine=StatusLineData(
+            "HTTP/1.1", directive["status"], "injected fault"
+        ),
+        headers=headers,
+        entity=EntityData(content=b'{"error": "injected fault"}'),
+    )
+
+
+def _do_request(
+    request: HTTPRequestData,
+    timeout: float,
+    extra_headers: Optional[Dict[str, str]] = None,
+) -> HTTPResponseData:
+    fault = _injected_fault(request.url)
+    if fault is not None:
+        return fault
+    headers = request.header_map()
+    if extra_headers:
+        headers.update(extra_headers)
     req = urllib.request.Request(
         request.url,
         data=request.entity.content if request.entity else None,
-        headers=request.header_map(),
+        headers=headers,
         method=request.method,
     )
     try:
@@ -56,53 +133,139 @@ def _do_request(request: HTTPRequestData, timeout: float) -> HTTPResponseData:
 
 
 class HTTPClient:
-    """Synchronous client with ``HandlingUtils.advanced`` retry semantics:
-    retry on transport errors and retryable statuses with exponential
-    backoff, honoring ``Retry-After`` on 429
-    (``io/http/HTTPClients.scala:73-138``)."""
+    """Synchronous client with ``HandlingUtils.advanced`` retry semantics
+    behind the resilience layer: per-host breaker, retry budget, ambient
+    deadline, ``Retry-After`` on 429/503 (delta-seconds or HTTP-date).
 
-    def __init__(self, retries: Sequence[float] = (0.1, 0.5, 1.0),
-                 timeout: float = 60.0):
-        self.retries = list(retries)
+    ``retries`` keeps the legacy fixed-wait schedule; pass ``policy`` for
+    seeded full-jitter backoff. ``breakers=None`` disables the breaker
+    (unit tests of pure retry behavior); the default is the process-shared
+    per-host registry."""
+
+    def __init__(
+        self,
+        retries: Sequence[float] = (0.1, 0.5, 1.0),
+        timeout: float = 60.0,
+        policy: Optional[RetryPolicy] = None,
+        budget: Optional[RetryBudget] = None,
+        breakers: Optional[BreakerRegistry] = "shared",  # type: ignore[assignment]
+    ):
         self.timeout = timeout
+        self.policy = policy or RetryPolicy.from_legacy_waits(
+            retries, retry_statuses=RETRY_STATUSES
+        )
+        if budget is not None:
+            self.policy.budget = budget
+        self.breakers: Optional[BreakerRegistry] = (
+            shared_breakers() if breakers == "shared" else breakers
+        )
 
     def send(self, request: HTTPRequestData) -> HTTPResponseData:
+        policy = self.policy
+        if policy.budget is not None:
+            policy.budget.record_request()
+        breaker = (
+            self.breakers.for_url(request.url)
+            if self.breakers is not None else None
+        )
         last: Optional[HTTPResponseData] = None
-        for attempt in range(len(self.retries) + 1):
+        last_exc: Optional[Exception] = None
+        attempt = 0
+        while True:
+            dl = current_deadline()
+            if dl is not None and dl.expired:
+                raise DeadlineExceededError(
+                    f"deadline expired before attempt {attempt + 1} to "
+                    f"{request.url}"
+                )
+            if breaker is not None and not breaker.allow():
+                raise BreakerOpenError(
+                    breaker.name, retry_after=breaker.retry_after()
+                )
+            extra = None
+            timeout = self.timeout
+            if dl is not None:
+                # forward the remaining budget; cap the socket wait to it
+                extra = {DEADLINE_HEADER: dl.to_header()}
+                timeout = max(1e-3, min(self.timeout, dl.remaining()))
+            resp: Optional[HTTPResponseData] = None
             try:
-                resp = _do_request(request, self.timeout)
-            except Exception as e:  # transport error (conn refused, timeout)
-                if attempt >= len(self.retries):
-                    raise
-                time.sleep(self.retries[attempt])
-                continue
-            if resp.status_code not in RETRY_STATUSES or attempt >= len(self.retries):
-                return resp
-            last = resp
-            wait = self.retries[attempt]
-            if resp.status_code == 429:
-                retry_after = resp.header_map().get("Retry-After")
-                if retry_after is not None:
-                    try:
-                        wait = max(wait, float(retry_after))
-                    except ValueError:
-                        pass
-            time.sleep(wait)
-        return last  # pragma: no cover
+                resp = _do_request(request, timeout, extra_headers=extra)
+            except Exception as e:  # transport error (conn refused/reset/timeout)
+                last_exc = e
+                logger.debug(
+                    "transport error on %s (%s: %s)",
+                    request.url, type(e).__name__, e,
+                )
+                if breaker is not None:
+                    breaker.record_failure()
+            else:
+                last_exc = None
+                if breaker is not None:
+                    if resp.status_code in BREAKER_FAILURE_STATUSES:
+                        breaker.record_failure()
+                    else:
+                        breaker.record_success()
+                if not policy.retryable(resp.status_code):
+                    return resp
+                last = resp
+            if not policy.allow_retry(attempt):
+                break
+            wait = policy.next_wait(
+                attempt,
+                status=resp.status_code if resp is not None else None,
+                headers=resp.header_map() if resp is not None else None,
+            )
+            policy.sleep(wait)
+            attempt += 1
+        if last_exc is not None:
+            raise last_exc
+        assert last is not None
+        # terminal retryable status: return it LOUDLY (the old code fell
+        # through to a silent `return last`)
+        logger.warning(
+            "giving up on %s %s after %d attempts: terminal HTTP %d",
+            request.method, request.url, attempt + 1, last.status_code,
+        )
+        return last
 
 
 class AsyncHTTPClient:
     """Bounded-concurrency batch sender (``AsyncClient``,
     ``io/http/Clients.scala:63``): N in-flight requests, results in input
-    order. ``None`` requests pass through as ``None`` (null rows)."""
+    order. ``None`` requests pass through as ``None`` (null rows). A call
+    rejected by an open breaker degrades to a synthetic local 503 carrying
+    ``Retry-After`` — error-column semantics, not a crashed batch."""
 
     def __init__(self, concurrency: int = 8,
                  retries: Sequence[float] = (0.1, 0.5, 1.0),
-                 timeout: float = 60.0):
+                 timeout: float = 60.0,
+                 policy: Optional[RetryPolicy] = None,
+                 budget: Optional[RetryBudget] = None,
+                 breakers: Optional[BreakerRegistry] = "shared"):  # type: ignore[assignment]
         if concurrency < 1:
             raise ValueError("concurrency must be >= 1")
         self.concurrency = concurrency
-        self._client = HTTPClient(retries=retries, timeout=timeout)
+        self._client = HTTPClient(
+            retries=retries, timeout=timeout, policy=policy, budget=budget,
+            breakers=breakers,
+        )
+
+    def _send_one(
+        self, request: Optional[HTTPRequestData]
+    ) -> Optional[HTTPResponseData]:
+        if request is None:
+            return None
+        try:
+            return self._client.send(request)
+        except BreakerOpenError as e:
+            return HTTPResponseData(
+                statusLine=StatusLineData("HTTP/1.1", 503, "breaker open"),
+                headers=[HeaderData("Retry-After", f"{e.retry_after:.3f}")],
+                entity=EntityData(content=(
+                    b'{"error": "circuit breaker open"}'
+                )),
+            )
 
     def send_all(
         self, requests: Iterable[Optional[HTTPRequestData]]
@@ -111,9 +274,4 @@ class AsyncHTTPClient:
         if not requests:
             return []
         with ThreadPoolExecutor(max_workers=self.concurrency) as pool:
-            return list(
-                pool.map(
-                    lambda r: None if r is None else self._client.send(r),
-                    requests,
-                )
-            )
+            return list(pool.map(self._send_one, requests))

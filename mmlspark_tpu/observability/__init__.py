@@ -31,11 +31,13 @@ Quick start::
 
 from mmlspark_tpu.observability.events import (
     BatchFormed,
+    BreakerTripped,
     Event,
     EventBus,
     EventLogSink,
     ModelCommitted,
     RequestServed,
+    RequestShed,
     StageCompleted,
     StageStarted,
     TaskDispatched,
@@ -58,6 +60,7 @@ from mmlspark_tpu.observability.tracing import Span, Tracer, get_tracer
 
 __all__ = [
     "BatchFormed",
+    "BreakerTripped",
     "Counter",
     "Event",
     "EventBus",
@@ -67,6 +70,7 @@ __all__ = [
     "MetricsRegistry",
     "ModelCommitted",
     "RequestServed",
+    "RequestShed",
     "Span",
     "StageCompleted",
     "StageStarted",

@@ -150,6 +150,30 @@ class ModelCommitted(Event):
     detail: str = ""
 
 
+# -- resilience --------------------------------------------------------------
+
+
+@_event
+class BreakerTripped(Event):
+    """A circuit breaker transitioned closed -> open: ``failures``
+    failures inside ``window_s`` seconds (docs/resilience.md)."""
+
+    breaker: str
+    failures: int
+    window_s: float
+
+
+@_event
+class RequestShed(Event):
+    """Admission control rejected a request with 429 + Retry-After
+    instead of queueing it (``reason`` names the exceeded bound)."""
+
+    reason: str
+    queue_depth: int
+    retry_after: float = 0.0
+    rid: str = ""
+
+
 # -- bus ---------------------------------------------------------------------
 
 
@@ -288,6 +312,8 @@ def timeline(events: Iterable[Event]) -> Dict[str, Any]:
     latencies: List[float] = []
     statuses: Dict[int, int] = {}
     models: List[str] = []
+    shed = 0
+    breaker_trips: Dict[str, int] = {}
     for ev in events:
         if isinstance(ev, StageStarted):
             stages.setdefault(
@@ -318,7 +344,13 @@ def timeline(events: Iterable[Event]) -> Dict[str, Any]:
             statuses[ev.status] = statuses.get(ev.status, 0) + 1
         elif isinstance(ev, ModelCommitted):
             models.append(ev.model)
-    requests: Dict[str, Any] = {"count": len(latencies), "statuses": statuses}
+        elif isinstance(ev, RequestShed):
+            shed += 1
+        elif isinstance(ev, BreakerTripped):
+            breaker_trips[ev.breaker] = breaker_trips.get(ev.breaker, 0) + 1
+    requests: Dict[str, Any] = {
+        "count": len(latencies), "statuses": statuses, "shed": shed,
+    }
     if latencies:
         ordered = sorted(latencies)
         requests["latency_p50"] = ordered[len(ordered) // 2]
@@ -329,6 +361,7 @@ def timeline(events: Iterable[Event]) -> Dict[str, Any]:
         "batches": batches,
         "requests": requests,
         "models": models,
+        "breaker_trips": breaker_trips,
     }
 
 
@@ -349,7 +382,12 @@ def format_timeline(summary: Dict[str, Any]) -> str:
     )
     b, r = summary["batches"], summary["requests"]
     lines.append(f"== serving == batches={b['count']} rows={b['rows']} "
-                 f"requests={r['count']}")
+                 f"requests={r['count']} shed={r.get('shed', 0)}")
+    trips = summary.get("breaker_trips") or {}
+    if trips:
+        lines.append("== breakers == " + ", ".join(
+            f"{name} tripped x{n}" for name, n in sorted(trips.items())
+        ))
     if "latency_p50" in r:
         lines.append(
             f"   latency p50={r['latency_p50'] * 1e3:.2f}ms "

@@ -1,0 +1,68 @@
+"""mmlspark_tpu.resilience — the request-plane fault-tolerance layer.
+
+PR 1's runtime made the *compute* plane fault-tolerant (task retries,
+lineage recompute); this package does the same for the *request* plane
+(serving ingress, outbound HTTP, cognitive polling, model downloads).
+Four cooperating pieces (``docs/resilience.md``):
+
+- :mod:`~mmlspark_tpu.resilience.breaker`   — per-dependency circuit
+  breakers (closed/open/half-open over a rolling failure window) so a
+  down dependency is failed fast locally instead of retried into the
+  ground;
+- :mod:`~mmlspark_tpu.resilience.budget`    — ambient :class:`Deadline`
+  propagated via the ``X-Deadline-Ms`` header, plus a token-bucket
+  :class:`RetryBudget` bounding retries to a fraction of traffic;
+- :mod:`~mmlspark_tpu.resilience.policy`    — the one
+  :class:`RetryPolicy` (seeded exponential backoff with full jitter,
+  Retry-After on 429 *and* 503 incl. HTTP-dates) shared by the HTTP
+  clients, cognitive polling, and the model downloader;
+- :mod:`~mmlspark_tpu.resilience.admission` — bounded serving admission
+  that sheds overload with ``429`` + ``Retry-After`` instead of queueing
+  forever.
+
+Everything takes injectable clocks/sleeps, and
+:class:`~mmlspark_tpu.runtime.faults.FaultPlan` grew seeded HTTP faults
+(503 storms, latency spikes, connection resets), so the whole layer is
+chaos-tested deterministically with zero real sleeps
+(``tests/test_resilience.py``).
+"""
+
+from mmlspark_tpu.resilience.admission import AdmissionController
+from mmlspark_tpu.resilience.breaker import (
+    BreakerOpenError,
+    BreakerRegistry,
+    CircuitBreaker,
+    shared_breakers,
+)
+from mmlspark_tpu.resilience.budget import (
+    DEADLINE_HEADER,
+    Deadline,
+    DeadlineExceededError,
+    RetryBudget,
+    current_deadline,
+    deadline_scope,
+)
+from mmlspark_tpu.resilience.policy import (
+    RETRY_AFTER_STATUSES,
+    RETRY_STATUSES,
+    RetryPolicy,
+    parse_retry_after,
+)
+
+__all__ = [
+    "AdmissionController",
+    "BreakerOpenError",
+    "BreakerRegistry",
+    "CircuitBreaker",
+    "DEADLINE_HEADER",
+    "Deadline",
+    "DeadlineExceededError",
+    "RETRY_AFTER_STATUSES",
+    "RETRY_STATUSES",
+    "RetryBudget",
+    "RetryPolicy",
+    "current_deadline",
+    "deadline_scope",
+    "parse_retry_after",
+    "shared_breakers",
+]

@@ -1,0 +1,93 @@
+"""Admission control — shed load instead of queueing forever.
+
+The serving queue was unbounded: under overload every listener thread
+blocked on its reply event while the queue grew without limit, so one slow
+dependency wedged the whole HTTP edge (exactly the failure mode *The Tail
+at Scale* calls out). The fix is a counter, not a queue: at most
+``max_pending`` requests may be admitted-and-unanswered at once (that one
+bound covers both the micro-batch queue and listener-thread concurrency,
+since every admitted request holds exactly one listener thread until its
+reply). Beyond it, requests are shed immediately with ``429`` +
+``Retry-After`` — a fast no is cheaper for the client than a slow maybe,
+and the shed clients' retries arrive after the hinted backoff instead of
+piling onto the queue.
+
+Sheds are counted (``serving_shed_total``), the in-flight depth is a live
+gauge, and each shed publishes
+:class:`~mmlspark_tpu.observability.events.RequestShed` when the bus has
+listeners.
+"""
+
+from __future__ import annotations
+
+import threading
+
+
+class AdmissionController:
+    """Bounded-in-flight admission with 429 shedding semantics."""
+
+    def __init__(
+        self,
+        max_pending: int = 1024,
+        retry_after_s: float = 1.0,
+        registry=None,
+        name: str = "serving",
+    ):
+        if max_pending < 1:
+            raise ValueError("max_pending must be >= 1")
+        self.max_pending = int(max_pending)
+        self.retry_after_s = float(retry_after_s)
+        self.name = name
+        self._lock = threading.Lock()
+        self._inflight = 0
+        if registry is None:
+            from mmlspark_tpu.observability.registry import get_registry
+
+            registry = get_registry()
+        self._shed = registry.counter(
+            "serving_shed_total",
+            "Requests rejected with 429 by admission control",
+        )
+        self._gauge = registry.gauge(
+            "serving_inflight", "Admitted requests awaiting a reply"
+        )
+
+    @property
+    def inflight(self) -> int:
+        with self._lock:
+            return self._inflight
+
+    def try_acquire(self) -> bool:
+        """Admit one request, or shed it (False) when ``max_pending``
+        requests are already in flight. A shed is counted and published;
+        the caller answers 429 with ``Retry-After: retry_after_s``."""
+        with self._lock:
+            if self._inflight >= self.max_pending:
+                depth = self._inflight
+                admitted = False
+            else:
+                self._inflight += 1
+                depth = self._inflight
+                admitted = True
+            self._gauge.set(depth)
+        if admitted:
+            return True
+        self._shed.inc()
+        from mmlspark_tpu.observability.events import RequestShed, get_bus
+
+        bus = get_bus()
+        if bus.active:
+            bus.publish(RequestShed(
+                reason="max_pending",
+                queue_depth=depth,
+                retry_after=self.retry_after_s,
+            ))
+        return False
+
+    def release(self) -> None:
+        """One admitted request finished (replied, timed out, or the
+        client hung up) — must be called exactly once per successful
+        :meth:`try_acquire`."""
+        with self._lock:
+            self._inflight = max(0, self._inflight - 1)
+            self._gauge.set(self._inflight)

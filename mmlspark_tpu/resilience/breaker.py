@@ -1,0 +1,243 @@
+"""Circuit breaker — fail fast when a dependency is down.
+
+Reference posture: ``HandlingUtils.advanced`` (``io/http/HTTPClients.scala
+:64-151``) retried every failure with backoff, which under a hard outage
+turns every caller into part of the retry storm. The breaker is the missing
+half (Dean & Barroso, *The Tail at Scale*: stop sending work you already
+know will fail): a per-dependency state machine
+
+- **closed**    — calls flow; failures are recorded in a rolling window;
+- **open**      — ``failure_threshold`` failures inside ``window_s`` trip
+  the breaker: calls are rejected locally (:class:`BreakerOpenError`)
+  without touching the network, for ``reset_timeout_s``;
+- **half-open** — after the cooldown, up to ``half_open_max`` probe calls
+  are let through; one success closes the breaker, one failure re-opens it.
+
+The clock is injectable (``clock=``) so state transitions are testable
+with no real sleeps, and every transition updates the
+``resilience_breaker_state`` gauge (0=closed, 1=half-open, 2=open) and
+publishes :class:`~mmlspark_tpu.observability.events.BreakerTripped` on
+trip — the serving dashboards see an outage the moment the first host
+stops calling, not when the error rate graph catches up.
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import threading
+import time
+from typing import Callable, Deque, Dict, Optional
+from urllib.parse import urlsplit
+
+logger = logging.getLogger("mmlspark_tpu.resilience")
+
+#: gauge values per state (Prometheus convention: higher = worse)
+CLOSED, HALF_OPEN, OPEN = "closed", "half_open", "open"
+_STATE_GAUGE = {CLOSED: 0.0, HALF_OPEN: 1.0, OPEN: 2.0}
+
+
+class BreakerOpenError(RuntimeError):
+    """Raised (or mapped to a synthetic 503) when the breaker rejects a
+    call locally. ``retry_after`` is the cooldown remaining in seconds —
+    callers surfacing this over HTTP should emit it as ``Retry-After``."""
+
+    def __init__(self, name: str, retry_after: float = 0.0):
+        super().__init__(
+            f"circuit breaker {name!r} is open (retry after "
+            f"{retry_after:.3f}s)"
+        )
+        self.name = name
+        self.retry_after = retry_after
+
+
+class CircuitBreaker:
+    """Closed/open/half-open breaker over a rolling failure window."""
+
+    def __init__(
+        self,
+        name: str,
+        failure_threshold: int = 5,
+        window_s: float = 10.0,
+        reset_timeout_s: float = 5.0,
+        half_open_max: int = 1,
+        clock: Callable[[], float] = time.monotonic,
+        registry=None,
+    ):
+        if failure_threshold < 1:
+            raise ValueError("failure_threshold must be >= 1")
+        self.name = name
+        self.failure_threshold = int(failure_threshold)
+        self.window_s = float(window_s)
+        self.reset_timeout_s = float(reset_timeout_s)
+        self.half_open_max = int(half_open_max)
+        self.clock = clock
+        self._lock = threading.Lock()
+        self._state = CLOSED
+        self._failures: Deque[float] = collections.deque()
+        self._opened_at = 0.0
+        self._probes_inflight = 0
+        #: number of closed->open transitions over the breaker's lifetime
+        self.trips = 0
+        if registry is None:
+            from mmlspark_tpu.observability.registry import get_registry
+
+            registry = get_registry()
+        self._gauge = registry.gauge(
+            "resilience_breaker_state",
+            "Circuit breaker state (0=closed, 1=half-open, 2=open)",
+        ).labels(breaker=name)
+        self._trips_counter = registry.counter(
+            "resilience_breaker_trips_total",
+            "Closed->open breaker transitions",
+        ).labels(breaker=name)
+        self._rejected = registry.counter(
+            "resilience_breaker_rejected_total",
+            "Calls rejected locally by an open breaker",
+        ).labels(breaker=name)
+        self._gauge.set(_STATE_GAUGE[CLOSED])
+
+    # -- state ---------------------------------------------------------------
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            self._advance(self.clock())
+            return self._state
+
+    def retry_after(self) -> float:
+        """Seconds until the next probe would be admitted (0 when not open)."""
+        with self._lock:
+            now = self.clock()
+            self._advance(now)
+            if self._state != OPEN:
+                return 0.0
+            return max(0.0, self._opened_at + self.reset_timeout_s - now)
+
+    def _advance(self, now: float) -> None:
+        """Time-driven transitions; caller holds the lock."""
+        if self._state == OPEN and now - self._opened_at >= self.reset_timeout_s:
+            self._state = HALF_OPEN
+            self._probes_inflight = 0
+            self._gauge.set(_STATE_GAUGE[HALF_OPEN])
+        while self._failures and now - self._failures[0] > self.window_s:
+            self._failures.popleft()
+
+    # -- call protocol -------------------------------------------------------
+
+    def allow(self) -> bool:
+        """True if a call may proceed now. Half-open admits at most
+        ``half_open_max`` concurrent probes."""
+        with self._lock:
+            self._advance(self.clock())
+            if self._state == OPEN:
+                self._rejected.inc()
+                return False
+            if self._state == HALF_OPEN:
+                if self._probes_inflight >= self.half_open_max:
+                    self._rejected.inc()
+                    return False
+                self._probes_inflight += 1
+            return True
+
+    def record_success(self) -> None:
+        with self._lock:
+            if self._state == HALF_OPEN:
+                self._state = CLOSED
+                self._failures.clear()
+                self._probes_inflight = 0
+                self._gauge.set(_STATE_GAUGE[CLOSED])
+
+    def record_failure(self) -> None:
+        tripped = False
+        with self._lock:
+            now = self.clock()
+            self._advance(now)
+            if self._state == HALF_OPEN:
+                # the probe failed: straight back to open, cooldown restarts
+                self._state = OPEN
+                self._opened_at = now
+                self._probes_inflight = 0
+                self._gauge.set(_STATE_GAUGE[OPEN])
+                return
+            self._failures.append(now)
+            if (
+                self._state == CLOSED
+                and len(self._failures) >= self.failure_threshold
+            ):
+                self._state = OPEN
+                self._opened_at = now
+                self.trips += 1
+                tripped = True
+                self._gauge.set(_STATE_GAUGE[OPEN])
+                self._trips_counter.inc()
+        if tripped:
+            logger.warning(
+                "circuit breaker %r tripped open (%d failures in %.1fs)",
+                self.name, self.failure_threshold, self.window_s,
+            )
+            from mmlspark_tpu.observability.events import BreakerTripped, get_bus
+
+            bus = get_bus()
+            if bus.active:
+                bus.publish(BreakerTripped(
+                    breaker=self.name,
+                    failures=self.failure_threshold,
+                    window_s=self.window_s,
+                ))
+
+
+class BreakerRegistry:
+    """Get-or-create table of breakers keyed by dependency (host)."""
+
+    def __init__(
+        self,
+        failure_threshold: int = 10,
+        window_s: float = 30.0,
+        reset_timeout_s: float = 5.0,
+        clock: Callable[[], float] = time.monotonic,
+        registry=None,
+    ):
+        self.failure_threshold = failure_threshold
+        self.window_s = window_s
+        self.reset_timeout_s = reset_timeout_s
+        self.clock = clock
+        self.registry = registry
+        self._lock = threading.Lock()
+        self._breakers: Dict[str, CircuitBreaker] = {}
+
+    def get(self, key: str) -> CircuitBreaker:
+        with self._lock:
+            br = self._breakers.get(key)
+            if br is None:
+                br = CircuitBreaker(
+                    key,
+                    failure_threshold=self.failure_threshold,
+                    window_s=self.window_s,
+                    reset_timeout_s=self.reset_timeout_s,
+                    clock=self.clock,
+                    registry=self.registry,
+                )
+                self._breakers[key] = br
+            return br
+
+    def for_url(self, url: str) -> CircuitBreaker:
+        """The per-host breaker for an outbound URL (host:port keying: two
+        services on one box fail independently)."""
+        return self.get(urlsplit(url).netloc or url)
+
+
+_SHARED: Optional[BreakerRegistry] = None
+_SHARED_LOCK = threading.Lock()
+
+
+def shared_breakers() -> BreakerRegistry:
+    """The process-global per-host registry the HTTP clients default to.
+    Thresholds are deliberately lenient (10 failures / 30 s) so only a
+    sustained outage trips; latency-sensitive callers construct their own
+    tighter :class:`BreakerRegistry`."""
+    global _SHARED
+    with _SHARED_LOCK:
+        if _SHARED is None:
+            _SHARED = BreakerRegistry()
+        return _SHARED

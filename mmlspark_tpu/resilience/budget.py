@@ -1,0 +1,173 @@
+"""Deadline propagation + retry budget.
+
+Two tail-latency controls from *The Tail at Scale* (Dean & Barroso) the
+reference stack never had:
+
+- :class:`Deadline` — an ambient (contextvar) wall-clock budget for the
+  whole request tree. The serving edge mints one from the
+  ``X-Deadline-Ms`` header (or a server default); every outbound hop
+  forwards the *remaining* budget in the same header and caps its socket
+  timeout to it, so a request that has already missed its SLA stops
+  consuming work at every layer at once.
+- :class:`RetryBudget` — a token bucket that bounds retries to a fraction
+  of live traffic. Each first attempt deposits ``ratio`` tokens, each
+  retry spends one: in steady state retries are at most ``ratio`` of
+  requests, so a down dependency sees load shed toward 1x instead of the
+  (attempts)x multiplication a per-call retry loop produces.
+
+Both take injectable clocks so chaos tests run with zero real sleeps.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import threading
+import time
+from typing import Callable, Iterator, Optional
+
+#: the deadline propagation header: milliseconds of budget remaining,
+#: re-computed (shrunk) at every hop
+DEADLINE_HEADER = "X-Deadline-Ms"
+
+
+class DeadlineExceededError(TimeoutError):
+    """The ambient deadline expired before (or during) the call."""
+
+
+class Deadline:
+    """An absolute point on a monotonic clock; ``remaining()`` is the
+    budget left. Immutable once minted — hops shrink the budget simply by
+    time passing."""
+
+    __slots__ = ("at", "clock")
+
+    def __init__(self, at: float, clock: Callable[[], float] = time.monotonic):
+        self.at = float(at)
+        self.clock = clock
+
+    @classmethod
+    def after(
+        cls, seconds: float, clock: Callable[[], float] = time.monotonic
+    ) -> "Deadline":
+        return cls(clock() + float(seconds), clock)
+
+    def remaining(self) -> float:
+        """Seconds left (negative once expired)."""
+        return self.at - self.clock()
+
+    @property
+    def expired(self) -> bool:
+        return self.remaining() <= 0.0
+
+    # -- header contract (docs/resilience.md) --------------------------------
+
+    def to_header(self) -> str:
+        """Remaining budget as integer milliseconds (floored at 0).
+        Nearest-ms rounding: ceil/floor would drift the budget by up to
+        1 ms per hop in one direction."""
+        return str(max(0, round(self.remaining() * 1000.0)))
+
+    @classmethod
+    def from_header(
+        cls, value: str, clock: Callable[[], float] = time.monotonic
+    ) -> Optional["Deadline"]:
+        """Parse an ``X-Deadline-Ms`` value; None on garbage (a malformed
+        deadline must degrade to "no deadline", never to a 400)."""
+        try:
+            ms = float(value)
+        except (TypeError, ValueError):
+            return None
+        return cls.after(ms / 1000.0, clock)
+
+    def __repr__(self) -> str:
+        return f"Deadline(remaining={self.remaining():.3f}s)"
+
+
+_DEADLINE: contextvars.ContextVar[Optional[Deadline]] = contextvars.ContextVar(
+    "mmlspark_tpu_deadline", default=None
+)
+
+
+def current_deadline() -> Optional[Deadline]:
+    """The ambient deadline, if any caller up-stack set one."""
+    return _DEADLINE.get()
+
+
+@contextlib.contextmanager
+def deadline_scope(
+    seconds_or_deadline, clock: Callable[[], float] = time.monotonic
+) -> Iterator[Deadline]:
+    """Run a block under an ambient deadline::
+
+        with deadline_scope(1.5):
+            client.send(req)   # outbound hop forwards X-Deadline-Ms
+
+    An existing tighter ambient deadline wins — a callee can only shrink
+    the budget, never extend its caller's.
+    """
+    dl = (
+        seconds_or_deadline
+        if isinstance(seconds_or_deadline, Deadline)
+        else Deadline.after(float(seconds_or_deadline), clock)
+    )
+    outer = _DEADLINE.get()
+    # == not `is`: bound methods (fake_clock.now) are fresh objects per
+    # attribute access but compare equal for the same instance+function
+    if outer is not None and outer.at <= dl.at and outer.clock == dl.clock:
+        dl = outer
+    token = _DEADLINE.set(dl)
+    try:
+        yield dl
+    finally:
+        _DEADLINE.reset(token)
+
+
+class RetryBudget:
+    """Token-bucket retry budget (finagle's ``RetryBudget`` shape).
+
+    ``record_request()`` on every first attempt deposits ``ratio`` tokens;
+    ``try_spend()`` before every retry takes one token or answers False.
+    ``min_tokens`` seeds the bucket so low-traffic callers can still retry
+    a cold failure; ``max_tokens`` caps the stockpile so a long quiet
+    period can't bankroll a retry storm later.
+    """
+
+    def __init__(
+        self,
+        ratio: float = 0.2,
+        min_tokens: float = 5.0,
+        max_tokens: float = 100.0,
+        registry=None,
+    ):
+        if ratio < 0:
+            raise ValueError("ratio must be >= 0")
+        self.ratio = float(ratio)
+        self.max_tokens = float(max_tokens)
+        self._tokens = min(float(min_tokens), self.max_tokens)
+        self._lock = threading.Lock()
+        if registry is None:
+            from mmlspark_tpu.observability.registry import get_registry
+
+            registry = get_registry()
+        self._exhausted = registry.counter(
+            "resilience_retry_budget_exhausted_total",
+            "Retries suppressed because the retry budget was empty",
+        )
+
+    @property
+    def tokens(self) -> float:
+        with self._lock:
+            return self._tokens
+
+    def record_request(self) -> None:
+        with self._lock:
+            self._tokens = min(self.max_tokens, self._tokens + self.ratio)
+
+    def try_spend(self, cost: float = 1.0) -> bool:
+        with self._lock:
+            if self._tokens >= cost:
+                self._tokens -= cost
+                return True
+        self._exhausted.inc()
+        return False

@@ -1,0 +1,183 @@
+"""RetryPolicy — one retry loop for the whole codebase.
+
+The repo grew three ad-hoc retry loops (``io/http/clients.py``,
+``cognitive/base.py`` polling, ``downloader/repository.py``
+``retryWithTimeout``), each with its own backoff shape and its own bugs.
+This is the single policy object they all now share — the
+``HandlingUtils.advanced`` role (``io/http/HTTPClients.scala:64-151``)
+done once:
+
+- **seeded exponential backoff with full jitter**: attempt ``n`` sleeps
+  ``U(0, min(cap, base * 2**n))`` drawn from a seeded RNG, so retries
+  de-synchronize across callers (no thundering herd) while chaos tests
+  replay the exact same schedule;
+- a fixed ``delays`` schedule overrides the jitter for callers that need
+  the legacy deterministic waits;
+- ``Retry-After`` parsing handles both delta-seconds and HTTP-date
+  (RFC 9110 §10.2.3) and is honored on 503 as well as 429 — a dependency
+  saying "come back at T" is obeyed whatever status it said it with;
+- an optional :class:`~mmlspark_tpu.resilience.budget.RetryBudget` caps
+  retries to a fraction of traffic, and the ambient
+  :class:`~mmlspark_tpu.resilience.budget.Deadline` clips every sleep.
+
+``sleep``/``clock`` are injectable so every test runs with a fake clock.
+"""
+
+from __future__ import annotations
+
+import email.utils
+import logging
+import time
+from typing import Callable, Mapping, Optional, Sequence, Tuple, TypeVar
+
+import numpy as np
+
+from mmlspark_tpu.resilience.budget import RetryBudget, current_deadline
+
+logger = logging.getLogger("mmlspark_tpu.resilience")
+
+T = TypeVar("T")
+
+#: statuses worth retrying (transient by contract)
+RETRY_STATUSES: Tuple[int, ...] = (408, 429, 500, 502, 503, 504)
+#: statuses that also carry a Retry-After worth honoring
+RETRY_AFTER_STATUSES: Tuple[int, ...] = (429, 503)
+
+
+def parse_retry_after(
+    value: Optional[str], now_wall: Callable[[], float] = time.time
+) -> Optional[float]:
+    """``Retry-After`` -> seconds to wait: either delta-seconds ("120") or
+    an HTTP-date ("Fri, 31 Dec 1999 23:59:59 GMT"). Returns None on
+    garbage — an unparseable hint must not break the retry loop."""
+    if value is None:
+        return None
+    value = value.strip()
+    try:
+        return max(0.0, float(value))
+    except ValueError:
+        pass
+    try:
+        dt = email.utils.parsedate_to_datetime(value)
+    except (TypeError, ValueError):
+        return None
+    if dt is None:
+        return None
+    return max(0.0, dt.timestamp() - now_wall())
+
+
+class RetryPolicy:
+    """Bounded retry schedule: ``max_attempts`` total attempts, sleeps
+    from a seeded full-jitter exponential (or a fixed ``delays`` list)."""
+
+    def __init__(
+        self,
+        max_attempts: int = 4,
+        base: float = 0.1,
+        cap: float = 5.0,
+        delays: Optional[Sequence[float]] = None,
+        seed: Optional[int] = None,
+        retry_statuses: Sequence[int] = RETRY_STATUSES,
+        budget: Optional[RetryBudget] = None,
+        sleep: Callable[[float], None] = time.sleep,
+        clock: Callable[[], float] = time.monotonic,
+        now_wall: Callable[[], float] = time.time,
+    ):
+        if max_attempts < 1:
+            raise ValueError("max_attempts must be >= 1")
+        self.max_attempts = int(max_attempts)
+        self.base = float(base)
+        self.cap = float(cap)
+        self.delays = list(delays) if delays is not None else None
+        self.retry_statuses = tuple(retry_statuses)
+        self.budget = budget
+        self.sleep = sleep
+        self.clock = clock
+        self.now_wall = now_wall
+        self._rng = np.random.default_rng(seed)
+
+    @classmethod
+    def from_legacy_waits(cls, waits: Sequence[float], **kwargs) -> "RetryPolicy":
+        """The old ``retries=(0.1, 0.5, 1.0)`` convention: N fixed waits
+        means N+1 attempts with exactly those sleeps between them."""
+        return cls(max_attempts=len(waits) + 1, delays=waits, **kwargs)
+
+    # -- pieces (used by the HTTP clients' status-aware loop) ----------------
+
+    def retryable(self, status: int) -> bool:
+        return status in self.retry_statuses
+
+    def delay(self, attempt: int) -> float:
+        """Sleep before retry number ``attempt`` (0-based)."""
+        if self.delays is not None:
+            return self.delays[min(attempt, len(self.delays) - 1)]
+        bound = min(self.cap, self.base * (2.0 ** attempt))
+        return float(self._rng.uniform(0.0, bound))
+
+    def retry_after(
+        self, headers: Mapping[str, str], status: int
+    ) -> Optional[float]:
+        """The server's ``Retry-After`` hint, when the status carries one."""
+        if status not in RETRY_AFTER_STATUSES:
+            return None
+        ci = {k.lower(): v for k, v in headers.items()}
+        return parse_retry_after(ci.get("retry-after"), self.now_wall)
+
+    def next_wait(
+        self,
+        attempt: int,
+        status: Optional[int] = None,
+        headers: Optional[Mapping[str, str]] = None,
+    ) -> float:
+        """The full wait computation for one retry: jitter/schedule,
+        raised to the server's Retry-After, clipped to the ambient
+        deadline's remaining budget."""
+        wait = self.delay(attempt)
+        if status is not None and headers is not None:
+            hinted = self.retry_after(headers, status)
+            if hinted is not None:
+                wait = max(wait, hinted)
+        dl = current_deadline()
+        if dl is not None:
+            wait = min(wait, max(0.0, dl.remaining()))
+        return wait
+
+    def allow_retry(self, attempt: int) -> bool:
+        """Retry number ``attempt`` permitted? Checks the attempt bound,
+        the retry budget, and the ambient deadline."""
+        if attempt >= self.max_attempts - 1:
+            return False
+        dl = current_deadline()
+        if dl is not None and dl.expired:
+            return False
+        if self.budget is not None and not self.budget.try_spend():
+            logger.warning(
+                "retry budget exhausted; giving up after attempt %d", attempt + 1
+            )
+            return False
+        return True
+
+    # -- the generic loop (downloader, arbitrary callables) ------------------
+
+    def run(
+        self,
+        fn: Callable[[], T],
+        retry_on: Tuple[type, ...] = (Exception,),
+        describe: str = "",
+    ) -> T:
+        """Call ``fn`` under the policy, retrying on ``retry_on``
+        exceptions. The last failure is re-raised once attempts (or the
+        budget, or the deadline) run out."""
+        if self.budget is not None:
+            self.budget.record_request()
+        last: Optional[BaseException] = None
+        for attempt in range(self.max_attempts):
+            try:
+                return fn()
+            except retry_on as e:
+                last = e
+                if not self.allow_retry(attempt):
+                    break
+                self.sleep(self.next_wait(attempt))
+        assert last is not None
+        raise last

@@ -12,8 +12,10 @@ deterministic enough to test fault recovery bit-for-bit:
   worker pool with graceful drain and dead-worker replacement;
 - :mod:`~mmlspark_tpu.runtime.lineage`   — recompute a lost partition
   from its recorded source instead of failing the job;
-- :mod:`~mmlspark_tpu.runtime.faults`    — seeded fault injection
-  (kill-task, delay-task, drop-heartbeat) for chaos tests;
+- :mod:`~mmlspark_tpu.runtime.faults`    — seeded fault injection for
+  chaos tests: task-plane (kill-task, delay-task, drop-heartbeat) and
+  HTTP-plane (503 storms, latency spikes, connection resets — consumed
+  by the ``mmlspark_tpu.resilience`` layer's clients);
 - :mod:`~mmlspark_tpu.runtime.metrics`   — per-task timings, retry
   counts, queue depth via ``core/profiling.py`` conventions.
 

@@ -14,6 +14,18 @@ recovery sequence instead of racing a process killer:
   heartbeating and hangs until the scheduler declares it lost and
   re-dispatches (the classic network-partitioned worker).
 
+The request plane (``mmlspark_tpu.resilience``) injects at the HTTP
+boundary instead of the task boundary — the outbound clients consult the
+ambient plan before every wire call:
+
+- ``http_storm(n)``   — the next ``n`` matching requests answer a
+  synthetic 503 (or any status) without touching the network — the
+  down-dependency storm that must trip a circuit breaker;
+- ``http_delay(n,s)`` — the next ``n`` matching requests stall ``s``
+  seconds first (tail-latency spike; pairs with deadline propagation);
+- ``http_reset(n)``   — the next ``n`` matching requests raise
+  ``ConnectionResetError`` (the mid-flight TCP reset).
+
 Each registered fault fires at most once; ``plan.fired`` records what
 actually triggered, so tests assert the fault happened AND was survived.
 ``kill_random_task`` draws its victim from the plan's seeded RNG — the
@@ -51,6 +63,9 @@ class FaultPlan:
         self._kill = {}
         self._delay = {}
         self._drop_beat = {}
+        #: ordered HTTP fault directives, consumed first-match per request
+        self._http: List[dict] = []
+        self._http_seq = 0
         self._lock = threading.Lock()
         #: [(kind, task_index, attempt)] in fire order
         self.fired: List[Tuple[str, int, int]] = []
@@ -79,10 +94,48 @@ class FaultPlan:
         plan's RNG, so the chaos is reproducible."""
         return self.kill_task(int(self._rng.integers(num_tasks)), attempt)
 
+    def http_storm(
+        self,
+        count: int = 1,
+        status: int = 503,
+        url_part: str = "",
+        retry_after: Optional[float] = None,
+    ) -> "FaultPlan":
+        """The next ``count`` requests whose URL contains ``url_part``
+        answer a synthetic ``status`` (default 503) without a wire call;
+        ``retry_after`` adds a Retry-After header to the fake response."""
+        self._http.append({
+            "kind": "status", "n": int(count), "status": int(status),
+            "url_part": url_part, "retry_after": retry_after,
+        })
+        return self
+
+    def http_delay(
+        self, count: int = 1, seconds: float = 0.05, url_part: str = ""
+    ) -> "FaultPlan":
+        """The next ``count`` matching requests stall ``seconds`` before
+        going to the wire (injected tail-latency spike)."""
+        self._http.append({
+            "kind": "delay", "n": int(count), "seconds": float(seconds),
+            "url_part": url_part,
+        })
+        return self
+
+    def http_reset(self, count: int = 1, url_part: str = "") -> "FaultPlan":
+        """The next ``count`` matching requests die with
+        ``ConnectionResetError`` (mid-flight TCP reset)."""
+        self._http.append({
+            "kind": "reset", "n": int(count), "url_part": url_part,
+        })
+        return self
+
     @property
     def pending(self) -> int:
         with self._lock:
-            return len(self._kill) + len(self._delay) + len(self._drop_beat)
+            return (
+                len(self._kill) + len(self._delay) + len(self._drop_beat)
+                + sum(d["n"] for d in self._http)
+            )
 
     # -- worker-side hook ----------------------------------------------------
 
@@ -121,6 +174,33 @@ class FaultPlan:
             raise ExecutorDeathError(
                 f"injected executor death on task {index} attempt {attempt}"
             )
+
+    # -- HTTP-side hook (consulted by io/http clients per request) -----------
+
+    def apply_on_http(self, url: str) -> Optional[dict]:
+        """Pop the first registered HTTP fault matching ``url``, or None.
+        The caller (the HTTP client) enacts the directive: synthesize the
+        status, sleep the delay, or raise the reset. Directives are
+        consumed in registration order, one per request."""
+        with self._lock:
+            directive = None
+            for d in self._http:
+                if d["n"] > 0 and d["url_part"] in url:
+                    d["n"] -= 1
+                    directive = dict(d)
+                    break
+            if directive is None:
+                return None
+            self._http = [d for d in self._http if d["n"] > 0]
+            seq = self._http_seq
+            self._http_seq += 1
+        kind = directive["kind"]
+        self.fired.append((
+            f"http_{kind}",
+            seq,
+            directive["status"] if kind == "status" else 0,
+        ))
+        return directive
 
 
 # -- ambient injection (reaches schedulers created inside fit/serve calls) --

@@ -46,6 +46,8 @@ from mmlspark_tpu.data.table import Table
 from mmlspark_tpu.observability.events import BatchFormed, RequestServed, get_bus
 from mmlspark_tpu.observability.registry import get_registry
 from mmlspark_tpu.observability.tracing import Span, get_tracer
+from mmlspark_tpu.resilience.admission import AdmissionController
+from mmlspark_tpu.resilience.budget import DEADLINE_HEADER, Deadline
 
 logger = logging.getLogger("mmlspark_tpu.serving")
 
@@ -74,6 +76,11 @@ class _PendingRequest:
     t_submit: float = 0.0
     span: Optional[Span] = None
     trace_id: str = ""
+    # resilience: the request's wall-clock budget (X-Deadline-Ms or the
+    # server default) and the listener-gave-up flag — both checked by the
+    # batch loop so timed-out work is purged BEFORE the TPU apply
+    deadline: Optional[Deadline] = None
+    cancelled: bool = False
 
 
 @dataclass
@@ -108,6 +115,7 @@ class _BatchLoop:
         max_retries: int = 1,
         scheduler=None,
         registry=None,
+        admission: Optional[AdmissionController] = None,
     ):
         self.model = model
         self.input_col = input_col
@@ -115,6 +123,8 @@ class _BatchLoop:
         self.max_batch_size = int(max_batch_size)
         self.max_latency_ms = float(max_latency_ms)
         self.max_retries = int(max_retries)
+        #: shed-or-admit gate shared by every listener on this loop
+        self.admission = admission
         #: optional mmlspark_tpu.runtime.Scheduler — when set, each
         #: micro-batch is applied as partitioned tasks with retry /
         #: heartbeat re-dispatch (the Spark-executor dispatch analog)
@@ -122,6 +132,10 @@ class _BatchLoop:
         self.queue: "queue.Queue[_PendingRequest]" = queue.Queue()
         self._epoch = 0
         self._history: Dict[int, List[_PendingRequest]] = {}  # uncommitted epochs
+        #: rid -> request reply registry; entries leave on reply OR via
+        #: :meth:`forget` when the listener gives up (504), so timed-out
+        #: rids never accumulate
+        self._pending: Dict[str, _PendingRequest] = {}
         self._lock = threading.Lock()
         self._stopping = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -152,13 +166,38 @@ class _BatchLoop:
             "serving_apply_latency_seconds",
             "Model apply time per micro-batch",
         )
+        self._reg_expired = reg.counter(
+            "serving_expired_total",
+            "Requests dropped before model apply (deadline expired or "
+            "listener gave up)",
+        )
 
     # -- intake / reply ------------------------------------------------------
 
     def submit(self, req: _PendingRequest) -> None:
         if not req.t_submit:
             req.t_submit = time.monotonic()
+        with self._lock:
+            self._pending[req.rid] = req
         self.queue.put(req)
+
+    def forget(self, rid: str) -> None:
+        """The listener answered 504 and moved on: drop the rid from the
+        reply registry and mark the request cancelled so the batch loop
+        purges it instead of computing an answer nobody is waiting for."""
+        with self._lock:
+            req = self._pending.pop(rid, None)
+        if req is not None:
+            req.cancelled = True
+
+    def _finish(self, req: _PendingRequest, data: bytes, status: int) -> None:
+        """Resolve a request: deregister its rid, store the reply, wake
+        the listener."""
+        with self._lock:
+            self._pending.pop(req.rid, None)
+        req.response = data
+        req.status = status
+        req.event.set()
 
     def _reply(self, req: _PendingRequest, value: Any, status: int = 200) -> None:
         """replyTo(requestId) (``HTTPSinkV2.scala:81-89``)."""
@@ -166,9 +205,9 @@ class _BatchLoop:
             value = value.tolist()
         elif isinstance(value, np.generic):
             value = value.item()
-        req.response = json.dumps({self.output_col: value}).encode("utf-8")
-        req.status = status
-        req.event.set()
+        self._finish(
+            req, json.dumps({self.output_col: value}).encode("utf-8"), status
+        )
 
     def note_reply_failure(self, rid: str, exc: BaseException) -> None:
         """The answer existed but the client hung up before the write — a
@@ -232,7 +271,32 @@ class _BatchLoop:
         )
         return Table({self.output_col: out})
 
+    def _purge_expired(
+        self, batch: List[_PendingRequest]
+    ) -> List[_PendingRequest]:
+        """Drop cancelled/deadline-expired requests BEFORE the TPU apply —
+        computing an answer whose requester already got a 504 only adds
+        latency for the live requests behind it (the load-shedding half of
+        deadline propagation)."""
+        live: List[_PendingRequest] = []
+        for r in batch:
+            if r.cancelled or (r.deadline is not None and r.deadline.expired):
+                self._reg_expired.inc()
+                if not r.event.is_set():
+                    self._finish(
+                        r, b'{"error": "deadline exceeded"}', status=504
+                    )
+                else:
+                    with self._lock:
+                        self._pending.pop(r.rid, None)
+            else:
+                live.append(r)
+        return live
+
     def _process(self, batch: List[_PendingRequest]) -> None:
+        batch = self._purge_expired(batch)
+        if not batch:
+            return
         epoch = self._epoch
         self._epoch += 1
         for r in batch:
@@ -295,9 +359,7 @@ class _BatchLoop:
                 self.queue.put(r)
             err = json.dumps({"error": str(e)[:500]}).encode("utf-8")
             for r in failed:
-                r.response = err
-                r.status = 500
-                r.event.set()
+                self._finish(r, err, status=500)
                 self._reg_requests.inc()
 
     def _serve_loop(self) -> None:
@@ -342,6 +404,21 @@ class _BatchLoop:
         self._thread.start()
         return self
 
+    def drain(self, timeout: float = 5.0) -> bool:
+        """Graceful-shutdown helper: wait (bounded) for the already-queued
+        requests to be answered by the still-running loop. Callers stop
+        accepting first, drain second, stop the loop last — admitted
+        requests get answers, not connection resets. Returns True when the
+        queue fully drained."""
+        if self._thread is None or not self._thread.is_alive():
+            return self.queue.empty()
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self.queue.empty() and not self.uncommitted_epochs:
+                return True
+            time.sleep(0.005)
+        return self.queue.empty()
+
     def stop(self) -> None:
         self._stopping.set()
 
@@ -363,6 +440,9 @@ class _ListenerMixin:
                 round(now - last, 3) if last is not None else None
             ),
             "uncommitted_epochs": len(loop.uncommitted_epochs),
+            "inflight": (
+                loop.admission.inflight if loop.admission is not None else None
+            ),
         }
 
     def _make_handler(self, loop: _BatchLoop, input_col: str):
@@ -383,10 +463,14 @@ class _ListenerMixin:
             def _reply_bytes(
                 self, status: int, data: bytes,
                 content_type: str = "application/json",
+                extra_headers: Optional[Dict[str, str]] = None,
             ) -> None:
                 self.send_response(status)
                 self.send_header("Content-Type", content_type)
                 self.send_header("Content-Length", str(len(data)))
+                if extra_headers:
+                    for k, v in extra_headers.items():
+                        self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(data)
 
@@ -403,6 +487,25 @@ class _ListenerMixin:
                     self._reply_bytes(404, b'{"error": "not found"}')
 
             def do_POST(self):  # noqa: N802 (http.server API)
+                # admit-or-shed BEFORE reading the body: an overloaded
+                # server answers 429 + Retry-After in microseconds instead
+                # of queueing work it will time out on (docs/resilience.md)
+                admission = loop.admission
+                if admission is not None and not admission.try_acquire():
+                    self._reply_bytes(
+                        429, b'{"error": "server overloaded"}',
+                        extra_headers={
+                            "Retry-After": f"{admission.retry_after_s:g}"
+                        },
+                    )
+                    return
+                try:
+                    self._handle_admitted()
+                finally:
+                    if admission is not None:
+                        admission.release()
+
+            def _handle_admitted(self) -> None:
                 length = int(self.headers.get("Content-Length", 0))
                 body = self.rfile.read(length)
                 try:
@@ -413,6 +516,13 @@ class _ListenerMixin:
                 if isinstance(payload, dict) and input_col in payload:
                     payload = payload[input_col]
                 req = _PendingRequest(rid=uuid.uuid4().hex, payload=payload)
+                # deadline propagation: a caller-supplied X-Deadline-Ms wins;
+                # otherwise the server's default request budget (if any)
+                req.deadline = Deadline.from_header(
+                    self.headers.get(DEADLINE_HEADER)
+                )
+                if req.deadline is None and server.request_deadline_s:
+                    req.deadline = Deadline.after(server.request_deadline_s)
                 tracer = get_tracer()
                 # listener threads carry no ambient span, so this is a trace
                 # root: the request mints the trace id the batch loop joins
@@ -423,8 +533,15 @@ class _ListenerMixin:
                     span.tags["upstream_trace_id"] = upstream
                 req.span, req.trace_id = span, span.trace_id
                 loop.submit(req)
-                req.event.wait(timeout=30.0)
+                wait_s = server.reply_timeout_s
+                if req.deadline is not None:
+                    # never hold the connection past the caller's budget
+                    wait_s = min(wait_s, max(0.0, req.deadline.remaining()))
+                req.event.wait(timeout=wait_s)
                 if req.response is None:
+                    # the listener gives up: deregister the rid so the loop
+                    # purges the request instead of computing into the void
+                    loop.forget(req.rid)
                     status, data = 504, b'{"error": "timeout"}'
                 else:
                     status, data = req.status, req.response
@@ -475,15 +592,30 @@ class ServingServer(_ListenerMixin):
         name: str = "serving",
         loop: Optional[_BatchLoop] = None,
         registry=None,
+        reply_timeout_s: float = 30.0,
+        max_pending: int = 1024,
+        shed_retry_after_s: float = 1.0,
+        request_deadline_s: Optional[float] = None,
+        drain_timeout_s: float = 5.0,
     ):
         self.input_col = input_col
         self.output_col = output_col
         self.name = name
         self._owns_loop = loop is None
         self._started_at = time.monotonic()
+        #: how long a listener thread holds the connection waiting for the
+        #: loop's reply (was a hardcoded 30 s)
+        self.reply_timeout_s = float(reply_timeout_s)
+        #: default per-request budget when the caller sends no X-Deadline-Ms
+        self.request_deadline_s = request_deadline_s
+        self.drain_timeout_s = float(drain_timeout_s)
         self.loop = loop or _BatchLoop(
             model, input_col, output_col, max_batch_size, max_latency_ms,
             max_retries, registry=registry,
+            admission=AdmissionController(
+                max_pending=max_pending, retry_after_s=shed_retry_after_s,
+                registry=registry, name=name,
+            ),
         )
         self._httpd = _Server((host, port), self._make_handler(self.loop, input_col))
         self.info = ServiceInfo(name, host, self._httpd.server_address[1])
@@ -499,10 +631,14 @@ class ServingServer(_ListenerMixin):
         return self
 
     def stop(self) -> None:
-        if self._owns_loop:
-            self.loop.stop()
+        # graceful drain: stop accepting, answer what was admitted, THEN
+        # stop the loop — reversing the old order, which could kill the
+        # loop while listeners still held admitted-but-unanswered requests
         self._httpd.shutdown()
         self._httpd.server_close()
+        if self._owns_loop:
+            self.loop.drain(timeout=self.drain_timeout_s)
+            self.loop.stop()
 
     def __enter__(self) -> "ServingServer":
         return self.start()
@@ -627,8 +763,12 @@ class DistributedServingServer:
         base_port: int = 0,
         num_executors: int = 0,
         executor_policy=None,
+        max_pending: int = 1024,
+        shed_retry_after_s: float = 1.0,
+        drain_timeout_s: float = 5.0,
         **kwargs,
     ):
+        self.drain_timeout_s = float(drain_timeout_s)
         # num_executors > 0 (or an ambient runtime.policy() / explicit
         # executor_policy) routes every micro-batch through the
         # fault-tolerant partition scheduler: the Spark-cluster posture
@@ -640,9 +780,15 @@ class DistributedServingServer:
         if num_executors > 0 or pol is not None:
             pol = pol or runtime.SchedulerPolicy(max_workers=num_executors)
             self.scheduler = runtime.Scheduler(policy=pol)
+        # ONE admission gate across all listeners: the shared loop is the
+        # shared bottleneck, so the pending bound must be global too
         self.loop = _BatchLoop(
             model, input_col, output_col, max_batch_size, max_latency_ms,
             max_retries, scheduler=self.scheduler,
+            admission=AdmissionController(
+                max_pending=max_pending, retry_after_s=shed_retry_after_s,
+                name=name,
+            ),
         )
         # base_port > 0: listeners bind base_port, base_port+1, ... (the
         # deployable layout — k8s Services need declared ports); 0 keeps
@@ -692,9 +838,12 @@ class DistributedServingServer:
         return self
 
     def stop(self) -> None:
-        self.loop.stop()
+        # listeners first (stop accepting), drain the shared queue, then
+        # stop the loop — admitted requests get answered, not dropped
         for s in self.servers:
             s.stop()
+        self.loop.drain(timeout=self.drain_timeout_s)
+        self.loop.stop()
         if self.scheduler is not None:
             # graceful executor drain, then teardown (Spark's
             # decommission-before-stop)

@@ -1,0 +1,615 @@
+"""resilience/ tests — seeded chaos with injectable clocks, zero real
+sleeps on every retry/breaker path (the fake-clock discipline of
+``tests/test_runtime.py`` applied to the request plane)."""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core.pipeline import Transformer
+from mmlspark_tpu.data.table import Table
+from mmlspark_tpu.io.http.clients import AsyncHTTPClient, HTTPClient
+from mmlspark_tpu.io.http.schema import (
+    EntityData,
+    HeaderData,
+    HTTPRequestData,
+    HTTPResponseData,
+    StatusLineData,
+)
+from mmlspark_tpu.observability.events import BreakerTripped, RequestShed, get_bus
+from mmlspark_tpu.observability.registry import MetricsRegistry
+from mmlspark_tpu.resilience import (
+    AdmissionController,
+    BreakerOpenError,
+    BreakerRegistry,
+    CircuitBreaker,
+    Deadline,
+    DeadlineExceededError,
+    RetryBudget,
+    RetryPolicy,
+    current_deadline,
+    deadline_scope,
+    parse_retry_after,
+)
+from mmlspark_tpu.runtime.faults import FaultPlan, inject_faults
+from mmlspark_tpu.serving.server import ServingServer, _BatchLoop, _PendingRequest
+
+from tests.http_mock import MockService
+
+
+class FakeClock:
+    """Monotonic clock whose time only moves when told (or when a fake
+    sleep is taken), so breaker cooldowns and deadlines are exact."""
+
+    def __init__(self, start: float = 1000.0):
+        self.t = start
+        self.sleeps = []
+
+    def now(self) -> float:
+        return self.t
+
+    def advance(self, seconds: float) -> None:
+        self.t += seconds
+
+    def sleep(self, seconds: float) -> None:
+        self.sleeps.append(seconds)
+        self.t += seconds
+
+
+def _get(url: str, method: str = "GET") -> HTTPRequestData:
+    return HTTPRequestData(url=url, method=method)
+
+
+def _response(status: int, payload=None, headers=()) -> HTTPResponseData:
+    return HTTPResponseData(
+        statusLine=StatusLineData("HTTP/1.1", status, ""),
+        headers=[HeaderData(k, v) for k, v in headers],
+        entity=EntityData(content=json.dumps(payload or {}).encode()),
+    )
+
+
+class TestCircuitBreaker:
+    def _breaker(self, fc, **kw):
+        kw.setdefault("failure_threshold", 3)
+        kw.setdefault("window_s", 10.0)
+        kw.setdefault("reset_timeout_s", 5.0)
+        return CircuitBreaker(
+            "dep", clock=fc.now, registry=MetricsRegistry(), **kw
+        )
+
+    def test_trips_open_at_threshold(self):
+        fc = FakeClock()
+        br = self._breaker(fc)
+        br.record_failure()
+        br.record_failure()
+        assert br.state == "closed" and br.allow()
+        br.record_failure()
+        assert br.state == "open" and br.trips == 1
+        assert not br.allow()
+        assert br.retry_after() == pytest.approx(5.0)
+
+    def test_window_expiry_forgets_old_failures(self):
+        fc = FakeClock()
+        br = self._breaker(fc)
+        br.record_failure()
+        br.record_failure()
+        fc.advance(11.0)  # both failures age out of the 10s window
+        br.record_failure()
+        assert br.state == "closed"
+
+    def test_half_open_probe_success_closes(self):
+        fc = FakeClock()
+        br = self._breaker(fc)
+        for _ in range(3):
+            br.record_failure()
+        fc.advance(5.0)
+        assert br.state == "half_open"
+        assert br.allow()          # the single probe
+        assert not br.allow()      # half_open_max=1: second caller rejected
+        br.record_success()
+        assert br.state == "closed" and br.allow()
+
+    def test_half_open_probe_failure_reopens(self):
+        fc = FakeClock()
+        br = self._breaker(fc)
+        for _ in range(3):
+            br.record_failure()
+        fc.advance(5.0)
+        assert br.allow()
+        br.record_failure()
+        assert br.state == "open"
+        # the cooldown restarted from the probe failure
+        assert br.retry_after() == pytest.approx(5.0)
+
+    def test_gauge_and_trip_counter_exported(self):
+        fc = FakeClock()
+        reg = MetricsRegistry()
+        br = CircuitBreaker(
+            "api.example:443", failure_threshold=1, clock=fc.now, registry=reg
+        )
+        br.record_failure()
+        text = reg.exposition()
+        assert 'resilience_breaker_state{breaker="api.example:443"} 2' in text
+        assert 'resilience_breaker_trips_total{breaker="api.example:443"} 1' in text
+
+    def test_trip_publishes_event(self):
+        fc = FakeClock()
+        br = self._breaker(fc, failure_threshold=1)
+        seen = []
+        bus = get_bus()
+        bus.add_listener(seen.append)
+        try:
+            br.record_failure()
+        finally:
+            bus.remove_listener(seen.append)
+        trips = [e for e in seen if isinstance(e, BreakerTripped)]
+        assert len(trips) == 1 and trips[0].breaker == "dep"
+
+    def test_registry_keys_by_host(self):
+        fc = FakeClock()
+        reg = BreakerRegistry(clock=fc.now, registry=MetricsRegistry())
+        a = reg.for_url("http://h1:8080/path/x")
+        b = reg.for_url("http://h1:8080/other")
+        c = reg.for_url("http://h2:8080/path/x")
+        assert a is b and a is not c
+        assert a.name == "h1:8080"
+
+
+class TestRetryPolicy:
+    def test_seeded_jitter_is_deterministic(self):
+        d1 = [RetryPolicy(seed=42).delay(i) for i in range(6)]
+        d2 = [RetryPolicy(seed=42).delay(i) for i in range(6)]
+        d3 = [RetryPolicy(seed=43).delay(i) for i in range(6)]
+        assert d1 == d2 and d1 != d3
+        # full jitter: bounded by min(cap, base * 2**n)
+        for i, d in enumerate(d1):
+            assert 0.0 <= d <= min(5.0, 0.1 * 2 ** i)
+
+    def test_legacy_waits_schedule(self):
+        p = RetryPolicy.from_legacy_waits((0.1, 0.5, 1.0))
+        assert p.max_attempts == 4
+        assert [p.delay(i) for i in range(3)] == [0.1, 0.5, 1.0]
+
+    def test_parse_retry_after_delta_and_http_date(self):
+        assert parse_retry_after("120") == 120.0
+        assert parse_retry_after(" 0 ") == 0.0
+        assert parse_retry_after("-5") == 0.0
+        import email.utils
+
+        when = "Wed, 21 Oct 2015 07:28:00 GMT"
+        ts = email.utils.parsedate_to_datetime(when).timestamp()
+        assert parse_retry_after(when, now_wall=lambda: ts - 90) == pytest.approx(90.0)
+        assert parse_retry_after(when, now_wall=lambda: ts + 90) == 0.0
+        assert parse_retry_after("soonish") is None
+        assert parse_retry_after(None) is None
+
+    def test_retry_after_only_on_429_and_503(self):
+        p = RetryPolicy(seed=0)
+        headers = {"Retry-After": "9"}
+        assert p.retry_after(headers, 503) == 9.0
+        assert p.retry_after(headers, 429) == 9.0
+        assert p.retry_after(headers, 500) is None
+
+    def test_budget_caps_retries(self):
+        reg = MetricsRegistry()
+        budget = RetryBudget(ratio=0.0, min_tokens=1.0, registry=reg)
+        fc = FakeClock()
+        p = RetryPolicy(
+            max_attempts=10, base=0.0, seed=0, budget=budget, sleep=fc.sleep
+        )
+        calls = []
+
+        def fn():
+            calls.append(1)
+            raise ValueError("down")
+
+        with pytest.raises(ValueError):
+            p.run(fn)
+        # 1 first attempt + exactly min_tokens=1 budgeted retry
+        assert len(calls) == 2
+        assert reg.get("resilience_retry_budget_exhausted_total").value == 1
+
+    def test_run_returns_after_transient_failures(self):
+        fc = FakeClock()
+        p = RetryPolicy(max_attempts=5, base=0.5, seed=7, sleep=fc.sleep)
+        state = {"n": 0}
+
+        def fn():
+            state["n"] += 1
+            if state["n"] < 3:
+                raise OSError("flaky")
+            return "ok"
+
+        assert p.run(fn) == "ok"
+        assert len(fc.sleeps) == 2 and all(s >= 0 for s in fc.sleeps)
+
+
+class TestDeadline:
+    def test_header_round_trip_and_expiry(self):
+        fc = FakeClock()
+        d = Deadline.after(1.0, clock=fc.now)
+        assert d.to_header() == "1000"
+        fc.advance(0.4)
+        assert d.to_header() == "600"
+        d2 = Deadline.from_header(d.to_header(), clock=fc.now)
+        assert d2.remaining() == pytest.approx(0.6)
+        fc.advance(0.7)
+        assert d.expired and d.to_header() == "0"
+        assert Deadline.from_header("garbage") is None
+        assert Deadline.from_header(None) is None
+
+    def test_scope_tighter_outer_wins(self):
+        fc = FakeClock()
+        assert current_deadline() is None
+        with deadline_scope(1.0, clock=fc.now) as outer:
+            with deadline_scope(5.0, clock=fc.now) as inner:
+                assert inner is outer  # callee cannot extend the budget
+                assert current_deadline().remaining() == pytest.approx(1.0)
+            with deadline_scope(0.25, clock=fc.now) as tighter:
+                assert tighter is not outer
+                assert current_deadline().remaining() == pytest.approx(0.25)
+        assert current_deadline() is None
+
+
+class TestHTTPClientResilience:
+    """Seeded chaos against the rewritten client: every sleep is fake."""
+
+    def _policy(self, fc, attempts=10, **kw):
+        kw.setdefault("base", 0.0)
+        kw.setdefault("seed", 0)
+        return RetryPolicy(max_attempts=attempts, sleep=fc.sleep, **kw)
+
+    def test_storm_trips_breaker_and_stops_outbound(self):
+        fc = FakeClock()
+        breakers = BreakerRegistry(
+            failure_threshold=3, window_s=100.0, reset_timeout_s=60.0,
+            registry=MetricsRegistry(),
+        )
+        client = HTTPClient(policy=self._policy(fc), breakers=breakers)
+        plan = FaultPlan(seed=0).http_storm(count=10, status=503)
+        with inject_faults(plan):
+            with pytest.raises(BreakerOpenError) as ei:
+                client.send(_get("http://127.0.0.1:9/predict"))
+        # exactly threshold attempts went "out"; the rest were cut locally
+        assert [f[0] for f in plan.fired] == ["http_status"] * 3
+        assert plan.pending == 7
+        assert breakers.get("127.0.0.1:9").state == "open"
+        assert ei.value.retry_after > 0
+
+    def test_throttle_does_not_trip_breaker(self):
+        fc = FakeClock()
+        breakers = BreakerRegistry(
+            failure_threshold=2, registry=MetricsRegistry()
+        )
+        client = HTTPClient(
+            policy=self._policy(fc, attempts=4), breakers=breakers
+        )
+        plan = FaultPlan(seed=0).http_storm(count=4, status=429)
+        with inject_faults(plan):
+            resp = client.send(_get("http://127.0.0.1:9/limited"))
+        assert resp.status_code == 429  # exhausted retries, returned loudly
+        assert breakers.get("127.0.0.1:9").state == "closed"
+
+    def test_retry_after_honored_on_503(self):
+        fc = FakeClock()
+        client = HTTPClient(policy=self._policy(fc), breakers=None)
+        with MockService() as mock:
+            plan = FaultPlan(seed=0).http_storm(
+                count=2, status=503, retry_after=2.5
+            )
+            with inject_faults(plan):
+                resp = client.send(_get(mock.url + "/x"))
+        assert resp.status_code == 200
+        assert fc.sleeps == [2.5, 2.5]  # jitter base 0 raised to the hint
+
+    def test_terminal_retryable_status_logged_not_silent(self, caplog):
+        fc = FakeClock()
+        client = HTTPClient(policy=self._policy(fc, attempts=2), breakers=None)
+        plan = FaultPlan(seed=0).http_storm(count=5, status=503)
+        with caplog.at_level("WARNING", logger="mmlspark_tpu.io.http"):
+            with inject_faults(plan):
+                resp = client.send(_get("http://127.0.0.1:9/down"))
+        assert resp.status_code == 503
+        assert "giving up" in caplog.text
+
+    def test_connection_reset_fault_raises_after_retries(self):
+        fc = FakeClock()
+        client = HTTPClient(policy=self._policy(fc, attempts=2), breakers=None)
+        plan = FaultPlan(seed=0).http_reset(count=5)
+        with inject_faults(plan):
+            with pytest.raises(ConnectionResetError):
+                client.send(_get("http://127.0.0.1:9/reset"))
+        assert [f[0] for f in plan.fired] == ["http_reset"] * 2
+
+    def test_deadline_forwarded_as_header(self):
+        client = HTTPClient(breakers=None)
+        with MockService() as mock:
+            with deadline_scope(30.0):
+                resp = client.send(_get(mock.url + "/fwd"))
+            assert resp.status_code == 200
+            ms = int(mock.requests[0]["headers"]["X-Deadline-Ms"])
+        assert 0 < ms <= 30_000
+
+    def test_expired_deadline_short_circuits(self):
+        client = HTTPClient(breakers=None)
+        with MockService() as mock:
+            fc = FakeClock()
+            expired = Deadline.after(-1.0, clock=fc.now)
+            with deadline_scope(expired, clock=fc.now):
+                with pytest.raises(DeadlineExceededError):
+                    client.send(_get(mock.url + "/late"))
+            assert mock.requests == []  # no wasted wire call
+
+    def test_async_breaker_open_degrades_to_synthetic_503(self):
+        breakers = BreakerRegistry(
+            failure_threshold=1, reset_timeout_s=60.0,
+            registry=MetricsRegistry(),
+        )
+        breakers.for_url("http://127.0.0.1:9/").record_failure()
+        client = AsyncHTTPClient(concurrency=2, breakers=breakers)
+        out = client.send_all([
+            None, _get("http://127.0.0.1:9/a"), _get("http://127.0.0.1:9/b"),
+        ])
+        assert out[0] is None
+        for resp in out[1:]:
+            assert resp.status_code == 503
+            assert "Retry-After" in resp.header_map()
+
+
+class _Doubler(Transformer):
+    def transform(self, table):
+        x = np.asarray(table.column("input"), dtype=np.float64)
+        return table.with_column("prediction", x * 2)
+
+
+class _GatedModel(Transformer):
+    """Blocks every transform until ``release`` is set."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.release = threading.Event()
+        self.calls = 0
+
+    def transform(self, table):
+        self.calls += 1
+        assert self.release.wait(timeout=10.0), "model gate never released"
+        x = np.asarray(table.column("input"), dtype=np.float64)
+        return table.with_column("prediction", x * 2)
+
+
+def _post(url, payload, timeout=10, headers=None):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(), method="POST",
+        headers={"Content-Type": "application/json", **(headers or {})},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, dict(r.headers), json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), json.loads(e.read() or b"{}")
+
+
+class TestServingAdmission:
+    def test_overload_sheds_with_429_retry_after(self):
+        model = _GatedModel()
+        with ServingServer(
+            model, max_latency_ms=1.0, max_pending=2, shed_retry_after_s=0.25,
+        ) as srv:
+            with ThreadPoolExecutor(max_workers=2) as pool:
+                futs = [
+                    pool.submit(_post, srv.info.url, {"input": float(i)})
+                    for i in range(2)
+                ]
+                deadline = time.monotonic() + 5.0
+                while srv.loop.admission.inflight < 2:
+                    assert time.monotonic() < deadline, "requests never admitted"
+                    time.sleep(0.005)
+                status, headers, _ = _post(srv.info.url, {"input": 9.0})
+                assert status == 429
+                assert headers["Retry-After"] == "0.25"
+                model.release.set()
+                results = [f.result() for f in futs]
+            assert sorted(r[0] for r in results) == [200, 200]
+            # capacity freed after replies (release runs just after the
+            # response write, so poll briefly)
+            deadline = time.monotonic() + 5.0
+            while srv.loop.admission.inflight > 0:
+                assert time.monotonic() < deadline, "admission never released"
+                time.sleep(0.005)
+
+    def test_shed_counted_and_published(self):
+        reg = MetricsRegistry()
+        adm = AdmissionController(max_pending=1, registry=reg, name="t")
+        seen = []
+        bus = get_bus()
+        bus.add_listener(seen.append)
+        try:
+            assert adm.try_acquire()
+            assert not adm.try_acquire()
+        finally:
+            bus.remove_listener(seen.append)
+        adm.release()
+        assert reg.get("serving_shed_total").value == 1
+        sheds = [e for e in seen if isinstance(e, RequestShed)]
+        assert len(sheds) == 1 and sheds[0].reason == "max_pending"
+
+    def test_health_reports_inflight(self):
+        with ServingServer(_Doubler(), max_pending=4) as srv:
+            with urllib.request.urlopen(
+                srv.info.url + "healthz", timeout=5
+            ) as r:
+                health = json.loads(r.read())
+        assert health["inflight"] == 0
+
+
+class TestServingDeadlines:
+    def test_expired_requests_purged_before_model_apply(self):
+        class Exploder(Transformer):
+            def transform(self, table):
+                raise AssertionError("model must not run on expired requests")
+
+        reg = MetricsRegistry()
+        loop = _BatchLoop(Exploder(), "input", "prediction", 8, 1.0, registry=reg)
+        fc = FakeClock()
+        dead = _PendingRequest(
+            rid="r-dead", payload=1.0,
+            deadline=Deadline.after(-0.1, clock=fc.now),
+        )
+        loop.submit(dead)
+        loop._process([dead])  # loop not started: drive one batch directly
+        assert dead.status == 504 and dead.event.is_set()
+        assert b"deadline exceeded" in dead.response
+        assert loop._pending == {}
+        assert reg.get("serving_expired_total").value == 1
+
+    def test_zero_deadline_header_yields_504(self):
+        with ServingServer(_Doubler()) as srv:
+            status, _, body = _post(
+                srv.info.url, {"input": 1.0}, headers={"X-Deadline-Ms": "0"}
+            )
+        assert status == 504 and body["error"] == "timeout"
+
+    def test_reply_timeout_forgets_rid(self):
+        model = _GatedModel()
+        with ServingServer(
+            model, max_latency_ms=1.0, reply_timeout_s=0.2, drain_timeout_s=0.2,
+        ) as srv:
+            status, _, _ = _post(srv.info.url, {"input": 1.0})
+            assert status == 504
+            assert srv.loop._pending == {}  # 504 deregistered the rid
+            model.release.set()
+
+    def test_graceful_drain_answers_admitted_requests(self):
+        srv = ServingServer(_Doubler(), max_latency_ms=1.0).start()
+        try:
+            status, _, out = _post(srv.info.url, {"input": 4.0})
+            assert status == 200 and out["prediction"] == 8.0
+        finally:
+            srv.stop()
+        # the drain left nothing queued or half-processed
+        assert srv.loop.queue.empty()
+        assert srv.loop.uncommitted_epochs == []
+
+
+class _PollSvc:
+    """Minimal stand-in exercising CognitiveServicesBase._poll."""
+
+    def __new__(cls, **params):
+        from mmlspark_tpu.cognitive.base import CognitiveServicesBase
+
+        class Svc(CognitiveServicesBase):
+            polling = True
+
+            def prepare_entity(self, table, row):
+                return {}
+
+        return Svc(outputCol="out", url="http://unused", **params)
+
+
+class TestCognitivePolling:
+    def _resp_202(self):
+        return _response(202, headers=[("Operation-Location", "http://op/1")])
+
+    def _patch_client(self, monkeypatch, responses):
+        calls = []
+
+        class FakeClient:
+            def __init__(self, *a, **kw):
+                pass
+
+            def send(self, request):
+                calls.append(request)
+                return responses[min(len(calls) - 1, len(responses) - 1)]
+
+        monkeypatch.setattr(
+            "mmlspark_tpu.io.http.clients.HTTPClient", FakeClient
+        )
+        return calls
+
+    def test_wall_clock_deadline_bounds_polling(self, monkeypatch):
+        svc = _PollSvc(
+            pollingIntervalMs=50, maxPollingRetries=1000, pollingDeadlineMs=100
+        )
+        calls = self._patch_client(
+            monkeypatch, [_response(200, {"status": "running"})]
+        )
+        fc = FakeClock()
+        with pytest.raises(TimeoutError, match="polling deadline"):
+            svc._poll(self._resp_202(), None, clock=fc.now, sleep=fc.sleep)
+        # 100ms budget / 50ms interval: a couple of polls, not 1000
+        # (float rounding can slip one extra ~0-length wait through)
+        assert len(calls) <= 2
+        assert sum(fc.sleeps) == pytest.approx(0.1, abs=1e-6)
+
+    def test_poll_honors_retry_after_hint(self, monkeypatch):
+        svc = _PollSvc(
+            pollingIntervalMs=50, maxPollingRetries=10,
+            pollingDeadlineMs=10_000_000,
+        )
+        self._patch_client(monkeypatch, [
+            _response(503, {}, headers=[("Retry-After", "3")]),
+            _response(200, {"status": "succeeded", "v": 1}),
+        ])
+        fc = FakeClock()
+        out = svc._poll(self._resp_202(), None, clock=fc.now, sleep=fc.sleep)
+        assert out == {"status": "succeeded", "v": 1}
+        assert fc.sleeps == [0.05, 3.0]  # throttled poll stretched the wait
+
+    def test_ambient_deadline_clips_poll(self, monkeypatch):
+        svc = _PollSvc(
+            pollingIntervalMs=50, maxPollingRetries=1000,
+            pollingDeadlineMs=10_000_000,
+        )
+        self._patch_client(
+            monkeypatch, [_response(200, {"status": "running"})]
+        )
+        fc = FakeClock()
+        with deadline_scope(0.08, clock=fc.now):
+            with pytest.raises(TimeoutError):
+                svc._poll(self._resp_202(), None, clock=fc.now, sleep=fc.sleep)
+
+
+class TestDownloaderRetry:
+    def test_success_after_transient_failures(self):
+        from mmlspark_tpu.downloader.repository import FaultToleranceUtils
+
+        def run_once():
+            fc = FakeClock()
+            state = {"n": 0}
+
+            def fn():
+                state["n"] += 1
+                if state["n"] < 3:
+                    raise OSError("transient")
+                return "payload"
+
+            out = FaultToleranceUtils.retry_with_timeout(
+                fn, times=3, backoff=0.5, sleep=fc.sleep
+            )
+            return out, fc.sleeps
+
+        out1, sleeps1 = run_once()
+        out2, sleeps2 = run_once()
+        assert out1 == out2 == "payload"
+        assert len(sleeps1) == 2
+        assert sleeps1 == sleeps2  # seeded jitter: reproducible schedule
+
+    def test_exhaustion_reraises_last_error(self):
+        from mmlspark_tpu.downloader.repository import FaultToleranceUtils
+
+        fc = FakeClock()
+
+        def fn():
+            raise KeyError("gone")
+
+        with pytest.raises(KeyError):
+            FaultToleranceUtils.retry_with_timeout(
+                fn, times=2, backoff=0.1, sleep=fc.sleep
+            )
+        assert len(fc.sleeps) == 1
