@@ -19,6 +19,13 @@ The per-shard layout maps onto mesh data slices via
 ``parallel.mesh.partition_assignment`` — each executor host binning its own
 shards is the multi-host version of this module (SURVEY.md §7 step 3's
 host-side ingest role).
+
+Every written shard carries a ``<shard>.crc32`` sidecar; loads verify it
+when present and a mismatch raises
+:class:`~mmlspark_tpu.runtime.lineage.PartitionLostError` — under the
+fault-tolerant scheduler that routes the shard through
+``Lineage.recompute`` (a fresh read of the source file), so a torn or
+bit-rotted read is retried instead of silently binning garbage.
 """
 
 from __future__ import annotations
@@ -26,11 +33,50 @@ from __future__ import annotations
 import dataclasses
 import os
 import tempfile
+import zlib
 from typing import Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from mmlspark_tpu.lightgbm.binning import BinMapper, apply_bins, fit_bin_mapper
+from mmlspark_tpu.runtime.lineage import PartitionLostError
+
+
+def _file_crc32(path: str) -> int:
+    """Streaming CRC32 of a file's bytes (shards can be GB-scale)."""
+    crc = 0
+    with open(path, "rb") as fh:
+        for chunk in iter(lambda: fh.read(1 << 20), b""):
+            crc = zlib.crc32(chunk, crc)
+    return crc & 0xFFFFFFFF
+
+
+def write_shard_sidecar(path: str) -> str:
+    """Write ``<path>.crc32`` holding the hex CRC32 of the shard bytes;
+    returns the sidecar path. Loads verify it when it exists."""
+    sidecar = path + ".crc32"
+    crc = _file_crc32(path)
+    with open(sidecar, "w", encoding="utf-8") as fh:
+        fh.write(f"{crc:08x}")
+    return sidecar
+
+
+def _verify_shard(path: str) -> None:
+    """Check ``path`` against its ``.crc32`` sidecar (no-op when absent).
+    A mismatch raises PartitionLostError so the scheduler's lineage path
+    re-reads the shard instead of consuming corrupt bytes."""
+    sidecar = path + ".crc32"
+    try:
+        with open(sidecar, "r", encoding="utf-8") as fh:
+            want = fh.read().strip()
+    except OSError:
+        return
+    got = f"{_file_crc32(path):08x}"
+    if got != want:
+        raise PartitionLostError(
+            f"shard {path} failed CRC verification "
+            f"(sidecar {want}, file {got})"
+        )
 
 
 @dataclasses.dataclass
@@ -85,6 +131,7 @@ class ShardedDataset:
             if w is not None:
                 payload["w"] = np.asarray(w[lo:hi])
             np.savez(path, **payload)
+            write_shard_sidecar(path)
             paths.append(path)
         return ShardedDataset(paths)
 
@@ -92,6 +139,7 @@ class ShardedDataset:
 
     @staticmethod
     def _load(path: str) -> Tuple[np.ndarray, Optional[np.ndarray], Optional[np.ndarray]]:
+        _verify_shard(path)
         if path.endswith(".npz"):
             with np.load(path, allow_pickle=False) as z:
                 X = np.asarray(z["X"], dtype=np.float64)

@@ -351,15 +351,41 @@ class LightGBMBase(LightGBMParams, Estimator):
         )
         from mmlspark_tpu.observability.tracing import get_tracer
 
+        # durable binning: under MMLSPARK_TPU_CHECKPOINT_DIR each
+        # partition's binned block checkpoints as it completes, so a
+        # killed fit rerun with the same params + data resumes with zero
+        # re-execution of finished partitions
+        journal_root = journal_key = None
+        ckpt_root = runtime.default_checkpoint_dir()
+        if ckpt_root is not None:
+            import os
+
+            journal_root = os.path.join(ckpt_root, "binning")
+            journal_key = self._checkpoint_key(X, kwargs)
         self._runtime_metrics = runtime.RuntimeMetrics()
         with get_tracer().span(
             "lightgbm.binning", rows=int(getattr(X, "shape", (0,))[0])
         ):
             bins, mapper = bin_dataset_partitioned(
-                X, policy=pol, metrics=self._runtime_metrics, **kwargs
+                X, policy=pol, metrics=self._runtime_metrics,
+                journal_root=journal_root, journal_key=journal_key, **kwargs
             )
         self._runtime_metrics.log(prefix="binning: ")
         return bins, mapper
+
+    def _checkpoint_key(self, X, bin_kwargs: dict) -> str:
+        """Identity of one durable fit: estimator class + binning params +
+        a data fingerprint (shape + content CRC). A rerun with identical
+        inputs resumes; any change lands in a fresh journal directory."""
+        import zlib
+
+        arr = np.ascontiguousarray(np.asarray(X, dtype=np.float64))
+        crc = zlib.crc32(arr.view(np.uint8).reshape(-1)) & 0xFFFFFFFF
+        parts = [type(self).__name__, f"seed{self.getSeed()}"]
+        parts += [f"{k}={bin_kwargs[k]}" for k in sorted(bin_kwargs)]
+        parts.append(f"X{arr.shape[0]}x{arr.shape[1] if arr.ndim > 1 else 1}")
+        parts.append(f"{crc:08x}")
+        return "-".join(parts)
 
     def _fit(self, table: Table) -> "LightGBMModelBase":
         # Validation split by indicator column (LightGBMBase.scala:196-197).
@@ -446,12 +472,30 @@ class LightGBMBase(LightGBMParams, Estimator):
         model._train_evals = result.evals
         from mmlspark_tpu.observability.events import ModelCommitted, get_bus
 
+        # durable model commit: atomic-rename versioned write under the
+        # checkpoint root, so a warm-restarting server's recovery scan
+        # (ModelStore.latest) never observes a torn model file
+        version = None
+        from mmlspark_tpu.runtime.journal import ModelStore, default_checkpoint_dir
+
+        ckpt_root = default_checkpoint_dir()
+        if ckpt_root is not None:
+            import os
+
+            store = ModelStore(os.path.join(ckpt_root, "models"))
+            version = store.commit(
+                model.get_model_string(), name=type(model).__name__.lower()
+            )
         bus = get_bus()
         if bus.active:
+            detail = (
+                f"{result.booster.num_trees} trees"
+                if getattr(result, "booster", None) is not None else ""
+            )
+            if version is not None:
+                detail = f"{detail} v{version}".strip()
             bus.publish(ModelCommitted(
-                model=type(model).__name__,
-                detail=f"{result.booster.num_trees} trees"
-                if getattr(result, "booster", None) is not None else "",
+                model=type(model).__name__, detail=detail,
             ))
         return model
 
@@ -592,12 +636,18 @@ class LightGBMModelBase(HasFeaturesCol, HasPredictionCol, Model):
             f.write(self.get_model_string())
 
     @classmethod
+    def from_model_string(cls, text: str, **kwargs) -> "LightGBMModelBase":
+        """Build a model from native model text — the loader a
+        warm-restarting server hands to
+        :func:`mmlspark_tpu.serving.recover_model`."""
+        m = cls(**kwargs)
+        m.set_booster(Booster.from_string(text))
+        return m
+
+    @classmethod
     def load_native_model(cls, path: str, **kwargs) -> "LightGBMModelBase":
         with open(path) as f:
-            booster = Booster.from_string(f.read())
-        m = cls(**kwargs)
-        m.set_booster(booster)
-        return m
+            return cls.from_model_string(f.read(), **kwargs)
 
     def get_feature_importances(self, importance_type: str = "split") -> np.ndarray:
         return self.booster.feature_importances(importance_type)

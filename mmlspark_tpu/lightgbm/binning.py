@@ -250,6 +250,7 @@ def bin_dataset_partitioned(
     X, max_bin: int = 255, mapper: Optional[BinMapper] = None,
     categorical_features=None, sample_cnt: int = 200_000,
     max_bin_by_feature=None, policy=None, metrics=None,
+    journal_root: Optional[str] = None, journal_key: Optional[str] = None,
 ) -> Tuple[np.ndarray, BinMapper]:
     """:func:`bin_dataset` with the row-binning pass dispatched as
     partitioned tasks on the fault-tolerant scheduler
@@ -264,6 +265,14 @@ def bin_dataset_partitioned(
 
     CSR input falls back to the inline path (``apply_bins_csr`` scatters
     over the whole matrix in one pass).
+
+    ``journal_root`` + ``journal_key`` make the pass durable: each
+    partition's binned block checkpoints to a
+    :class:`~mmlspark_tpu.runtime.journal.FitJournal` as it completes, so
+    a killed process rerun with the same key restores finished partitions
+    with zero re-execution (the partition count is folded into the
+    journal identity — a different ``max_workers`` starts clean rather
+    than mixing incompatible row slices).
     """
     from mmlspark_tpu import runtime
     from mmlspark_tpu.data.sparse import CSRMatrix
@@ -296,10 +305,19 @@ def bin_dataset_partitioned(
         )
         for i in range(num_parts)
     ]
-    parts = runtime.run_partitioned(
-        lambda rows: apply_bins(rows, mapper), shards, pol,
-        lineage=lineage, metrics=metrics,
-    )
+    journal = None
+    if journal_root is not None and journal_key is not None:
+        journal = runtime.FitJournal(
+            journal_root, f"{journal_key}-p{num_parts}", num_tasks=num_parts
+        )
+    try:
+        parts = runtime.run_partitioned(
+            lambda rows: apply_bins(rows, mapper), shards, pol,
+            lineage=lineage, metrics=metrics, journal=journal,
+        )
+    finally:
+        if journal is not None:
+            journal.close()
     return np.concatenate(parts, axis=0), mapper
 
 

@@ -42,7 +42,11 @@ from mmlspark_tpu.observability.events import (
     StageStarted,
     TaskDispatched,
     TaskFailed,
+    TaskRecovered,
     TaskRetried,
+    TaskSpeculated,
+    WorkerParoled,
+    WorkerQuarantined,
     format_timeline,
     from_record,
     get_bus,
@@ -76,8 +80,12 @@ __all__ = [
     "StageStarted",
     "TaskDispatched",
     "TaskFailed",
+    "TaskRecovered",
     "TaskRetried",
+    "TaskSpeculated",
     "Tracer",
+    "WorkerParoled",
+    "WorkerQuarantined",
     "format_timeline",
     "from_record",
     "get_bus",

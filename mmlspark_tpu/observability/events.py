@@ -110,12 +110,59 @@ class TaskRetried(Event):
 
 @_event
 class TaskFailed(Event):
-    """An attempt failed; ``permanent`` marks retry-budget exhaustion."""
+    """An attempt failed; ``permanent`` marks retry-budget exhaustion.
+    ``worker``/``duration``/``speculative`` carry the structured attempt
+    record (worker -1 = the attempt never reached a worker)."""
 
     job_id: int
     task_id: int
     reason: str
     permanent: bool = False
+    worker: int = -1
+    duration: float = 0.0
+    speculative: bool = False
+    attempt: int = 0
+
+
+@_event
+class TaskSpeculated(Event):
+    """The scheduler launched a speculative duplicate of a running task
+    whose age exceeded ``speculation_multiplier`` x the median run time
+    (the ``spark.speculation`` re-launch)."""
+
+    job_id: int
+    task_id: int
+    original_worker: int
+    age: float
+    median: float
+
+
+@_event
+class TaskRecovered(Event):
+    """A task's result was restored from a journal checkpoint at job
+    start — no dispatch, zero re-execution (RDD checkpoint recovery)."""
+
+    job_id: int
+    task_id: int
+
+
+@_event
+class WorkerQuarantined(Event):
+    """The health tracker took a worker out of the dispatch pool after
+    its rolling failure/straggle score crossed the threshold (the
+    BlacklistTracker exclusion)."""
+
+    worker: int
+    score: float
+    parole_s: float
+
+
+@_event
+class WorkerParoled(Event):
+    """A quarantined worker's parole elapsed; it rejoins the pool with a
+    clean history."""
+
+    worker: int
 
 
 # -- serving -----------------------------------------------------------------
@@ -306,8 +353,15 @@ def timeline(events: Iterable[Event]) -> Dict[str, Any]:
     per-stage wall times, task dispatch/retry/failure counts, serving
     batch/request stats, committed models."""
     stages: Dict[Any, Dict[str, Any]] = {}
-    tasks = {"dispatched": 0, "retried": 0, "failed": 0, "failed_permanent": 0}
+    tasks = {
+        "dispatched": 0, "retried": 0, "failed": 0, "failed_permanent": 0,
+        "speculated": 0, "recovered": 0,
+    }
     retry_reasons: Dict[str, int] = {}
+    #: per-task structured attempt history folded from TaskFailed events
+    attempts: Dict[int, List[Dict[str, Any]]] = {}
+    quarantines: Dict[int, int] = {}
+    paroles = 0
     batches = {"count": 0, "rows": 0}
     latencies: List[float] = []
     statuses: Dict[int, int] = {}
@@ -336,6 +390,19 @@ def timeline(events: Iterable[Event]) -> Dict[str, Any]:
             tasks["failed"] += 1
             if ev.permanent:
                 tasks["failed_permanent"] += 1
+            attempts.setdefault(ev.task_id, []).append({
+                "attempt": ev.attempt, "worker": ev.worker,
+                "reason": ev.reason, "duration": ev.duration,
+                "speculative": ev.speculative, "permanent": ev.permanent,
+            })
+        elif isinstance(ev, TaskSpeculated):
+            tasks["speculated"] += 1
+        elif isinstance(ev, TaskRecovered):
+            tasks["recovered"] += 1
+        elif isinstance(ev, WorkerQuarantined):
+            quarantines[ev.worker] = quarantines.get(ev.worker, 0) + 1
+        elif isinstance(ev, WorkerParoled):
+            paroles += 1
         elif isinstance(ev, BatchFormed):
             batches["count"] += 1
             batches["rows"] += ev.size
@@ -357,11 +424,13 @@ def timeline(events: Iterable[Event]) -> Dict[str, Any]:
         requests["latency_max"] = ordered[-1]
     return {
         "stages": [stages[k] for k in sorted(stages)],
-        "tasks": dict(tasks, retry_reasons=retry_reasons),
+        "tasks": dict(tasks, retry_reasons=retry_reasons, attempts=attempts),
         "batches": batches,
         "requests": requests,
         "models": models,
         "breaker_trips": breaker_trips,
+        "quarantines": quarantines,
+        "paroles": paroles,
     }
 
 
@@ -379,7 +448,26 @@ def format_timeline(summary: Dict[str, Any]) -> str:
     lines.append(
         f"== tasks == dispatched={t['dispatched']} retried={t['retried']} "
         f"failed={t['failed']} permanent={t['failed_permanent']}"
+        + (f" speculated={t['speculated']}" if t.get("speculated") else "")
+        + (f" recovered={t['recovered']}" if t.get("recovered") else "")
     )
+    # structured per-task attempt history (worker / reason / duration /
+    # speculative flag) — the JobFailedError post-mortem view
+    for task_id in sorted(t.get("attempts") or {}):
+        parts = []
+        for a in t["attempts"][task_id]:
+            parts.append(
+                f"attempt {a['attempt']}"
+                + (" (spec)" if a.get("speculative") else "")
+                + f" on w{a['worker']} {a['reason']} {a['duration']:.3f}s"
+                + (" PERMANENT" if a.get("permanent") else "")
+            )
+        lines.append(f"   task {task_id}: " + "; ".join(parts))
+    quarantines = summary.get("quarantines") or {}
+    if quarantines:
+        lines.append("== quarantine == " + ", ".join(
+            f"w{wid} x{n}" for wid, n in sorted(quarantines.items())
+        ) + f" paroled={summary.get('paroles', 0)}")
     b, r = summary["batches"], summary["requests"]
     lines.append(f"== serving == batches={b['count']} rows={b['rows']} "
                  f"requests={r['count']} shed={r.get('shed', 0)}")

@@ -7,15 +7,25 @@ subsystem is our self-owned replacement — small, thread-based, and
 deterministic enough to test fault recovery bit-for-bit:
 
 - :mod:`~mmlspark_tpu.runtime.scheduler` — the driver: per-task state
-  machine, seeded exponential backoff, deterministic result ordering;
+  machine, seeded exponential backoff, deterministic result ordering,
+  speculative execution (straggler duplicates, first result wins);
 - :mod:`~mmlspark_tpu.runtime.executor`  — the fleet: heartbeating
-  worker pool with graceful drain and dead-worker replacement;
+  worker pool with graceful drain, dead-worker replacement, and
+  health-aware admission (quarantined workers get no new attempts);
+- :mod:`~mmlspark_tpu.runtime.health`    — the BlacklistTracker
+  analogue: rolling per-worker failure/straggle scores, timed
+  quarantine with parole;
+- :mod:`~mmlspark_tpu.runtime.journal`   — durable fit state: an
+  append-only completion journal with checksummed atomic partition
+  checkpoints (kill the process, rerun, zero re-execution) and
+  atomic-rename model commits with a recovery scan;
 - :mod:`~mmlspark_tpu.runtime.lineage`   — recompute a lost partition
   from its recorded source instead of failing the job;
 - :mod:`~mmlspark_tpu.runtime.faults`    — seeded fault injection for
-  chaos tests: task-plane (kill-task, delay-task, drop-heartbeat) and
-  HTTP-plane (503 storms, latency spikes, connection resets — consumed
-  by the ``mmlspark_tpu.resilience`` layer's clients);
+  chaos tests: task-plane (kill-task, delay-task, slow-task stragglers,
+  corrupt-result, drop-heartbeat) and HTTP-plane (503 storms, latency
+  spikes, connection resets — consumed by the ``mmlspark_tpu.resilience``
+  layer's clients);
 - :mod:`~mmlspark_tpu.runtime.metrics`   — per-task timings, retry
   counts, queue depth via ``core/profiling.py`` conventions.
 
@@ -31,6 +41,10 @@ Quick start::
     with runtime.inject_faults(plan):
         same = runtime.run_partitioned(process, shards)
     assert same == results and plan.fired
+
+    # durable fit: a rerun after a crash restores finished partitions
+    journal = runtime.FitJournal("/durable/ckpt", key="my-job", num_tasks=8)
+    results = runtime.run_partitioned(process, shards, journal=journal)
 """
 
 from mmlspark_tpu.runtime.executor import ExecutorPool
@@ -40,10 +54,21 @@ from mmlspark_tpu.runtime.faults import (
     current_faults,
     inject_faults,
 )
+from mmlspark_tpu.runtime.health import HealthTracker
+from mmlspark_tpu.runtime.journal import (
+    CHECKPOINT_DIR_ENV,
+    FitJournal,
+    ModelStore,
+    default_checkpoint_dir,
+    result_crc,
+)
 from mmlspark_tpu.runtime.lineage import Lineage, PartitionLostError, ShardLineage
 from mmlspark_tpu.runtime.metrics import RuntimeMetrics
 from mmlspark_tpu.runtime.scheduler import (
+    AllWorkersQuarantinedError,
+    AttemptInfo,
     JobFailedError,
+    ResultCorruptedError,
     Scheduler,
     SchedulerPolicy,
     TaskLostError,
@@ -54,12 +79,19 @@ from mmlspark_tpu.runtime.scheduler import (
 )
 
 __all__ = [
+    "AllWorkersQuarantinedError",
+    "AttemptInfo",
+    "CHECKPOINT_DIR_ENV",
     "ExecutorDeathError",
     "ExecutorPool",
     "FaultPlan",
+    "FitJournal",
+    "HealthTracker",
     "JobFailedError",
     "Lineage",
+    "ModelStore",
     "PartitionLostError",
+    "ResultCorruptedError",
     "RuntimeMetrics",
     "Scheduler",
     "SchedulerPolicy",
@@ -68,7 +100,9 @@ __all__ = [
     "TaskState",
     "current_faults",
     "current_policy",
+    "default_checkpoint_dir",
     "inject_faults",
     "policy",
+    "result_crc",
     "run_partitioned",
 ]

@@ -14,6 +14,14 @@ reproduced faithfully:
   the scheduler re-dispatches the attempt and calls
   :meth:`ExecutorPool.ensure_capacity` to spawn a replacement.
 
+The pool also enforces *admission*: before executing an attempt a worker
+consults :meth:`ExecutorPool._admit` — a quarantined worker (see
+:class:`~mmlspark_tpu.runtime.health.HealthTracker`) gets no new work,
+and an attempt that excludes this worker (a speculative copy must land
+on a different executor than the original) is handed back to the inbox
+for someone else. Attempts already superseded while queued are skipped
+without burning a worker.
+
 Workers are daemon threads so a held worker (fault-injected hang) never
 blocks interpreter exit.
 """
@@ -67,6 +75,15 @@ class _Worker(threading.Thread):
                 att = self.pool._inbox.get()
                 if att is POISON:
                     return
+                sup = getattr(att, "superseded", None)
+                if sup is not None and sup.is_set():
+                    continue  # driver gave up on this attempt while queued
+                if not self.pool._admit(self, att):
+                    # quarantined, or this attempt must run elsewhere:
+                    # hand it back and pause so the bounce doesn't spin hot
+                    self.pool._inbox.put(att)
+                    time.sleep(self.pool.heartbeat_interval / 4)
+                    continue
                 self.current = att
                 att.mark_started(self)
                 try:
@@ -102,6 +119,8 @@ class ExecutorPool:
         #: fleet size the pool keeps replacing dead workers up to
         self.target_workers = num_workers
         self.heartbeat_interval = heartbeat_interval
+        #: optional HealthTracker; quarantined workers are refused work
+        self.health = None
         self._inbox: "queue.Queue" = queue.Queue()
         self._lock = threading.Lock()
         self._workers: List[_Worker] = []
@@ -125,6 +144,18 @@ class ExecutorPool:
         if self._draining or self._shutdown:
             raise RuntimeError(f"pool {self.name!r} is shut down")
         self._inbox.put(attempt)
+
+    def _admit(self, worker: "_Worker", attempt) -> bool:
+        """May ``worker`` execute ``attempt``? False when the attempt
+        excludes this worker (speculative copies must land on a different
+        executor than the original) or the health tracker has the worker
+        quarantined — the worker re-queues the attempt for someone else."""
+        if worker.wid in getattr(attempt, "excluded_workers", ()):
+            return False
+        health = self.health
+        if health is not None and health.is_quarantined(worker.wid):
+            return False
+        return True
 
     def queue_depth(self) -> int:
         return self._inbox.qsize()

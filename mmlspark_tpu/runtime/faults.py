@@ -10,6 +10,13 @@ recovery sequence instead of racing a process killer:
   pool replaces it, like a lost JVM executor);
 - ``delay_task(n, s)``  — task ``n`` stalls ``s`` seconds before running
   (straggler / per-task-timeout scenarios);
+- ``slow_task(n, s)``   — task ``n`` straggles for up to ``s`` seconds
+  but wakes the moment the scheduler supersedes it: the *cancellable*
+  straggler that speculative execution overtakes (``delay_task`` sleeps
+  unconditionally; ``slow_task`` loses a first-result-wins race);
+- ``corrupt_result(n)`` — the executor computes task ``n``'s result
+  correctly, checksums it, then flips bytes before reporting — the
+  driver's end-to-end CRC check must catch the mismatch and retry;
 - ``drop_heartbeat(n)`` — the executor running task ``n`` stops
   heartbeating and hangs until the scheduler declares it lost and
   re-dispatches (the classic network-partitioned worker).
@@ -62,6 +69,8 @@ class FaultPlan:
         self._rng = np.random.default_rng(seed)
         self._kill = {}
         self._delay = {}
+        self._slow = {}
+        self._corrupt = {}
         self._drop_beat = {}
         #: ordered HTTP fault directives, consumed first-match per request
         self._http: List[dict] = []
@@ -78,6 +87,23 @@ class FaultPlan:
 
     def delay_task(self, index: int, seconds: float, attempt: int = 0) -> "FaultPlan":
         self._delay[(int(index), int(attempt))] = float(seconds)
+        return self
+
+    def slow_task(self, index: int, seconds: float, attempt: int = 0) -> "FaultPlan":
+        """Attempt ``attempt`` of task ``index`` straggles: it blocks up
+        to ``seconds`` but wakes immediately when superseded (a speculative
+        copy won, or the driver re-dispatched it), then runs the task body
+        normally. The deterministic straggler speculation must overtake."""
+        self._slow[(int(index), int(attempt))] = float(seconds)
+        return self
+
+    def corrupt_result(self, index: int, attempt: int = 0) -> "FaultPlan":
+        """Attempt ``attempt`` of task ``index`` computes its result
+        correctly and checksums it, then the reported value is corrupted
+        in flight (bit flip / tainted object). The scheduler's result
+        integrity check sees the CRC mismatch, books a retryable
+        ``corrupt`` failure, and the retry runs clean."""
+        self._corrupt[(int(index), int(attempt))] = True
         return self
 
     def drop_heartbeat(
@@ -129,11 +155,19 @@ class FaultPlan:
         })
         return self
 
+    def will_corrupt(self, index: int, attempt: int) -> bool:
+        """True while a ``corrupt_result`` fault is registered for this
+        (task, attempt) — the executor checks this to know it must take
+        the result checksum even when ``policy.result_integrity`` is off."""
+        with self._lock:
+            return (int(index), int(attempt)) in self._corrupt
+
     @property
     def pending(self) -> int:
         with self._lock:
             return (
                 len(self._kill) + len(self._delay) + len(self._drop_beat)
+                + len(self._slow) + len(self._corrupt)
                 + sum(d["n"] for d in self._http)
             )
 
@@ -151,11 +185,20 @@ class FaultPlan:
         key = (int(index), int(attempt))
         with self._lock:
             delay = self._delay.pop(key, None)
+            slow = self._slow.pop(key, None)
             drop = self._drop_beat.pop(key, None)
             kill = self._kill.pop(key, None)
         if delay is not None:
             self.fired.append(("delay", index, attempt))
             time.sleep(delay)
+        if slow is not None:
+            self.fired.append(("slow_task", index, attempt))
+            # straggle, but stay cancellable: a speculative win (or any
+            # supersede) sets the event and this attempt stops stalling
+            if superseded is not None:
+                superseded.wait(timeout=slow)
+            else:
+                time.sleep(slow)
         if drop is not None:
             self.fired.append(("drop_heartbeat", index, attempt))
             if worker is not None:
@@ -174,6 +217,20 @@ class FaultPlan:
             raise ExecutorDeathError(
                 f"injected executor death on task {index} attempt {attempt}"
             )
+
+    def apply_on_result(self, index: int, attempt: int, result):
+        """Consulted by the executor AFTER the task body returns and AFTER
+        the result checksum is taken. If a ``corrupt_result`` fault is
+        registered for this (task, attempt), return a corrupted copy of
+        ``result`` (deterministic bit flip) — simulating corruption between
+        executor and driver; otherwise return ``result`` unchanged."""
+        key = (int(index), int(attempt))
+        with self._lock:
+            corrupt = self._corrupt.pop(key, None)
+        if not corrupt:
+            return result
+        self.fired.append(("corrupt_result", index, attempt))
+        return _corrupted_copy(result)
 
     # -- HTTP-side hook (consulted by io/http clients per request) -----------
 
@@ -201,6 +258,33 @@ class FaultPlan:
             directive["status"] if kind == "status" else 0,
         ))
         return directive
+
+
+class _TaintedResult:
+    """Opaque stand-in for a result corrupted beyond byte-flipping (the
+    payload was not a buffer type). Never equal to the clean value, and
+    pickles to different bytes, so every checksum path catches it."""
+
+    def __init__(self, original):
+        self.original = original
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"_TaintedResult({self.original!r})"
+
+
+def _corrupted_copy(result):
+    """A deterministically corrupted copy of ``result``: byte-flip for
+    buffer-like payloads, a tainted wrapper otherwise."""
+    if isinstance(result, np.ndarray) and result.size and result.dtype != object:
+        bad = result.copy()
+        view = bad.view(np.uint8).reshape(-1)
+        view[0] ^= 0xFF
+        return bad
+    if isinstance(result, (bytes, bytearray)) and len(result):
+        bad = bytearray(result)
+        bad[0] ^= 0xFF
+        return bytes(bad)
+    return _TaintedResult(result)
 
 
 # -- ambient injection (reaches schedulers created inside fit/serve calls) --

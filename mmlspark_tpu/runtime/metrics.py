@@ -64,6 +64,29 @@ class RuntimeMetrics:
         self._reg_queue_depth = reg.gauge(
             "scheduler_max_queue_depth", "High-water executor queue depth"
         )
+        self._reg_spec_launched = reg.counter(
+            "scheduler_speculative_launched_total",
+            "Speculative duplicate attempts launched against stragglers",
+        )
+        self._reg_spec_wins = reg.counter(
+            "scheduler_speculative_wins_total",
+            "Tasks whose speculative copy finished first",
+        )
+        self._reg_recovered = reg.counter(
+            "scheduler_tasks_recovered_total",
+            "Tasks restored from journal checkpoints (zero re-execution)",
+        )
+        self._reg_quarantines = reg.counter(
+            "scheduler_quarantines_total",
+            "Workers quarantined by the health tracker",
+        )
+        self._reg_paroles = reg.counter(
+            "scheduler_paroles_total",
+            "Quarantined workers paroled back into the pool",
+        )
+        self._reg_quarantined_now = reg.gauge(
+            "scheduler_quarantined_workers", "Workers currently quarantined"
+        )
         self._reg_queue_wait = reg.histogram(
             "scheduler_task_queue_wait_seconds", "Dispatch-to-start wait per attempt"
         )
@@ -113,7 +136,8 @@ class RuntimeMetrics:
         self._reg_retries.inc()
 
     def note_failure(self, index: int, reason: str) -> None:
-        """reason: 'error' | 'executor_death' | 'timeout' | 'heartbeat'."""
+        """reason: 'error' | 'executor_death' | 'timeout' | 'heartbeat' |
+        'corrupt' (result failed the end-to-end CRC check)."""
         with self._lock:
             self.counters["failures_total"] += 1
             self.counters[f"failures_{reason}"] += 1
@@ -125,11 +149,42 @@ class RuntimeMetrics:
         self._reg_recomputes.inc()
 
     def note_wasted_result(self) -> None:
-        """A superseded attempt (timeout / heartbeat loss) reported late;
-        its result was discarded."""
+        """A superseded attempt (timeout / heartbeat loss / lost race)
+        reported late; its result was discarded."""
         with self._lock:
             self.counters["wasted_results"] += 1
         self._reg_wasted.inc()
+
+    def note_speculative_launch(self, index: int) -> None:
+        with self._lock:
+            self.counters["speculative_launched"] += 1
+        self._reg_spec_launched.inc()
+
+    def note_speculative_win(self, index: int) -> None:
+        """A speculative duplicate finished before the original attempt."""
+        with self._lock:
+            self.counters["speculative_wins"] += 1
+        self._reg_spec_wins.inc()
+
+    def note_recovered(self, index: int) -> None:
+        """A task restored from a journal checkpoint without dispatch."""
+        with self._lock:
+            self.counters["tasks_recovered"] += 1
+        self._reg_recovered.inc()
+
+    def note_quarantine(self, worker_id: int) -> None:
+        with self._lock:
+            self.counters["quarantines"] += 1
+            n = self.counters["quarantines"] - self.counters["paroles"]
+        self._reg_quarantines.inc()
+        self._reg_quarantined_now.set(max(0, n))
+
+    def note_parole(self, worker_id: int) -> None:
+        with self._lock:
+            self.counters["paroles"] += 1
+            n = self.counters["quarantines"] - self.counters["paroles"]
+        self._reg_paroles.inc()
+        self._reg_quarantined_now.set(max(0, n))
 
     # -- reporting (core/profiling conventions) -----------------------------
 
@@ -148,8 +203,14 @@ class RuntimeMetrics:
                 "failures_heartbeat": self.counters["failures_heartbeat"],
                 "failures_timeout": self.counters["failures_timeout"],
                 "failures_executor_death": self.counters["failures_executor_death"],
+                "failures_corrupt": self.counters["failures_corrupt"],
                 "lineage_recomputes": self.counters["lineage_recomputes"],
                 "wasted_results": self.counters["wasted_results"],
+                "speculative_launched": self.counters["speculative_launched"],
+                "speculative_wins": self.counters["speculative_wins"],
+                "tasks_recovered": self.counters["tasks_recovered"],
+                "quarantines": self.counters["quarantines"],
+                "paroles": self.counters["paroles"],
                 "max_queue_depth": self.max_queue_depth,
                 "phases": self.stopwatch.summary(),
                 "per_task": {i: dict(t) for i, t in self.task_timings.items()},
@@ -162,10 +223,13 @@ class RuntimeMetrics:
         logger.info(
             "%stasks=%d dispatches=%d retries=%d failures=%d "
             "(heartbeat=%d timeout=%d death=%d) recomputes=%d "
+            "speculative=%d/%d recovered=%d quarantines=%d "
             "max_queue_depth=%d",
             prefix, s["tasks_done"], s["dispatches"], s["retries_total"],
             s["failures_total"], s["failures_heartbeat"], s["failures_timeout"],
             s["failures_executor_death"], s["lineage_recomputes"],
+            s["speculative_wins"], s["speculative_launched"],
+            s["tasks_recovered"], s["quarantines"],
             s["max_queue_depth"],
         )
         self.stopwatch.log(logger, prefix=prefix)

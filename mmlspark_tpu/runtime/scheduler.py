@@ -16,6 +16,26 @@ then waits on the job condition with a heartbeat-interval timeout, and on
 every wake scans RUNNING attempts for per-task timeout and stale
 heartbeats. A lost attempt is *superseded* (its late result, if any, is
 discarded), its worker is declared lost, and the task is re-queued.
+
+Three further Spark behaviors ride the same loop (docs/runtime.md):
+
+- **speculative execution** (``spark.speculation``) — once
+  ``speculation_quantile`` of tasks have finished, a running attempt
+  older than ``speculation_multiplier`` x the median run time gets a
+  duplicate attempt on a *different* worker; first result wins, the
+  loser is superseded (its straggle is booked against its worker's
+  health score);
+- **executor quarantine** (BlacklistTracker) — a
+  :class:`~mmlspark_tpu.runtime.health.HealthTracker` scores failures
+  and straggles per worker over a rolling window; workers over the
+  threshold get no new dispatches until parole, and when *every* alive
+  worker is quarantined the job fails fast with
+  :class:`AllWorkersQuarantinedError` (opt out via
+  ``quarantine_fail_fast=False`` to wait for parole);
+- **durable checkpoint/recovery** — pass a
+  :class:`~mmlspark_tpu.runtime.journal.FitJournal` and completed task
+  results are checkpointed (checksummed, atomic) as they land; a re-run
+  after a crash restores them at startup with zero re-execution.
 """
 
 from __future__ import annotations
@@ -34,12 +54,18 @@ from mmlspark_tpu.core.profiling import get_logger
 from mmlspark_tpu.observability.events import (
     TaskDispatched,
     TaskFailed,
+    TaskRecovered,
     TaskRetried,
+    TaskSpeculated,
+    WorkerParoled,
+    WorkerQuarantined,
     get_bus,
 )
 from mmlspark_tpu.observability.tracing import get_tracer
 from mmlspark_tpu.runtime.executor import ExecutorPool
 from mmlspark_tpu.runtime.faults import FaultPlan, current_faults
+from mmlspark_tpu.runtime.health import HealthTracker
+from mmlspark_tpu.runtime.journal import FitJournal, result_crc as _result_crc
 from mmlspark_tpu.runtime.lineage import Lineage, PartitionLostError, ShardLineage
 from mmlspark_tpu.runtime.metrics import RuntimeMetrics
 
@@ -69,9 +95,55 @@ class TaskLostError(RuntimeError):
     budget like any task failure."""
 
 
+class ResultCorruptedError(RuntimeError):
+    """The driver's end-to-end integrity check rejected a reported result:
+    the CRC the executor took after computing it no longer matches the
+    value that arrived. Retryable — the re-run computes a clean copy."""
+
+
+@dataclasses.dataclass
+class AttemptInfo:
+    """One line of a task's attempt history — what :class:`JobFailedError`
+    carries per task and ``format_timeline`` renders."""
+
+    attempt: int
+    worker: int  # executor worker id; -1 = never reached a worker
+    reason: str  # ok|error|timeout|heartbeat|executor_death|corrupt|superseded
+    duration: float
+    speculative: bool = False
+
+
 class JobFailedError(RuntimeError):
     """A task exhausted its retry budget; the whole job fails (Spark
-    semantics: ``spark.task.maxFailures`` exceeded aborts the stage)."""
+    semantics: ``spark.task.maxFailures`` exceeded aborts the stage).
+
+    ``history`` maps task index -> ordered :class:`AttemptInfo` list for
+    every task that recorded at least one attempt, so the post-mortem
+    (which worker, which failure mode, how long, speculative or not) is
+    on the exception itself — no event-log round trip needed.
+    """
+
+    def __init__(self, message: str, history: Optional[Dict[int, List[AttemptInfo]]] = None):
+        super().__init__(message)
+        self.history: Dict[int, List[AttemptInfo]] = history or {}
+
+    def describe(self) -> str:
+        """The message plus per-task attempt lines, newest task last."""
+        lines = [str(self)]
+        for index in sorted(self.history):
+            for a in self.history[index]:
+                spec = " (spec)" if a.speculative else ""
+                lines.append(
+                    f"  task {index}: attempt {a.attempt}{spec} on "
+                    f"w{a.worker} {a.reason} {a.duration:.3f}s"
+                )
+        return "\n".join(lines)
+
+
+class AllWorkersQuarantinedError(JobFailedError):
+    """Every alive worker is quarantined and ``quarantine_fail_fast`` is
+    on — the job cannot make progress anywhere (Spark's "task cannot run
+    anywhere due to node and executor blacklist" abort)."""
 
 
 @dataclasses.dataclass
@@ -95,6 +167,22 @@ class SchedulerPolicy:
     seed: int = 0
     #: explicit fault plan; falls back to faults.current_faults()
     faults: Optional[FaultPlan] = None
+    # -- speculative execution (spark.speculation[.multiplier|.quantile]) ----
+    speculation: bool = False
+    #: a running attempt older than multiplier x median run time straggles
+    speculation_multiplier: float = 1.5
+    #: fraction of tasks that must be DONE before speculation engages
+    speculation_quantile: float = 0.75
+    # -- executor quarantine (spark.excludeOnFailure.*) ----------------------
+    #: rolling failure score at which a worker is quarantined; 0 disables
+    quarantine_threshold: float = 0.0
+    quarantine_window: float = 60.0
+    parole_s: float = 30.0
+    #: raise AllWorkersQuarantinedError instead of waiting for parole
+    quarantine_fail_fast: bool = True
+    # -- end-to-end result integrity -----------------------------------------
+    #: checksum every result executor-side and verify driver-side
+    result_integrity: bool = False
 
     def backoff(self, index: int, failures: int) -> float:
         """Delay before re-dispatching ``index`` after its ``failures``-th
@@ -119,21 +207,36 @@ class TaskRecord:
     error: Optional[BaseException] = None
     not_before: float = 0.0  # monotonic time before which we won't re-dispatch
     needs_recompute: bool = False
+    #: ordered AttemptInfo per settled attempt (success, failure, supersede)
+    history: List[AttemptInfo] = dataclasses.field(default_factory=list)
 
 
 class _Attempt:
     """One dispatch of one task; the unit the executor pool runs."""
 
-    def __init__(self, job: "_Job", task: TaskRecord, attempt_id: int):
+    def __init__(
+        self,
+        job: "_Job",
+        task: TaskRecord,
+        attempt_id: int,
+        speculative: bool = False,
+        excluded_workers: Sequence[int] = (),
+    ):
         self.job = job
         self.task = task
         self.id = attempt_id
         #: 0-based per-task attempt number (what FaultPlan keys on)
         self.task_attempt = task.failures
+        self.speculative = speculative
+        #: worker ids that must NOT run this attempt (a speculative copy
+        #: has to land on a different executor than the original)
+        self.excluded_workers = tuple(excluded_workers)
         self.superseded = threading.Event()
         self.worker = None
         self.dispatched_at = time.monotonic()
         self.started_at: Optional[float] = None
+        #: CRC32 the executor took over the pickled result, pre-transport
+        self.result_crc: Optional[int] = None
         #: tracing span opened at dispatch; finished by whichever side
         #: settles the attempt (success, failure, or driver supersede)
         self.span = None
@@ -159,13 +262,26 @@ class _Attempt:
         payload = self.task.payload
         if isinstance(payload, ShardLineage):
             payload = payload.materialize()
-        return self.job.fn(payload)
+        result = self.job.fn(payload)
+        if self.job.policy.result_integrity or (
+            plan is not None
+            and plan.will_corrupt(self.task.index, self.task_attempt)
+        ):
+            self.result_crc = _result_crc(result)
+        if plan is not None:
+            result = plan.apply_on_result(
+                self.task.index, self.task_attempt, result
+            )
+        return result
 
     def report_success(self, result: Any) -> None:
         self.job._on_success(self, result)
 
     def report_failure(self, err: BaseException, executor_died: bool = False) -> None:
         self.job._on_failure(self, err, executor_died)
+
+    def age(self, now: float) -> Optional[float]:
+        return None if self.started_at is None else now - self.started_at
 
 
 class _Job:
@@ -178,19 +294,26 @@ class _Job:
         policy: SchedulerPolicy,
         metrics: RuntimeMetrics,
         lineage: Optional[Lineage],
+        journal: Optional[FitJournal] = None,
+        health: Optional[HealthTracker] = None,
     ):
         self.fn = fn
         self.policy = policy
         self.metrics = metrics
         self.lineage = lineage
+        self.journal = journal
+        self.health = health
         self.id = _next_job_id()
         self.bus = get_bus()
         self.tasks = [TaskRecord(i, payload) for i, payload in enumerate(shards)]
         self.cond = threading.Condition()
         self.pending = set(range(len(self.tasks)))
-        self.running: Dict[int, _Attempt] = {}
+        #: task index -> live attempts (>1 while a speculative copy races)
+        self.running: Dict[int, List[_Attempt]] = {}
         self.done_count = 0
         self.failed: List[TaskRecord] = []
+        #: run durations of successful attempts — the speculation median
+        self.run_durations: List[float] = []
         self._attempt_ids = 0
 
     def finished(self) -> bool:
@@ -206,23 +329,83 @@ class _Job:
     def _is_current(self, att: _Attempt) -> bool:
         return (
             not att.superseded.is_set()
-            and self.running.get(att.task.index) is att
+            and att in self.running.get(att.task.index, ())
         )
 
     def _on_success(self, att: _Attempt, result: Any) -> None:
+        # end-to-end integrity: the executor checksummed the result before
+        # it crossed the (simulated) wire; verify before taking the lock
+        corrupt = (
+            att.result_crc is not None and _result_crc(result) != att.result_crc
+        )
+        accepted = False
+        t = att.task
         with self.cond:
             if not self._is_current(att):
                 self.metrics.note_wasted_result()
                 return
-            t = att.task
-            del self.running[t.index]
+            now = time.monotonic()
+            duration = now - (att.started_at or att.dispatched_at)
+            siblings = self.running.get(t.index, [])
+            siblings.remove(att)
+            if corrupt:
+                if not siblings:
+                    self.running.pop(t.index, None)
+                if att.span is not None:
+                    get_tracer().finish(att.span, status="corrupt")
+                self._register_failure(
+                    t,
+                    ResultCorruptedError(
+                        f"task {t.index} attempt {att.id} result failed the "
+                        f"end-to-end CRC check "
+                        f"(expected {att.result_crc:#010x})"
+                    ),
+                    "corrupt",
+                    att=att,
+                )
+                self.cond.notify_all()
+                return
+            # first result wins: supersede any racing sibling attempts
+            self.running.pop(t.index, None)
+            for other in siblings:
+                other.superseded.set()
+                if other.span is not None:
+                    get_tracer().finish(other.span, status="superseded")
+                t.history.append(AttemptInfo(
+                    attempt=other.task_attempt,
+                    worker=other.worker.wid if other.worker is not None else -1,
+                    reason="superseded",
+                    duration=(now - other.started_at) if other.started_at else 0.0,
+                    speculative=other.speculative,
+                ))
+                if self.health is not None and other.worker is not None:
+                    # being overtaken is a (discounted) health signal
+                    self.health.note_straggle(other.worker.wid)
             t.state = TaskState.DONE
             t.result = result
+            t.history.append(AttemptInfo(
+                attempt=att.task_attempt,
+                worker=att.worker.wid if att.worker is not None else -1,
+                reason="ok",
+                duration=duration,
+                speculative=att.speculative,
+            ))
             self.done_count += 1
-            self.metrics.note_done(t.index, time.monotonic() - (att.started_at or att.dispatched_at))
+            self.run_durations.append(duration)
+            self.metrics.note_done(t.index, duration)
+            if att.speculative:
+                self.metrics.note_speculative_win(t.index)
+                logger.info(
+                    "task %d: speculative copy won in %.3fs", t.index, duration
+                )
             if att.span is not None:
                 get_tracer().finish(att.span)
+            accepted = True
             self.cond.notify_all()
+        if accepted and self.journal is not None:
+            # durable record outside the job lock: checkpoint + journal
+            # line on the worker's time, never blocking the driver
+            self.journal.record(t.index, result)
 
     def _on_failure(self, att: _Attempt, err: BaseException, executor_died: bool) -> None:
         with self.cond:
@@ -230,23 +413,53 @@ class _Job:
                 self.metrics.note_wasted_result()
                 return
             t = att.task
-            del self.running[t.index]
+            siblings = self.running.get(t.index, [])
+            if att in siblings:
+                siblings.remove(att)
+            if not siblings:
+                self.running.pop(t.index, None)
             reason = "executor_death" if executor_died else "error"
             if att.span is not None:
                 get_tracer().finish(att.span, status=reason, error=str(err)[:200])
-            self._register_failure(t, err, reason)
+            self._register_failure(t, err, reason, att=att)
             self.cond.notify_all()
 
-    def _register_failure(self, t: TaskRecord, err: BaseException, reason: str) -> None:
+    def _register_failure(
+        self,
+        t: TaskRecord,
+        err: BaseException,
+        reason: str,
+        att: Optional[_Attempt] = None,
+    ) -> None:
         """Book a failure against ``t`` and either re-queue or fail it.
-        Caller holds ``self.cond``."""
+        Caller holds ``self.cond``; ``att`` (when the failure settled a
+        specific attempt) supplies worker/timing/speculative detail."""
+        worker_id = -1
+        duration = 0.0
+        speculative = False
+        attempt_no = t.failures
+        if att is not None:
+            attempt_no = att.task_attempt
+            speculative = att.speculative
+            if att.worker is not None:
+                worker_id = att.worker.wid
+            if att.started_at is not None:
+                duration = time.monotonic() - att.started_at
         t.failures += 1
         self.metrics.note_failure(t.index, reason)
-        permanent = t.failures > self.policy.max_retries
+        if self.health is not None and worker_id >= 0:
+            self.health.note_failure(worker_id, reason)
+        t.history.append(AttemptInfo(
+            attempt=attempt_no, worker=worker_id, reason=reason,
+            duration=duration, speculative=speculative,
+        ))
+        others_running = bool(self.running.get(t.index))
+        permanent = t.failures > self.policy.max_retries and not others_running
         if self.bus.active:
             self.bus.publish(TaskFailed(
                 job_id=self.id, task_id=t.index, reason=reason,
-                permanent=permanent,
+                permanent=permanent, worker=worker_id, duration=duration,
+                speculative=speculative, attempt=attempt_no,
             ))
         if (
             isinstance(err, PartitionLostError)
@@ -254,6 +467,15 @@ class _Job:
             and self.lineage.has(t.index)
         ):
             t.needs_recompute = True
+        if others_running:
+            # a sibling attempt (the original, or a speculative copy) is
+            # still live — it remains the task's hope; no re-queue, no
+            # permanent verdict from this failure alone
+            logger.info(
+                "task %d attempt failed (%s); sibling attempt still running",
+                t.index, reason,
+            )
+            return
         if permanent:
             t.state = TaskState.FAILED
             t.error = err
@@ -284,6 +506,12 @@ class Scheduler:
     Reusable across jobs (the serving dispatch loop keeps one alive);
     metrics accumulate across runs. If no pool is supplied the scheduler
     owns one sized by the policy and :meth:`close` shuts it down.
+
+    ``health`` (a :class:`~mmlspark_tpu.runtime.health.HealthTracker`)
+    is built automatically when ``policy.quarantine_threshold > 0``;
+    pass one explicitly to control its clock (fake-clock tests) or share
+    it across schedulers. Either way it is wired to the pool's admission
+    check, this scheduler's metrics, and the event bus.
     """
 
     def __init__(
@@ -291,6 +519,7 @@ class Scheduler:
         pool: Optional[ExecutorPool] = None,
         policy: Optional[SchedulerPolicy] = None,
         metrics: Optional[RuntimeMetrics] = None,
+        health: Optional[HealthTracker] = None,
     ):
         self.policy = policy or current_policy() or SchedulerPolicy()
         self.metrics = metrics or RuntimeMetrics()
@@ -299,6 +528,40 @@ class Scheduler:
             self.policy.max_workers,
             heartbeat_interval=self.policy.heartbeat_interval,
         )
+        if health is None and self.policy.quarantine_threshold > 0:
+            health = HealthTracker(
+                threshold=self.policy.quarantine_threshold,
+                window_s=self.policy.quarantine_window,
+                parole_s=self.policy.parole_s,
+            )
+        self.health = health
+        if health is not None:
+            if health.metrics is None:
+                health.metrics = self.metrics
+            if health.on_quarantine is None:
+                health.on_quarantine = self._announce_quarantine
+            if health.on_parole is None:
+                health.on_parole = self._announce_parole
+        self.pool.health = health
+
+    # -- quarantine announcements (HealthTracker callbacks) ------------------
+
+    def _announce_quarantine(self, worker_id: int, score: float) -> None:
+        logger.warning(
+            "worker %d quarantined (score %.2f >= %.2f); parole in %.1fs",
+            worker_id, score, self.health.threshold, self.health.parole_s,
+        )
+        bus = get_bus()
+        if bus.active:
+            bus.publish(WorkerQuarantined(
+                worker=worker_id, score=score, parole_s=self.health.parole_s,
+            ))
+
+    def _announce_parole(self, worker_id: int) -> None:
+        logger.info("worker %d paroled; rejoining the pool", worker_id)
+        bus = get_bus()
+        if bus.active:
+            bus.publish(WorkerParoled(worker=worker_id))
 
     # -- driver loop ---------------------------------------------------------
 
@@ -308,16 +571,33 @@ class Scheduler:
         shards: Sequence[Any],
         *,
         lineage: Optional[Lineage] = None,
+        journal: Optional[FitJournal] = None,
+        revalidate: Optional[Callable[[int, Any], bool]] = None,
     ) -> List[Any]:
         """Run ``fn`` over every shard; return results in shard order.
 
+        ``journal`` makes the job durable: previously completed tasks are
+        restored from its checkpoints at startup (zero re-execution) and
+        every new completion is recorded before the job can finish.
+        ``revalidate(index, result) -> bool`` vets each restored result
+        (e.g. re-checksum side-effect files); a False sends the task back
+        through normal execution.
+
         Raises :class:`JobFailedError` if any task exhausts its retry
-        budget (partial results are discarded, Spark stage-abort style).
+        budget (partial results are discarded, Spark stage-abort style),
+        carrying the per-task :class:`AttemptInfo` history.
         """
         shards = list(shards)
         if not shards:
             return []
-        job = _Job(fn, shards, self.policy, self.metrics, lineage)
+        job = _Job(
+            fn, shards, self.policy, self.metrics, lineage,
+            journal=journal, health=self.health,
+        )
+        if journal is not None:
+            self._restore_from_journal(job, journal, revalidate)
+            if job.finished() and not job.failed:
+                return [t.result for t in job.tasks]
         # the job span parents every attempt span (attempts are children,
         # retries siblings); under a pipeline-stage or serving-apply span
         # the whole tree hangs off one trace id
@@ -329,8 +609,10 @@ class Scheduler:
                     if job.finished():
                         break
                     now = time.monotonic()
+                    self._check_all_quarantined(job)
                     self._dispatch_due(job, now)
                     self._monitor(job, now)
+                    self._maybe_speculate(job, now)
                     timeout = self._wait_timeout(job, now)
                     job.cond.wait(timeout)
                 # Replace any executor that died (ExecutorDeathError exit) or
@@ -342,9 +624,71 @@ class Scheduler:
                 first = job.failed[0]
                 raise JobFailedError(
                     f"{len(job.failed)}/{len(job.tasks)} tasks failed permanently; "
-                    f"first: task {first.index} after {first.failures} attempts"
+                    f"first: task {first.index} after {first.failures} attempts",
+                    history={
+                        t.index: list(t.history) for t in job.tasks if t.history
+                    },
                 ) from first.error
         return [t.result for t in job.tasks]
+
+    def _restore_from_journal(
+        self,
+        job: _Job,
+        journal: FitJournal,
+        revalidate: Optional[Callable[[int, Any], bool]],
+    ) -> None:
+        """Mark journaled tasks DONE before any dispatch happens (the
+        checkpoint-recovery scan). Runs before the driver loop, so no
+        locking is needed."""
+        restored = journal.restore()
+        recovered = 0
+        for index in sorted(restored):
+            if not 0 <= index < len(job.tasks):
+                continue  # stale journal from a differently-sized run
+            result = restored[index]
+            if revalidate is not None and not revalidate(index, result):
+                logger.warning(
+                    "task %d: journal checkpoint failed revalidation; "
+                    "recomputing", index,
+                )
+                continue
+            t = job.tasks[index]
+            t.state = TaskState.DONE
+            t.result = result
+            job.pending.discard(index)
+            job.done_count += 1
+            recovered += 1
+            self.metrics.note_recovered(index)
+            if job.bus.active:
+                job.bus.publish(TaskRecovered(job_id=job.id, task_id=index))
+        if recovered:
+            logger.info(
+                "restored %d/%d tasks from journal %s (zero re-execution)",
+                recovered, len(job.tasks), journal.dir,
+            )
+
+    def _check_all_quarantined(self, job: _Job) -> None:
+        """Fail fast when no alive worker may accept work. Caller holds
+        ``job.cond``; raising releases it."""
+        if self.health is None or not self.policy.quarantine_fail_fast:
+            return
+        if not (job.pending or job.running):
+            return
+        alive = [w.wid for w in self.pool.workers if not w.dead]
+        if not alive or not self.health.all_quarantined(alive):
+            return
+        # abandon in-flight/queued attempts so workers skip them instead
+        # of bouncing them through the inbox forever
+        for atts in job.running.values():
+            for att in atts:
+                att.superseded.set()
+        wait = self.health.next_parole_in()
+        detail = f" (next parole in {wait:.1f}s)" if wait is not None else ""
+        raise AllWorkersQuarantinedError(
+            f"all {len(alive)} workers are quarantined; job {job.id} cannot "
+            f"run anywhere{detail}",
+            history={t.index: list(t.history) for t in job.tasks if t.history},
+        )
 
     def _dispatch_due(self, job: _Job, now: float) -> None:
         """Submit every pending task whose backoff has elapsed. Caller
@@ -362,7 +706,7 @@ class Scheduler:
             att = _Attempt(job, t, job.next_attempt_id())
             t.attempt = att.id
             t.state = TaskState.RUNNING
-            job.running[index] = att
+            job.running[index] = [att]
             depth = self.pool.queue_depth() + 1
             self.metrics.note_dispatch(index, depth)
             # attempt spans: children of scheduler.job; a retry opens a
@@ -384,44 +728,106 @@ class Scheduler:
         Returns True if a worker was declared lost."""
         lost = False
         timeout = self.policy.task_timeout
-        for index, att in list(job.running.items()):
-            t = att.task
-            if (
-                timeout is not None
-                and att.started_at is not None
-                and now - att.started_at > timeout
-            ):
-                att.superseded.set()
-                del job.running[index]
-                if att.span is not None:
-                    get_tracer().finish(att.span, status="timeout")
-                job._register_failure(
-                    t,
-                    TaskLostError(
-                        f"task {index} attempt {att.id} exceeded "
-                        f"task_timeout={timeout:g}s"
-                    ),
-                    "timeout",
-                )
-            elif (
-                att.worker is not None
-                and now - att.worker.last_beat > self.policy.heartbeat_timeout
-            ):
-                att.superseded.set()
-                del job.running[index]
-                if att.span is not None:
-                    get_tracer().finish(att.span, status="heartbeat")
-                self.pool.declare_lost(att.worker)
-                lost = True
-                job._register_failure(
-                    t,
-                    TaskLostError(
-                        f"executor running task {index} attempt {att.id} missed "
-                        f"heartbeats for > {self.policy.heartbeat_timeout:g}s"
-                    ),
-                    "heartbeat",
-                )
+        for index, atts in list(job.running.items()):
+            for att in list(atts):
+                t = att.task
+                if (
+                    timeout is not None
+                    and att.started_at is not None
+                    and now - att.started_at > timeout
+                ):
+                    att.superseded.set()
+                    atts.remove(att)
+                    if not atts:
+                        job.running.pop(index, None)
+                    if att.span is not None:
+                        get_tracer().finish(att.span, status="timeout")
+                    job._register_failure(
+                        t,
+                        TaskLostError(
+                            f"task {index} attempt {att.id} exceeded "
+                            f"task_timeout={timeout:g}s"
+                        ),
+                        "timeout",
+                        att=att,
+                    )
+                elif (
+                    att.worker is not None
+                    and now - att.worker.last_beat > self.policy.heartbeat_timeout
+                ):
+                    att.superseded.set()
+                    atts.remove(att)
+                    if not atts:
+                        job.running.pop(index, None)
+                    if att.span is not None:
+                        get_tracer().finish(att.span, status="heartbeat")
+                    self.pool.declare_lost(att.worker)
+                    lost = True
+                    job._register_failure(
+                        t,
+                        TaskLostError(
+                            f"executor running task {index} attempt {att.id} missed "
+                            f"heartbeats for > {self.policy.heartbeat_timeout:g}s"
+                        ),
+                        "heartbeat",
+                        att=att,
+                    )
         return lost
+
+    def _maybe_speculate(self, job: _Job, now: float) -> None:
+        """Launch duplicate attempts against stragglers (the
+        ``spark.speculation`` re-launch). Caller holds ``job.cond``.
+
+        Engages only once ``speculation_quantile`` of the job's tasks are
+        DONE and at least one run duration is known; a running attempt
+        whose age exceeds ``speculation_multiplier`` x the median run
+        time gets one speculative copy, pinned off its current worker."""
+        pol = self.policy
+        if not pol.speculation or not job.run_durations:
+            return
+        if job.done_count < pol.speculation_quantile * len(job.tasks):
+            return
+        workers = [w for w in self.pool.workers if not w.dead]
+        if self.health is not None:
+            workers = [w for w in workers if not self.health.is_quarantined(w.wid)]
+        if len(workers) < 2:
+            return  # nowhere different to run a copy
+        median = float(np.median(job.run_durations))
+        threshold = max(pol.speculation_multiplier * median, 1e-6)
+        for index, atts in list(job.running.items()):
+            if len(atts) != 1:
+                continue  # a copy is already racing (or the list is settling)
+            orig = atts[0]
+            age = orig.age(now)
+            if age is None or age <= threshold or orig.worker is None:
+                continue
+            spec = _Attempt(
+                job, orig.task, job.next_attempt_id(),
+                speculative=True, excluded_workers=(orig.worker.wid,),
+            )
+            atts.append(spec)
+            depth = self.pool.queue_depth() + 1
+            self.metrics.note_dispatch(index, depth)
+            self.metrics.note_speculative_launch(index)
+            spec.span = get_tracer().start_span(
+                f"task-{index}", job_id=job.id, attempt=orig.task.failures,
+                speculative=True,
+            )
+            if job.bus.active:
+                job.bus.publish(TaskSpeculated(
+                    job_id=job.id, task_id=index,
+                    original_worker=orig.worker.wid, age=age, median=median,
+                ))
+                job.bus.publish(TaskDispatched(
+                    job_id=job.id, task_id=index, attempt=orig.task.failures,
+                    queue_depth=depth,
+                ))
+            logger.info(
+                "task %d: speculative copy launched (attempt age %.3fs > "
+                "%.2fx median %.3fs)",
+                index, age, pol.speculation_multiplier, median,
+            )
+            self.pool.submit(spec)
 
     def _wait_timeout(self, job: _Job, now: float) -> float:
         """How long the driver may sleep: until the next backoff expiry,
@@ -452,11 +858,15 @@ def run_partitioned(
     lineage: Optional[Lineage] = None,
     pool: Optional[ExecutorPool] = None,
     metrics: Optional[RuntimeMetrics] = None,
+    journal: Optional[FitJournal] = None,
+    revalidate: Optional[Callable[[int, Any], bool]] = None,
 ) -> List[Any]:
     """Run ``fn`` over ``shards`` on a fault-tolerant scheduler; results
     come back in shard order. The one-call public entry point."""
     with Scheduler(pool=pool, policy=policy, metrics=metrics) as sched:
-        return sched.run(fn, shards, lineage=lineage)
+        return sched.run(
+            fn, shards, lineage=lineage, journal=journal, revalidate=revalidate
+        )
 
 
 # -- ambient policy (reaches schedulers created inside fit/serve calls) ------

@@ -5,6 +5,8 @@ from mmlspark_tpu.serving.server import (
     RegistrationService,
     ServiceInfo,
     ServingServer,
+    recover_model,
+    warm_restart_server,
 )
 
 __all__ = [
@@ -12,4 +14,6 @@ __all__ = [
     "RegistrationService",
     "ServiceInfo",
     "ServingServer",
+    "recover_model",
+    "warm_restart_server",
 ]

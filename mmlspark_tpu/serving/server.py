@@ -651,47 +651,78 @@ class RegistrationService:
     """Driver-side endpoint registry (``DriverServiceUtils:113-173``):
     workers POST their ServiceInfo to ``/register``; clients GET
     ``/services`` to discover every worker endpoint
-    (``HTTPSourceStateHolder.serviceInfo``, ``HTTPSourceV2.scala:318-410``)."""
+    (``HTTPSourceStateHolder.serviceInfo``, ``HTTPSourceV2.scala:318-410``).
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    With ``ttl_s`` set, every registration is a lease: replicas refresh it
+    by POSTing ``/heartbeat`` (or calling :meth:`heartbeat` in-process),
+    and a replica whose lease expires silently drops out of
+    :attr:`services` — a crashed worker stops being discoverable without
+    anyone deregistering it. ``ttl_s=None`` keeps the old everlasting
+    registrations."""
+
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        ttl_s: Optional[float] = None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
         self._services: Dict[str, ServiceInfo] = {}
+        #: service name -> last register/heartbeat time (the lease stamp)
+        self._last_seen: Dict[str, float] = {}
+        self.ttl_s = ttl_s
+        self._clock = clock
         self._lock = threading.Lock()
         self._started_at = time.monotonic()
         registry = self
 
         class Handler(BaseHTTPRequestHandler):
             def do_POST(self):  # noqa: N802
-                if self.path != "/register":
+                if self.path not in ("/register", "/heartbeat"):
                     self.send_response(404)
                     self.end_headers()
                     return
                 length = int(self.headers.get("Content-Length", 0))
                 try:
                     info = json.loads(self.rfile.read(length))
-                    svc = ServiceInfo(info["name"], info["host"], int(info["port"]))
+                    name = str(info["name"])
+                except (KeyError, TypeError, ValueError) as e:
+                    logger.debug("rejected malformed %s payload: %s", self.path, e)
+                    self.send_response(400)
+                    self.end_headers()
+                    return
+                if self.path == "/heartbeat":
+                    # lease refresh only: an unknown (expired/never-seen)
+                    # name gets 404 so the replica knows to re-register
+                    if not registry.heartbeat(name):
+                        self.send_response(404)
+                        self.end_headers()
+                        return
+                    self.send_response(200)
+                    self.end_headers()
+                    return
+                try:
+                    svc = ServiceInfo(name, info["host"], int(info["port"]))
                 except (KeyError, TypeError, ValueError) as e:
                     logger.debug("rejected malformed /register payload: %s", e)
                     self.send_response(400)
                     self.end_headers()
                     return
-                with registry._lock:
-                    registry._services[svc.name] = svc
+                registry.register(svc)
                 self.send_response(200)
                 self.end_headers()
 
             def do_GET(self):  # noqa: N802
                 ctype = "application/json"
                 if self.path == "/services":
-                    with registry._lock:
-                        body = json.dumps(
-                            [vars(s) for s in registry._services.values()]
-                        ).encode()
+                    body = json.dumps(
+                        [vars(s) for s in registry.services]
+                    ).encode()
                 elif self.path == "/metrics":
                     body = get_registry().exposition().encode("utf-8")
                     ctype = "text/plain; version=0.0.4; charset=utf-8"
                 elif self.path == "/healthz":
-                    with registry._lock:
-                        n = len(registry._services)
+                    n = len(registry.services)
                     body = json.dumps({
                         "status": "ok",
                         "uptime_seconds": round(
@@ -717,12 +748,39 @@ class RegistrationService:
 
     @property
     def services(self) -> List[ServiceInfo]:
+        """Live endpoints: lease-expired replicas are pruned on read."""
         with self._lock:
+            self._prune_expired()
             return list(self._services.values())
+
+    def _prune_expired(self) -> None:
+        """Drop services whose lease lapsed. Caller holds ``self._lock``."""
+        if self.ttl_s is None:
+            return
+        now = self._clock()
+        for name, seen in list(self._last_seen.items()):
+            if now - seen > self.ttl_s:
+                self._services.pop(name, None)
+                del self._last_seen[name]
+                logger.warning(
+                    "service %r lease expired (no heartbeat for > %.1fs); "
+                    "dropped from discovery", name, self.ttl_s,
+                )
 
     def register(self, svc: ServiceInfo) -> None:
         with self._lock:
             self._services[svc.name] = svc
+            self._last_seen[svc.name] = self._clock()
+
+    def heartbeat(self, name: str) -> bool:
+        """Refresh ``name``'s lease; False when the service is unknown
+        (expired or never registered) — the replica must re-register."""
+        with self._lock:
+            self._prune_expired()
+            if name not in self._services:
+                return False
+            self._last_seen[name] = self._clock()
+            return True
 
     def start(self) -> "RegistrationService":
         threading.Thread(target=self._httpd.serve_forever, daemon=True).start()
@@ -766,9 +824,15 @@ class DistributedServingServer:
         max_pending: int = 1024,
         shed_retry_after_s: float = 1.0,
         drain_timeout_s: float = 5.0,
+        registry_heartbeat_s: Optional[float] = None,
         **kwargs,
     ):
         self.drain_timeout_s = float(drain_timeout_s)
+        #: lease-refresh cadence against a TTL'd RegistrationService;
+        #: None disables the heartbeat thread
+        self.registry_heartbeat_s = registry_heartbeat_s
+        self._hb_stop = threading.Event()
+        self._hb_thread: Optional[threading.Thread] = None
         # num_executors > 0 (or an ambient runtime.policy() / explicit
         # executor_policy) routes every micro-batch through the
         # fault-tolerant partition scheduler: the Spark-cluster posture
@@ -824,6 +888,46 @@ class DistributedServingServer:
                 )
                 urllib.request.urlopen(req, timeout=5).read()
 
+    # -- registry lease refresh ----------------------------------------------
+
+    def _heartbeat_once(self) -> None:
+        """Refresh every listener's lease; a rejected heartbeat (lease
+        already expired) falls back to a full re-registration."""
+        if self._registry is not None:
+            for info in self.service_info:
+                if not self._registry.heartbeat(info.name):
+                    self._registry.register(info)
+        if self._registry_url:
+            import urllib.request
+
+            base = self._registry_url.rstrip("/")
+            for info in self.service_info:
+                req = urllib.request.Request(
+                    base + "/heartbeat",
+                    data=json.dumps({"name": info.name}).encode(),
+                    method="POST",
+                    headers={"Content-Type": "application/json"},
+                )
+                try:
+                    urllib.request.urlopen(req, timeout=5).read()
+                except Exception:
+                    # expired or registry restarted: re-register from scratch
+                    try:
+                        self._register_endpoints()
+                    except Exception:
+                        logger.warning(
+                            "registry heartbeat + re-register failed",
+                            exc_info=True,
+                        )
+                    return
+
+    def _heartbeat_loop(self) -> None:
+        while not self._hb_stop.wait(self.registry_heartbeat_s):
+            try:
+                self._heartbeat_once()
+            except Exception:
+                logger.warning("registry heartbeat failed", exc_info=True)
+
     def start(self) -> "DistributedServingServer":
         self.loop.start()
         for s in self.servers:
@@ -835,9 +939,20 @@ class DistributedServingServer:
             logger.exception("endpoint registration failed; stopping servers")
             self.stop()
             raise
+        if self.registry_heartbeat_s is not None:
+            self._hb_stop.clear()
+            self._hb_thread = threading.Thread(
+                target=self._heartbeat_loop, daemon=True,
+                name="registry-heartbeat",
+            )
+            self._hb_thread.start()
         return self
 
     def stop(self) -> None:
+        self._hb_stop.set()
+        if self._hb_thread is not None:
+            self._hb_thread.join(timeout=1.0)
+            self._hb_thread = None
         # listeners first (stop accepting), drain the shared queue, then
         # stop the loop — admitted requests get answered, not dropped
         for s in self.servers:
@@ -855,3 +970,56 @@ class DistributedServingServer:
 
     def __exit__(self, *exc) -> None:
         self.stop()
+
+
+# -- warm restart (durable model recovery) -----------------------------------
+
+
+def recover_model(
+    loader: Callable[[str], Any],
+    root: Optional[str] = None,
+    name: str = "model",
+):
+    """Warm-restart recovery scan: load the last atomically committed
+    model from the :class:`~mmlspark_tpu.runtime.journal.ModelStore`
+    under ``root`` (default: the ambient ``MMLSPARK_TPU_CHECKPOINT_DIR``,
+    where a durable ``fit`` commits) and rebuild it via ``loader(text)``
+    — e.g. ``LightGBMClassificationModel.from_model_string``. Returns
+    ``(version, model)`` or ``None`` when nothing was ever committed.
+    A torn/corrupt CURRENT pointer falls back to the newest checksummed
+    version, so a crash mid-commit can never resurrect a broken model."""
+    import os
+
+    from mmlspark_tpu.runtime.journal import ModelStore, default_checkpoint_dir
+
+    root = root or default_checkpoint_dir()
+    if root is None:
+        return None
+    store = ModelStore(os.path.join(root, "models"))
+    latest = store.latest(name)
+    if latest is None:
+        return None
+    version, text = latest
+    return version, loader(text)
+
+
+def warm_restart_server(
+    loader: Callable[[str], Any],
+    root: Optional[str] = None,
+    name: str = "model",
+    **server_kwargs,
+) -> ServingServer:
+    """Build a :class:`ServingServer` from the last committed model —
+    the process-kill recovery path: the server that died mid-serve comes
+    back serving exactly the model version that was last atomically
+    committed. Raises ``FileNotFoundError`` when no committed model
+    exists (nothing safe to serve)."""
+    recovered = recover_model(loader, root=root, name=name)
+    if recovered is None:
+        raise FileNotFoundError(
+            f"no committed model {name!r} found under "
+            f"{root or 'MMLSPARK_TPU_CHECKPOINT_DIR'}; cannot warm-restart"
+        )
+    version, model = recovered
+    logger.info("warm restart: serving committed model %s v%06d", name, version)
+    return ServingServer(model, **server_kwargs)

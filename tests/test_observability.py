@@ -209,7 +209,11 @@ class TestEventBus:
         assert summary["stages"][0]["duration"] == 0.5
         assert summary["tasks"] == {
             "dispatched": 1, "retried": 0, "failed": 1, "failed_permanent": 0,
-            "retry_reasons": {},
+            "retry_reasons": {}, "speculated": 0, "recovered": 0,
+            "attempts": {0: [{
+                "attempt": 0, "worker": -1, "reason": "error",
+                "duration": 0.0, "speculative": False, "permanent": False,
+            }]},
         }
         assert summary["requests"]["statuses"] == {200: 1}
         assert summary["models"] == ["PipelineModel"]

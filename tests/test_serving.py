@@ -332,3 +332,97 @@ class TestSchedulerBackedDispatch:
                 results = [f.result() for f in futs]
         for k, (status, out) in enumerate(results):
             assert status == 200 and out["prediction"] == k * 2.0
+
+
+class TestRegistrationLeases:
+    """Registration-service TTL: registrations are leases refreshed by
+    replica heartbeats; a silent crash drops out of discovery."""
+
+    class _Clock:
+        def __init__(self):
+            self.t = 1000.0
+
+        def now(self):
+            return self.t
+
+    def _svc(self, name, port=9001):
+        from mmlspark_tpu.serving.server import ServiceInfo
+
+        return ServiceInfo(name=name, host="127.0.0.1", port=port)
+
+    def test_lease_expires_without_heartbeat(self):
+        clock = self._Clock()
+        reg = RegistrationService(ttl_s=10.0, clock=clock.now)
+        reg.register(self._svc("replica-0"))
+        reg.register(self._svc("replica-1", port=9002))
+        assert {s.name for s in reg.services} == {"replica-0", "replica-1"}
+        # replica-1 keeps heartbeating; replica-0 goes silent
+        clock.t += 8.0
+        assert reg.heartbeat("replica-1")
+        clock.t += 4.0  # replica-0 is now 12 s stale, replica-1 only 4 s
+        assert {s.name for s in reg.services} == {"replica-1"}
+
+    def test_heartbeat_refreshes_lease_indefinitely(self):
+        clock = self._Clock()
+        reg = RegistrationService(ttl_s=10.0, clock=clock.now)
+        reg.register(self._svc("replica-0"))
+        for _ in range(5):
+            clock.t += 9.0
+            assert reg.heartbeat("replica-0")
+        assert {s.name for s in reg.services} == {"replica-0"}
+
+    def test_heartbeat_after_expiry_demands_reregistration(self):
+        clock = self._Clock()
+        reg = RegistrationService(ttl_s=10.0, clock=clock.now)
+        reg.register(self._svc("replica-0"))
+        clock.t += 11.0
+        # the lease lapsed: heartbeat is refused, replica must re-register
+        assert not reg.heartbeat("replica-0")
+        assert reg.services == []
+        reg.register(self._svc("replica-0"))
+        assert {s.name for s in reg.services} == {"replica-0"}
+
+    def test_no_ttl_means_everlasting_registrations(self):
+        clock = self._Clock()
+        reg = RegistrationService(clock=clock.now)  # ttl_s=None
+        reg.register(self._svc("replica-0"))
+        clock.t += 1e9
+        assert {s.name for s in reg.services} == {"replica-0"}
+
+    def test_http_heartbeat_endpoint(self):
+        with RegistrationService(ttl_s=30.0) as reg:
+            reg.register(self._svc("replica-0"))
+            req = urllib.request.Request(
+                reg.info.url + "heartbeat",
+                data=json.dumps({"name": "replica-0"}).encode(),
+                method="POST", headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=5) as r:
+                assert r.status == 200
+            # unknown replica -> 404, the re-register signal
+            req = urllib.request.Request(
+                reg.info.url + "heartbeat",
+                data=json.dumps({"name": "ghost"}).encode(),
+                method="POST", headers={"Content-Type": "application/json"},
+            )
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req, timeout=5)
+            assert ei.value.code == 404
+
+    def test_distributed_server_heartbeats_keep_lease_alive(self):
+        with RegistrationService(ttl_s=1.0) as reg:
+            with DistributedServingServer(
+                _Doubler(), num_servers=2, registry_url=reg.info.url,
+                registry_heartbeat_s=0.2,
+            ) as srv:
+                deadline = time.monotonic() + 2.5
+                while time.monotonic() < deadline:
+                    # the replicas outlive several TTL windows because the
+                    # heartbeat thread keeps refreshing the lease
+                    assert len(reg.services) == 2
+                    time.sleep(0.25)
+            # servers stopped -> heartbeats stop -> leases lapse
+            deadline = time.monotonic() + 5.0
+            while reg.services and time.monotonic() < deadline:
+                time.sleep(0.1)
+            assert reg.services == []

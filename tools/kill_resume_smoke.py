@@ -1,0 +1,132 @@
+#!/usr/bin/env python
+"""Kill-and-resume smoke for the durable fit journal (CI: runtime-faults).
+
+A child process starts a durable partitioned job (``FitJournal`` under a
+throwaway checkpoint dir) whose tasks are slow enough that the job is
+mid-flight when the parent SIGKILLs it — the closest a test gets to a
+real machine loss. The parent then reruns the SAME job in-process and
+asserts the headline durability invariant:
+
+  * every partition the child committed before dying is restored from
+    its checkpoint — the task function runs ZERO times for them;
+  * only the unfinished remainder executes;
+  * the final results are exactly what an uninterrupted run produces.
+
+Exit code 0 + "kill-resume smoke OK" on success; any assertion failure
+is a non-zero exit for CI.
+
+Usage: python tools/kill_resume_smoke.py            # the whole smoke
+       python tools/kill_resume_smoke.py --child D  # internal: the victim
+"""
+
+import glob
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+# runnable both installed (CI) and straight from a checkout
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+NUM_TASKS = 8
+KEY = "kill-resume-smoke"
+# Slow enough that the child is guaranteed mid-flight when killed, fast
+# enough that the whole smoke stays in single-digit seconds.
+TASK_SECONDS = 0.4
+
+
+def _work(x):
+    time.sleep(TASK_SECONDS)
+    return x * x
+
+
+def run_child(root: str) -> None:
+    """The victim: run the durable job to completion (it won't get to)."""
+    from mmlspark_tpu import runtime
+
+    journal = runtime.FitJournal(root, key=KEY, num_tasks=NUM_TASKS)
+    runtime.run_partitioned(
+        _work,
+        list(range(NUM_TASKS)),
+        runtime.SchedulerPolicy(max_workers=2, backoff_base=0.01),
+        journal=journal,
+    )
+    journal.close()
+
+
+def main() -> int:
+    root = tempfile.mkdtemp(prefix="mmlspark-tpu-killsmoke-")
+    child = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--child", root],
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+
+    # Wait for SOME (not all) partitions to commit, then pull the plug.
+    ckpt_glob = os.path.join(root, "*", "task-*.ckpt")
+    deadline = time.monotonic() + 60.0
+    committed_before = 0
+    while time.monotonic() < deadline:
+        committed_before = len(glob.glob(ckpt_glob))
+        if committed_before >= 2:
+            break
+        if child.poll() is not None:
+            print("FAIL: child finished before it could be killed; "
+                  "raise NUM_TASKS or TASK_SECONDS", file=sys.stderr)
+            return 1
+        time.sleep(0.02)
+    else:
+        print("FAIL: no partitions committed within 60s", file=sys.stderr)
+        child.kill()
+        return 1
+
+    child.send_signal(signal.SIGKILL)
+    child.wait()
+    assert child.returncode != 0, "SIGKILLed child cannot exit 0"
+    committed_before = len(glob.glob(ckpt_glob))  # settle post-mortem
+    print(f"killed child mid-fit with {committed_before}/{NUM_TASKS} "
+          f"partitions committed")
+    assert 0 < committed_before < NUM_TASKS, (
+        f"need a genuine partial state, got {committed_before}/{NUM_TASKS}"
+    )
+
+    # Resume in THIS process: committed partitions must not re-execute.
+    from mmlspark_tpu import runtime
+
+    executed = []
+
+    def counting_work(x):
+        executed.append(x)
+        return _work(x)
+
+    journal = runtime.FitJournal(root, key=KEY, num_tasks=NUM_TASKS)
+    restored = len(journal.restore())
+    out = runtime.run_partitioned(
+        counting_work,
+        list(range(NUM_TASKS)),
+        runtime.SchedulerPolicy(max_workers=2, backoff_base=0.01),
+        journal=journal,
+    )
+    journal.close()
+
+    assert restored == committed_before, (
+        f"restored {restored} != committed {committed_before}"
+    )
+    assert out == [x * x for x in range(NUM_TASKS)], f"wrong results: {out}"
+    assert len(executed) == NUM_TASKS - committed_before, (
+        f"re-executed a committed partition: ran {sorted(executed)}, "
+        f"but {committed_before} were already committed"
+    )
+    assert journal.appended == len(executed)
+    print(f"resume executed only the {len(executed)} uncommitted "
+          f"partitions (zero re-execution of {committed_before} committed)")
+    print("kill-resume smoke OK")
+    return 0
+
+
+if __name__ == "__main__":
+    if len(sys.argv) == 3 and sys.argv[1] == "--child":
+        run_child(sys.argv[2])
+        sys.exit(0)
+    sys.exit(main())
