@@ -201,6 +201,18 @@ class LightGBMParams(
         "the scheduler",
         default=0, converter=to_int, validator=ge(0),
     )
+    numProcesses = Param(
+        "Run the fit itself across this many real worker processes under a "
+        "supervised gang (mmlspark_tpu.runtime.procgroup): each process "
+        "fits a contiguous row shard, histograms allreduce over sockets, "
+        "and a process killed mid-fit triggers gang recovery that resumes "
+        "from the fit journal with zero re-execution of committed "
+        "iterations. The distributed analog of the reference's "
+        "per-executor native fit. 0/1 (default) fits in-process. Process "
+        "mode restricts options (no bagging/GOSS/dart, no validation "
+        "sets); see lightgbm.procfit.validate_process_options",
+        default=0, converter=to_int, validator=ge(0),
+    )
 
     def _objective_name(self) -> str:
         raise NotImplementedError
@@ -453,7 +465,13 @@ class LightGBMBase(LightGBMParams, Estimator):
             init_margins = prev.raw_margin(X)
 
         num_batches = self.getNumBatches()
-        if num_batches and num_batches > 1:
+        num_processes = self.getNumProcesses()
+        if num_processes > 1:
+            result = self._fit_process_group(
+                bins, y, w, init_margins, opts, mapper, valid_sets,
+                feature_names, num_processes, num_batches, X,
+            )
+        elif num_batches and num_batches > 1:
             result = self._fit_batches(
                 bins, y, w, init_margins, opts, mapper, mesh, valid_sets, feature_names,
                 num_batches,
@@ -498,6 +516,55 @@ class LightGBMBase(LightGBMParams, Estimator):
                 model=type(model).__name__, detail=detail,
             ))
         return model
+
+    def _fit_process_group(
+        self, bins, y, w, init_margins, opts, mapper, valid_sets,
+        feature_names, num_processes, num_batches, X,
+    ) -> TrainResult:
+        """`numProcesses` > 1: hand the fit to a supervised worker gang
+        (:func:`mmlspark_tpu.lightgbm.procfit.fit_process_group`). The
+        feature combinations a shard-local process cannot reproduce are
+        rejected up front rather than silently diverging."""
+        from mmlspark_tpu.lightgbm.procfit import fit_process_group
+
+        if num_batches and num_batches > 1:
+            raise ValueError("numProcesses and numBatches are exclusive")
+        if valid_sets:
+            raise ValueError(
+                "process-parallel fit does not support validation sets "
+                "(validation is driver-side; score the model after fit)"
+            )
+        if init_margins is not None:
+            raise ValueError(
+                "process-parallel fit does not support initScoreCol or "
+                "modelString warm start"
+            )
+        if self.callbacks:
+            raise ValueError(
+                "training delegates cannot cross the process boundary; "
+                "unset delegates or numProcesses"
+            )
+        journal_root = journal_key = None
+        from mmlspark_tpu.runtime.journal import default_checkpoint_dir
+
+        ckpt_root = default_checkpoint_dir()
+        if ckpt_root is not None:
+            import os
+
+            journal_root = os.path.join(ckpt_root, "procfit")
+            journal_key = self._checkpoint_key(
+                X, {"procs": num_processes, "iters": opts.num_iterations}
+            )
+        result = fit_process_group(
+            None, y, opts, w=w, num_processes=num_processes,
+            feature_names=feature_names, bins=bins, mapper=mapper,
+            journal_root=journal_root,
+            journal_key=journal_key or "procfit",
+        )
+        self._process_fit = result  # epochs/exit statuses for inspection
+        return TrainResult(
+            booster=result.booster, evals={}, best_iteration=-1
+        )
 
     def _fit_batches(
         self, bins, y, w, init_margins, opts, mapper, mesh, valid_sets,

@@ -1,0 +1,424 @@
+"""Process-spanning GBDT fit over a supervised worker gang.
+
+The reference's distributed fit is real OS processes: each Spark executor
+runs native LightGBM against its partition, histograms cross executors
+over LightGBM's socket ``Network::Allreduce``, and a died executor means
+a re-run task on a surviving one. This module is that shape on the TPU
+framework:
+
+- the driver (:func:`fit_process_group`) bins the dataset once, parks it
+  in the group workdir, and hands the fit to a
+  :class:`~mmlspark_tpu.runtime.procgroup.ProcessGroup` — N worker
+  processes, ``jax.distributed`` rendezvous, heartbeats, gang recovery;
+- each worker (:func:`worker_fit`) slices its contiguous row shard,
+  rebuilds margins from the shared
+  :class:`~mmlspark_tpu.runtime.journal.FitJournal`, and runs
+  :func:`~mmlspark_tpu.lightgbm.train.train` with the histogram
+  allreduce injected (``hist_reduce``) — so every member grows identical
+  trees from GLOBAL statistics;
+- rank 0 journals each committed iteration (``iteration_hook``), and on
+  gang recovery the re-formed group resumes at the first un-journaled
+  iteration with ZERO re-execution of committed ones
+  (``TaskRecovered`` per restored iteration, exactly like the
+  thread-scheduler's checkpoint recovery).
+
+Because the bagging mask would otherwise be drawn per-shard (breaking
+parity with a single-process fit), process mode restricts the option
+surface: no bagging, no GOSS/dart, no quantile/L1 percentile renewal, no
+voting-parallel, no quantized gradients, no validation sets. Everything
+else — growth policies, categoricals, feature fraction, weights,
+multiclass — carries over unchanged, and a 2-process fit reproduces the
+single-process model text byte for byte.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import pickle
+from pathlib import Path
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from mmlspark_tpu.core.profiling import get_logger
+
+logger = get_logger("mmlspark_tpu.lightgbm.procfit")
+
+#: dataclass fields that must come back as tuples after a JSON round-trip
+_TUPLE_FIELDS = ("categorical_slots", "onehot_slots")
+
+
+@dataclasses.dataclass
+class ProcessFitResult:
+    """What a process-group fit hands back to the driver."""
+
+    booster: Any
+    model_text: str
+    iterations: int
+    recovered_iterations: int
+    epochs: int
+    worker_results: Dict[int, Any]
+    exit_statuses: List[Any]
+
+
+def options_to_payload(opts) -> Dict[str, Any]:
+    """JSON-safe TrainOptions (tuples become lists in flight)."""
+    return dataclasses.asdict(opts)
+
+
+def options_from_payload(d: Dict[str, Any]):
+    """Rebuild TrainOptions from the epoch-spec payload, restoring the
+    tuple-typed fields (``_opts_key`` hashes them)."""
+    from mmlspark_tpu.lightgbm.train import TrainOptions
+
+    fixed = dict(d)
+    for key in _TUPLE_FIELDS:
+        if key in fixed and isinstance(fixed[key], list):
+            fixed[key] = tuple(fixed[key])
+    return TrainOptions(**fixed)
+
+
+def validate_process_options(opts) -> None:
+    """Reject option combinations whose semantics depend on the row shard
+    (they would break single-process parity) or that need cross-row state
+    the histogram allreduce does not carry."""
+    problems = []
+    if opts.bagging_fraction < 1.0 or opts.bagging_freq > 0:
+        problems.append(
+            "bagging (masks would be drawn per-shard, not globally)"
+        )
+    if opts.pos_bagging_fraction < 1.0 or opts.neg_bagging_fraction < 1.0:
+        problems.append("pos/neg bagging")
+    if opts.boosting_type in ("goss", "dart"):
+        problems.append(
+            f"boosting_type={opts.boosting_type!r} (GOSS top-k and dart "
+            "drops are global-row decisions)"
+        )
+    if opts.objective in ("quantile", "regression_l1"):
+        problems.append(
+            f"objective={opts.objective!r} (percentile leaf renewal sorts "
+            "all rows globally)"
+        )
+    if opts.tree_learner == "voting_parallel":
+        problems.append("tree_learner='voting_parallel'")
+    if opts.use_quantized_grad:
+        problems.append("use_quantized_grad (U path is single-device)")
+    if opts.histogram_method == "u":
+        problems.append("histogram_method='u' (U path is single-device)")
+    if opts.provide_training_metric:
+        problems.append("provide_training_metric (needs global margins)")
+    if opts.early_stopping_round > 0:
+        problems.append("early stopping (validation is driver-side)")
+    if problems:
+        raise ValueError(
+            "process-parallel fit does not support: " + "; ".join(problems)
+        )
+
+
+def model_texts_close(a: str, b: str, rtol: float = 1e-3,
+                      atol: float = 1e-6) -> bool:
+    """Model-text parity for distributed fits.
+
+    A process-parallel fit sums shard histograms over the wire, so float
+    cells round differently than the single-process full-row scatter-add
+    (1-2 ulps — native LightGBM's parallel learners diverge the same
+    way). Tree STRUCTURE must be byte-identical: every line compares
+    exactly except that float-valued fields (``split_gain``,
+    ``leaf_value``, ...) compare within tolerance. Integer-valued fields
+    compare exactly even on the float path."""
+    la, lb = a.splitlines(), b.splitlines()
+    if len(la) != len(lb):
+        return False
+    for x, z in zip(la, lb):
+        if x == z:
+            continue
+        ka, _, va = x.partition("=")
+        kb, _, vb = z.partition("=")
+        if ka != kb:
+            return False
+        if ka == "tree_sizes":
+            # byte length of each serialized tree — tracks float repr
+            # width, not structure; only the tree count must agree
+            if len(va.split()) != len(vb.split()):
+                return False
+            continue
+        try:
+            fa = np.asarray([float(t) for t in va.split()])
+            fb = np.asarray([float(t) for t in vb.split()])
+        except ValueError:
+            return False
+        if fa.shape != fb.shape or not np.allclose(fa, fb, rtol=rtol,
+                                                   atol=atol):
+            return False
+    return True
+
+
+def _journal_key(payload: Dict[str, Any]) -> str:
+    return str(payload.get("journal_key", "procfit"))
+
+
+def _shard(rank: int, world: int, n: int):
+    lo = rank * n // world
+    hi = (rank + 1) * n // world
+    return lo, hi
+
+
+# -- worker side --------------------------------------------------------------
+
+
+def worker_fit(ctx) -> Dict[str, Any]:
+    """Per-member fit entry, invoked by ``procgroup.worker_main`` inside a
+    formed epoch (rendezvous done, socket group live, distributed client
+    already released). Returns a small JSON-safe summary; the model rides
+    the filesystem (rank 0 writes ``model.txt``), trees ride the shared
+    journal."""
+    import jax
+
+    from mmlspark_tpu.lightgbm.train import (
+        _make_tree_contrib,
+        _pack_booster,
+        train,
+    )
+    from mmlspark_tpu.observability import TaskRecovered, get_bus
+    from mmlspark_tpu.runtime.journal import FitJournal
+    from mmlspark_tpu.runtime.procgroup import GroupRevokedError
+
+    payload = ctx.payload
+    opts = options_from_payload(payload["options"])
+    data = np.load(payload["dataset"])
+    with open(payload["mapper"], "rb") as fh:
+        mapper = pickle.load(fh)
+    bins, y = data["bins"], data["y"]
+    w = data["w"] if "w" in data.files else None
+    n = int(y.shape[0])
+    lo, hi = _shard(ctx.rank, ctx.world, n)
+    bins_l = np.ascontiguousarray(bins[lo:hi])
+    y_l = np.ascontiguousarray(y[lo:hi])
+    w_l = None if w is None else np.ascontiguousarray(w[lo:hi])
+
+    init_score = np.asarray(payload["init_score"], np.float32)
+    num_classes = int(init_score.shape[0])
+    total_iters = int(opts.num_iterations)
+
+    journal = FitJournal(
+        payload["journal_root"], key=_journal_key(payload),
+        num_tasks=total_iters,
+    )
+    restored = journal.restore()
+    trees: List[Any] = []
+    while len(trees) in restored:  # contiguous committed prefix only
+        trees.append(restored[len(trees)])
+    k = len(trees)
+    bus = get_bus()
+    if k and ctx.rank == 0 and bus.active:
+        # the scheduler's checkpoint-recovery event, one per iteration
+        # that will NOT re-execute
+        for it in range(k):
+            bus.publish(TaskRecovered(job_id=0, task_id=it))
+    if k:
+        logger.info("member %d resuming at iteration %d/%d (epoch %d)",
+                    ctx.member, k, total_iters, ctx.epoch)
+
+    # margins = global init score + the committed trees applied to the
+    # LOCAL shard (trees are membership-independent, so this works for any
+    # re-formed world size)
+    margins = np.broadcast_to(
+        init_score[None, :], (y_l.shape[0], num_classes)
+    ).astype(np.float32).copy()
+    if k:
+        contrib = _make_tree_contrib(opts.routing_steps)
+        bins_dev = np.asarray(bins_l, dtype=np.int32)
+        for tr in trees:
+            margins = margins + np.asarray(contrib(
+                bins_dev, tr.feat, tr.bin, tr.left, tr.right, tr.is_leaf,
+                tr.leaf_val, tr.cat_node, tr.cat_mask,
+            ))
+
+    state = {"it": k}
+
+    def hist_reduce(h):
+        # first collective of iteration `it`: the designated death point
+        # for kill_process chaos — peers are already blocked in this same
+        # allreduce when the victim goes down
+        ctx.maybe_die(state["it"])
+        return ctx.allreduce(h)
+
+    def hook(it, tree):
+        tree_np = jax.tree.map(
+            lambda a: None if a is None else np.asarray(a), tree,
+            is_leaf=lambda a: a is None,
+        )
+        if ctx.rank == 0:
+            journal.record(it, tree_np)
+        trees.append(tree_np)
+        state["it"] = it + 1
+
+    try:
+        train(
+            bins_l, y_l, opts, w=w_l, init_margins=margins, mapper=mapper,
+            feature_names=payload.get("feature_names"),
+            hist_reduce=hist_reduce if ctx.world > 1 else None,
+            iteration_hook=hook, start_iteration=k,
+        )
+    except GroupRevokedError:
+        raise
+    except Exception as e:
+        if ctx.group is not None and ctx.group.revoked:
+            # the allreduce died inside jit; jax re-raises it as
+            # XlaRuntimeError — translate back to the gang-protocol signal
+            raise GroupRevokedError(
+                f"collective failed at iteration {state['it']}: {e}"
+            ) from e
+        raise
+
+    result: Dict[str, Any] = {
+        "iterations": len(trees), "recovered": k, "rank": ctx.rank,
+        "world": ctx.world, "rows": int(y_l.shape[0]),
+        "journal_appended": journal.appended,
+    }
+    if ctx.rank == 0:
+        booster = _pack_booster(
+            trees, None, opts, num_classes, init_score, mapper,
+            payload.get("feature_names"),
+        )
+        model_path = Path(ctx.workdir) / "model.txt"
+        model_path.write_text(booster.model_to_string())
+        result["model_path"] = str(model_path)
+    journal.close()
+    return result
+
+
+# -- driver side --------------------------------------------------------------
+
+
+def fit_process_group(
+    X: Optional[np.ndarray],
+    y: np.ndarray,
+    opts,
+    w: Optional[np.ndarray] = None,
+    num_processes: int = 2,
+    workdir: Optional[str] = None,
+    feature_names: Optional[List[str]] = None,
+    bins: Optional[np.ndarray] = None,
+    mapper=None,
+    journal_root: Optional[str] = None,
+    journal_key: str = "procfit",
+    group_options: Optional[Dict[str, Any]] = None,
+) -> ProcessFitResult:
+    """Fit a booster across ``num_processes`` real worker processes.
+
+    Pass raw ``X`` (binned here, once, on the driver) or pre-binned
+    ``bins`` + ``mapper`` (the ``LightGBMBase._bin_dataset`` output — its
+    binning journal still applies). The fit itself is delegated to a
+    :class:`~mmlspark_tpu.runtime.procgroup.ProcessGroup`; a member
+    SIGKILL'd mid-fit surfaces here only as ``ProcessLost``/
+    ``GroupReformed`` events and a higher ``epochs`` count — the returned
+    model is the same either way, resumed from the shared journal with no
+    committed iteration re-executed.
+    """
+    from mmlspark_tpu.lightgbm.booster import Booster
+    from mmlspark_tpu.lightgbm.objectives import get_objective
+    from mmlspark_tpu.runtime.procgroup import ProcessGroup
+
+    validate_process_options(opts)
+    if bins is None:
+        if X is None:
+            raise ValueError("pass either X or pre-binned bins + mapper")
+        from mmlspark_tpu.lightgbm.binning import bin_dataset
+
+        bins, mapper = bin_dataset(
+            X, max_bin=opts.max_bin, mapper=mapper,
+            categorical_features=list(opts.categorical_slots) or None,
+        )
+    elif mapper is None:
+        raise ValueError("pre-binned input requires its BinMapper")
+    n = int(np.asarray(y).shape[0])
+    if n < num_processes:
+        raise ValueError(f"{n} rows cannot shard over {num_processes} processes")
+
+    objective = get_objective(opts.objective)
+    num_classes = objective.num_outputs_fn(opts.num_class)
+    y_np = np.asarray(y, dtype=np.float32)
+    w_np = None if w is None else np.asarray(w, dtype=np.float32)
+    if opts.boost_from_average:
+        init_score = objective.init_score(
+            y_np, num_classes,
+            np.ones(n, np.float32) if w_np is None else w_np,
+        )
+    else:
+        init_score = np.zeros(num_classes, dtype=np.float32)
+
+    if workdir is None:
+        import tempfile
+
+        workdir = tempfile.mkdtemp(prefix="mmlspark-tpu-procfit-")
+    wd = Path(workdir)
+    wd.mkdir(parents=True, exist_ok=True)
+    dataset_path = wd / "dataset.npz"
+    arrays = {"bins": np.asarray(bins), "y": y_np}
+    if w_np is not None:
+        arrays["w"] = w_np
+    np.savez(dataset_path, **arrays)
+    mapper_path = wd / "mapper.pkl"
+    with open(mapper_path, "wb") as fh:
+        pickle.dump(mapper, fh, protocol=4)
+    if journal_root is None:
+        journal_root = str(wd / "journal")
+    # Pre-create the journal (meta.json) on the driver: worker ranks open
+    # the same journal concurrently, and only an already-settled meta file
+    # keeps their constructors read-only (no atomic-write race).
+    from mmlspark_tpu.runtime.journal import FitJournal
+
+    FitJournal(journal_root, key=journal_key,
+               num_tasks=int(opts.num_iterations)).close()
+
+    payload = {
+        "dataset": str(dataset_path),
+        "mapper": str(mapper_path),
+        "options": options_to_payload(opts),
+        "init_score": [float(v) for v in np.asarray(init_score).ravel()],
+        "feature_names": list(feature_names) if feature_names else None,
+        "journal_root": journal_root,
+        "journal_key": journal_key,
+    }
+    gkw = dict(group_options or {})
+    gkw.setdefault("seed", opts.seed)
+    pg = ProcessGroup(
+        num_processes, "mmlspark_tpu.lightgbm.procfit:worker_fit",
+        payload=payload, workdir=str(wd / "group"), rendezvous="jax", **gkw,
+    )
+    try:
+        worker_results = pg.run()
+    finally:
+        # losses booked during recovery + final statuses from shutdown
+        exit_statuses = pg.exit_statuses + pg.shutdown()
+
+    model_path = None
+    recovered = 0
+    iterations = 0
+    for res in worker_results.values():
+        if res and res.get("model_path"):
+            model_path = res["model_path"]
+        if res:
+            recovered = max(recovered, int(res.get("recovered", 0)))
+            iterations = max(iterations, int(res.get("iterations", 0)))
+    if model_path is None:
+        raise RuntimeError(
+            f"no member produced a model; results: {worker_results}"
+        )
+    model_text = Path(model_path).read_text()
+    booster = Booster.from_string(model_text)
+    # the text round-trip keeps only [min:max] per feature; restore the
+    # full bin edges so this booster re-serializes like an in-process fit
+    booster.bin_edges = None if mapper is None else mapper.edges
+    return ProcessFitResult(
+        booster=booster,
+        model_text=model_text,
+        iterations=iterations,
+        recovered_iterations=recovered,
+        epochs=pg.epoch + 1,
+        worker_results=worker_results,
+        exit_statuses=exit_statuses,
+    )

@@ -470,11 +470,21 @@ def _split_search(
     )
 
 
-def _hist_fn(opts: TrainOptions, mesh=None, u_spec=None):
+def _hist_fn(opts: TrainOptions, mesh=None, u_spec=None, hist_reduce=None):
     """Histogram builder honoring the tree_learner choice. Returns a
     callable producing (hist (k,F,B,3), totals (k,3)); ``feature_mask``
     (featureFraction) steers voting so reduced histograms are spent only
     on splittable features.
+
+    ``hist_reduce`` is the cross-PROCESS reduction hook (data-parallel
+    fit over OS processes, ``lightgbm/procfit.py``): a host callable
+    summing the local histogram across the worker gang — LightGBM's
+    socket ``Network::Allreduce`` at the same point in the algorithm. It
+    is injected via ``jax.pure_callback`` right after the local build, so
+    everything downstream (totals, split search, leaf values) sees GLOBAL
+    statistics and every member grows byte-identical trees. The histogram
+    is the only tensor that crosses processes; its shape is row-count
+    independent, so members with different shard sizes stay aligned.
 
     When ``u_spec`` is set and the caller passes the fit-resident ``u``
     one-hot (``ops/u_histogram.py``), passes whose panel fits one lane
@@ -520,6 +530,14 @@ def _hist_fn(opts: TrainOptions, mesh=None, u_spec=None):
             h = build_histograms(
                 bins, grad, hess, count, node, num_nodes, num_bins,
                 method=method, chunk_rows=(mesh is None),
+            )
+        if hist_reduce is not None:
+            # host round-trip per histogram pass; "expand_dims" keeps one
+            # callback call under the per-class vmap so gang members make
+            # identical, aligned allreduce sequences
+            h = jax.pure_callback(
+                hist_reduce, jax.ShapeDtypeStruct(h.shape, h.dtype), h,
+                vmap_method="expand_dims",
             )
         return h, h[:, 0, :, :].sum(axis=1)  # feature 0 covers all rows
 
@@ -1013,12 +1031,12 @@ def _tree_stats(grad, hess, count, qkey=None):
 
 def _make_step(
     opts: TrainOptions, objective: Objective, num_bins: int, mesh=None,
-    n_real: Optional[int] = None, u_spec=None,
+    n_real: Optional[int] = None, u_spec=None, hist_reduce=None,
 ):
     build = (
         _build_tree_leafwise if opts.growth == "leafwise" else _build_tree_depthwise
     )
-    histf = _hist_fn(opts, mesh, u_spec)
+    histf = _hist_fn(opts, mesh, u_spec, hist_reduce=hist_reduce)
     obj_kwargs = {
         "num_classes": opts.num_class,
         "alpha": opts.alpha,
@@ -1320,12 +1338,31 @@ def train(
     mesh: Optional[Any] = None,
     feature_names: Optional[List[str]] = None,
     callbacks: Optional[Sequence[Any]] = None,
+    hist_reduce: Optional[Any] = None,
+    iteration_hook: Optional[Any] = None,
+    start_iteration: int = 0,
 ) -> TrainResult:
     """Run boosting. ``valid_sets`` entries are (name, bins_v, y_v, w_v).
 
     ``callbacks`` are :class:`~mmlspark_tpu.lightgbm.callbacks.TrainingCallback`
     delegates (``LightGBMDelegate.scala`` analogue): LR schedules ride the
-    scan fast path; per-iteration hooks run on the loop path."""
+    scan fast path; per-iteration hooks run on the loop path.
+
+    ``hist_reduce`` is the process-parallel histogram allreduce hook (see
+    :func:`_hist_fn`); ``iteration_hook(it, tree)`` fires after each
+    committed iteration on the loop path with the retained
+    :class:`TreeArrays` — the journal-commit point for
+    ``lightgbm/procfit.py``. Either forces the loop path (per-iteration
+    host control is the point) and bypasses the shared program cache
+    (the hook closures are fit-specific).
+
+    ``start_iteration`` resumes a journaled fit at iteration k: the first
+    k bagging/feature-mask draws are consumed WITHOUT running (the rng
+    stream stays aligned with an uninterrupted fit — the property model
+    parity after gang recovery rests on) and boosting begins at absolute
+    iteration k against the caller-rebuilt ``init_margins``. The returned
+    booster then contains only the new trees; a resuming caller packs
+    restored + new trees itself via :func:`_pack_booster`."""
     # Boosting-type contracts (matching native LightGBM's own errors):
     if opts.boosting_type == "rf":
         if not (opts.bagging_fraction < 1.0 and opts.bagging_freq > 0):
@@ -1583,13 +1620,22 @@ def train(
     okey = (_opts_key(opts), num_bins, mesh, u_spec, objective.cache_token)
     if opts.boosting_type == "goss":
         okey = okey + (n,)  # GOSS bakes the unpadded row count into the program
-    step_raw = _cached_program(
-        ("step_raw", okey),
-        lambda: _make_step(opts, objective, num_bins, mesh, n_real=n, u_spec=u_spec),
-    )
-    step = _cached_program(
-        ("step_jit", okey), lambda: jax.jit(step_raw, donate_argnums=(3,))
-    )
+    if hist_reduce is not None:
+        # the reduce hook closes over a live socket group — never share a
+        # compiled program holding it across fits
+        step_raw = _make_step(
+            opts, objective, num_bins, mesh, n_real=n, u_spec=u_spec,
+            hist_reduce=hist_reduce,
+        )
+        step = jax.jit(step_raw, donate_argnums=(3,))
+    else:
+        step_raw = _cached_program(
+            ("step_raw", okey),
+            lambda: _make_step(opts, objective, num_bins, mesh, n_real=n, u_spec=u_spec),
+        )
+        step = _cached_program(
+            ("step_jit", okey), lambda: jax.jit(step_raw, donate_argnums=(3,))
+        )
     u_builder = None
     if u_spec is not None:
         from mmlspark_tpu.ops.u_histogram import build_u
@@ -1691,6 +1737,9 @@ def train(
         and opts.num_iterations > 0
         and opts.boosting_type != "dart"  # dart drops trees per host decision
         and not opts.provide_training_metric  # needs per-iteration margins
+        and hist_reduce is None  # process fits need per-iteration control
+        and iteration_hook is None
+        and start_iteration == 0
     ):
         bag_list, fm_list = [], []
         for bag_np, _, fm_np in schedule:
@@ -1773,7 +1822,19 @@ def train(
                 tr.leaf_val, tr.cat_node, tr.cat_mask,
             )
 
+        pending_bag = None
         for it, (bag_np, bag_changed, fm_np) in enumerate(schedule):
+            if it < start_iteration:
+                # journal resume: consume the draw (rng stream stays
+                # aligned with an uninterrupted fit) without boosting
+                if bag_changed:
+                    pending_bag = bag_np
+                continue
+            if pending_bag is not None:
+                # the last skipped resample is the mask in force at k
+                if not bag_changed:
+                    bag_np, bag_changed = pending_bag, True
+                pending_bag = None
             if bag_changed:
                 bag_dev = put_rows(bag_np)
             fm_dev = put_rep(fm_np) if fm_np is not None else fm_ones_dev
@@ -1840,6 +1901,10 @@ def train(
             jax.block_until_ready(margins)
             # drop row_leaf, a (C, N) buffer per tree, before retaining
             trees.append(tree._replace(row_leaf=None))
+            if iteration_hook is not None:
+                # the commit point: the iteration's tree is final and its
+                # margins applied — procfit journals it here
+                iteration_hook(it, trees[-1])
 
             if opts.provide_training_metric:
                 # isProvideTrainingMetric: train-set metric per iteration
@@ -1913,6 +1978,30 @@ def train(
         finally:
             root_logger.setLevel(prev_level)
 
+    booster = _pack_booster(
+        trees, stacked_trees, opts, num_classes, init_score, mapper,
+        feature_names,
+        best_iteration=best_iter
+        if (valid_state and opts.early_stopping_round > 0) else -1,
+    )
+    return TrainResult(booster=booster, evals=evals, best_iteration=best_iter)
+
+
+def _pack_booster(
+    trees: Optional[List[TreeArrays]],
+    stacked_trees: Optional[TreeArrays],
+    opts: TrainOptions,
+    num_classes: int,
+    init_score: np.ndarray,
+    mapper: Optional[BinMapper],
+    feature_names: Optional[List[str]] = None,
+    best_iteration: int = -1,
+) -> Booster:
+    """Pack per-tree arrays into one :class:`Booster` — train()'s tail,
+    factored so the process-parallel fit (``procfit.py``) can rebuild the
+    identical booster from journal-restored trees. Accepts either a list
+    of per-iteration :class:`TreeArrays` (loop path / journal restore) or
+    a scan-stacked TreeArrays pytree."""
     t = opts.num_iterations if stacked_trees is not None else len(trees)
     m = opts.num_nodes
 
@@ -1960,7 +2049,7 @@ def train(
     if opts.boosting_type == "rf":
         # random-forest mode predicts the AVERAGE of the trees
         leaf_values = leaf_values / max(1, t)
-    booster = Booster(
+    return Booster(
         split_feature=stack("feat", np.int32),
         split_bin=stack("bin", np.int32),
         split_threshold=stack("thr", np.float32),
@@ -1974,7 +2063,7 @@ def train(
         num_classes=num_classes,
         objective=opts.objective,
         max_depth=_realized_depth(left, right, is_leaf, opts.routing_steps),
-        best_iteration=best_iter if (valid_state and opts.early_stopping_round > 0) else -1,
+        best_iteration=best_iteration,
         feature_names=feature_names,
         bin_edges=None if mapper is None else mapper.edges,
         cat_nodes=cat_nodes_np,
@@ -1984,7 +2073,6 @@ def train(
             else {int(j): np.asarray(v) for j, v in mapper.cat_values.items()}
         ),
     )
-    return TrainResult(booster=booster, evals=evals, best_iteration=best_iter)
 
 
 def _realized_depth(left, right, is_leaf, bound: int) -> int:

@@ -35,7 +35,10 @@ from mmlspark_tpu.observability.events import (
     Event,
     EventBus,
     EventLogSink,
+    GroupReformed,
     ModelCommitted,
+    ProcessLost,
+    ProcessStarted,
     RequestServed,
     RequestShed,
     StageCompleted,
@@ -70,9 +73,12 @@ __all__ = [
     "EventBus",
     "EventLogSink",
     "Gauge",
+    "GroupReformed",
     "Histogram",
     "MetricsRegistry",
     "ModelCommitted",
+    "ProcessLost",
+    "ProcessStarted",
     "RequestServed",
     "RequestShed",
     "Span",

@@ -165,6 +165,42 @@ class WorkerParoled(Event):
     worker: int
 
 
+# -- process group -----------------------------------------------------------
+
+
+@_event
+class ProcessStarted(Event):
+    """The process-group supervisor spawned (or respawned) a member
+    process for gang ``epoch`` (executor registration in the driver's
+    worker-list rendezvous)."""
+
+    member: int
+    pid: int
+    epoch: int
+
+
+@_event
+class ProcessLost(Event):
+    """A member process died or went silent mid-epoch; ``reason`` is
+    ``"exit:<code>"``, ``"signal:<sig>"`` or ``"heartbeat"`` (executor
+    lost, the SparkListenerExecutorRemoved analogue)."""
+
+    member: int
+    pid: int
+    reason: str
+    epoch: int
+
+
+@_event
+class GroupReformed(Event):
+    """Gang recovery completed: the group re-rendezvoused for ``epoch``
+    with ``members`` live processes after losing ``lost``."""
+
+    epoch: int
+    members: int
+    lost: int
+
+
 # -- serving -----------------------------------------------------------------
 
 
@@ -362,6 +398,8 @@ def timeline(events: Iterable[Event]) -> Dict[str, Any]:
     attempts: Dict[int, List[Dict[str, Any]]] = {}
     quarantines: Dict[int, int] = {}
     paroles = 0
+    processes = {"started": 0, "lost": 0, "reformed": 0}
+    loss_reasons: Dict[str, int] = {}
     batches = {"count": 0, "rows": 0}
     latencies: List[float] = []
     statuses: Dict[int, int] = {}
@@ -403,6 +441,13 @@ def timeline(events: Iterable[Event]) -> Dict[str, Any]:
             quarantines[ev.worker] = quarantines.get(ev.worker, 0) + 1
         elif isinstance(ev, WorkerParoled):
             paroles += 1
+        elif isinstance(ev, ProcessStarted):
+            processes["started"] += 1
+        elif isinstance(ev, ProcessLost):
+            processes["lost"] += 1
+            loss_reasons[ev.reason] = loss_reasons.get(ev.reason, 0) + 1
+        elif isinstance(ev, GroupReformed):
+            processes["reformed"] += 1
         elif isinstance(ev, BatchFormed):
             batches["count"] += 1
             batches["rows"] += ev.size
@@ -431,6 +476,7 @@ def timeline(events: Iterable[Event]) -> Dict[str, Any]:
         "breaker_trips": breaker_trips,
         "quarantines": quarantines,
         "paroles": paroles,
+        "processes": dict(processes, loss_reasons=loss_reasons),
     }
 
 
@@ -463,6 +509,18 @@ def format_timeline(summary: Dict[str, Any]) -> str:
                 + (" PERMANENT" if a.get("permanent") else "")
             )
         lines.append(f"   task {task_id}: " + "; ".join(parts))
+    procs = summary.get("processes") or {}
+    if procs.get("started") or procs.get("lost"):
+        line = (
+            f"== processes == started={procs.get('started', 0)} "
+            f"lost={procs.get('lost', 0)} reformed={procs.get('reformed', 0)}"
+        )
+        reasons = procs.get("loss_reasons") or {}
+        if reasons:
+            line += " (" + ", ".join(
+                f"{reason} x{n}" for reason, n in sorted(reasons.items())
+            ) + ")"
+        lines.append(line)
     quarantines = summary.get("quarantines") or {}
     if quarantines:
         lines.append("== quarantine == " + ", ".join(

@@ -155,6 +155,7 @@ def distributed_init(
     process_id: Optional[int] = None,
     executor_ids: Optional[Sequence[str]] = None,
     local_executor_id: Optional[str] = None,
+    initialization_timeout: Optional[float] = None,
 ) -> Topology:
     """Multi-host bootstrap — the surviving driver-rendezvous role.
 
@@ -171,8 +172,17 @@ def distributed_init(
       executor's rank in the list (deterministic across hosts, no extra
       coordination round).
 
-    No-ops (returning the current topology) when the process group is
-    already initialized or when running single-process.
+    No-ops (returning the current topology) when running single-process,
+    or when the process group is already initialized AND no explicit
+    rendezvous was requested. An explicit multi-process rendezvous while a
+    prior client is still up (a worker re-forming its gang after a member
+    died) first tears the old client down via
+    :func:`distributed_shutdown` — silently keeping the stale group would
+    rendezvous iteration state against a dead membership.
+
+    ``initialization_timeout`` (seconds) bounds how long the rendezvous
+    waits for stragglers; a gang member that never shows up surfaces as an
+    exception here instead of a five-minute default hang.
     """
     import jax
 
@@ -187,9 +197,7 @@ def distributed_init(
         num_processes = len(ordered)
         process_id = ordered.index(str(local_executor_id))
 
-    already = getattr(jax.distributed, "global_state", None)
-    already_up = already is not None and getattr(already, "client", None) is not None
-    if not already_up and num_processes is not None and num_processes > 1:
+    if num_processes is not None and num_processes > 1:
         if coordinator_address is None:
             raise ValueError(
                 f"{num_processes} processes derived but no coordinator_address "
@@ -201,12 +209,91 @@ def distributed_init(
                 f"{num_processes} processes requested but no process_id — "
                 "pass it explicitly or use the executor_ids convention"
             )
+        already = getattr(jax.distributed, "global_state", None)
+        if already is not None and getattr(already, "client", None) is not None:
+            # Re-initialization (second gang epoch in one process): the old
+            # client must go down before a new rendezvous can form. The old
+            # behavior — no-opping on global_state — left the process wired
+            # to a dead coordinator.
+            distributed_shutdown()
+        kwargs = {}
+        if initialization_timeout is not None:
+            kwargs["initialization_timeout"] = int(max(1, initialization_timeout))
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
             num_processes=num_processes,
             process_id=process_id,
+            **kwargs,
         )
     return get_topology()
+
+
+def distributed_shutdown(timeout_s: float = 5.0, clear_backends: bool = False) -> bool:
+    """Tear down this process's ``jax.distributed`` client/service so a new
+    group can form (the gang-recovery teardown half of
+    :func:`distributed_init`).
+
+    The clean path is :func:`jax.distributed.shutdown`; it can block
+    indefinitely when the coordinator died first, so it runs on a reaper
+    thread bounded by ``timeout_s`` and on overrun the global state is
+    force-cleared — the orphaned client leaks, but the process regains the
+    ability to rendezvous, which is the property gang recovery needs.
+
+    ``clear_backends=True`` additionally drops already-initialized XLA
+    backends and compiled caches (the :func:`force_platform` teardown):
+    required before re-initializing, because a backend created under the
+    old group bakes in its process count/device topology. Returns True on
+    a clean shutdown, False when state had to be force-cleared.
+    """
+    import threading
+
+    import jax
+    from jax._src import distributed as _dist
+    from jax._src import xla_bridge
+
+    state = getattr(_dist, "global_state", None)
+    clean = True
+    if state is not None and (
+        getattr(state, "client", None) is not None
+        or getattr(state, "service", None) is not None
+    ):
+        done = threading.Event()
+
+        def _shutdown():
+            try:
+                jax.distributed.shutdown()
+            except Exception:  # noqa: BLE001 - a dead coordinator is expected here
+                pass
+            finally:
+                done.set()
+
+        t = threading.Thread(
+            target=_shutdown, name="mmlspark-tpu-dist-shutdown", daemon=True
+        )
+        t.start()
+        if not done.wait(timeout_s):
+            clean = False
+        if getattr(state, "client", None) is not None or not clean:
+            # force-clear whatever the (possibly wedged) clean path left
+            for attr, value in (
+                ("client", None), ("service", None),
+                ("preemption_sync_manager", None),
+                ("process_id", 0), ("num_processes", 0),
+                ("coordinator_address", None),
+            ):
+                try:
+                    setattr(state, attr, value)
+                except AttributeError:
+                    pass
+    if clear_backends:
+        if getattr(xla_bridge, "_backends", None) and hasattr(
+            xla_bridge, "_clear_backends"
+        ):
+            xla_bridge._clear_backends()
+            if hasattr(xla_bridge.get_backend, "cache_clear"):
+                xla_bridge.get_backend.cache_clear()
+            jax.clear_caches()
+    return clean
 
 
 def partition_assignment(num_partitions: int, mesh) -> Dict[int, Tuple[int, ...]]:

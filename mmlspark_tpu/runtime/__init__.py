@@ -64,6 +64,17 @@ from mmlspark_tpu.runtime.journal import (
 )
 from mmlspark_tpu.runtime.lineage import Lineage, PartitionLostError, ShardLineage
 from mmlspark_tpu.runtime.metrics import RuntimeMetrics
+from mmlspark_tpu.runtime.procgroup import (
+    AllreduceGroup,
+    ExitStatus,
+    GangFailedError,
+    GroupRevokedError,
+    ProcessGroup,
+    WorkerContext,
+    pick_port,
+    scrub_env,
+    worker_main,
+)
 from mmlspark_tpu.runtime.scheduler import (
     AllWorkersQuarantinedError,
     AttemptInfo,
@@ -80,17 +91,22 @@ from mmlspark_tpu.runtime.scheduler import (
 
 __all__ = [
     "AllWorkersQuarantinedError",
+    "AllreduceGroup",
     "AttemptInfo",
     "CHECKPOINT_DIR_ENV",
     "ExecutorDeathError",
     "ExecutorPool",
+    "ExitStatus",
     "FaultPlan",
     "FitJournal",
+    "GangFailedError",
+    "GroupRevokedError",
     "HealthTracker",
     "JobFailedError",
     "Lineage",
     "ModelStore",
     "PartitionLostError",
+    "ProcessGroup",
     "ResultCorruptedError",
     "RuntimeMetrics",
     "Scheduler",
@@ -98,11 +114,15 @@ __all__ = [
     "ShardLineage",
     "TaskLostError",
     "TaskState",
+    "WorkerContext",
     "current_faults",
     "current_policy",
     "default_checkpoint_dir",
     "inject_faults",
+    "pick_port",
     "policy",
     "result_crc",
     "run_partitioned",
+    "scrub_env",
+    "worker_main",
 ]

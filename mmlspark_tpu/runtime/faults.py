@@ -21,6 +21,15 @@ recovery sequence instead of racing a process killer:
   heartbeating and hangs until the scheduler declares it lost and
   re-dispatches (the classic network-partitioned worker).
 
+The process plane (``mmlspark_tpu.runtime.procgroup``) injects at OS
+granularity — these faults kill *real* processes, not worker threads:
+
+- ``kill_process(m)`` — member ``m`` of a supervised process group
+  SIGKILLs itself at a designated fit iteration. The directive is
+  serialized into the group's epoch spec and enacted worker-side, so the
+  death is a genuine ``SIGKILL`` with no Python cleanup; the driver marks
+  the fault fired when it observes the corpse.
+
 The request plane (``mmlspark_tpu.resilience``) injects at the HTTP
 boundary instead of the task boundary — the outbound clients consult the
 ambient plan before every wire call:
@@ -72,6 +81,9 @@ class FaultPlan:
         self._slow = {}
         self._corrupt = {}
         self._drop_beat = {}
+        #: [{member, iteration, epoch}] process-kill directives, serialized
+        #: into the process group's epoch spec and enacted worker-side
+        self._kill_process: List[dict] = []
         #: ordered HTTP fault directives, consumed first-match per request
         self._http: List[dict] = []
         self._http_seq = 0
@@ -119,6 +131,67 @@ class FaultPlan:
         """Seeded kill-one-executor: the victim index is drawn from the
         plan's RNG, so the chaos is reproducible."""
         return self.kill_task(int(self._rng.integers(num_tasks)), attempt)
+
+    def kill_process(
+        self, member: int, iteration: int = 0, epoch: int = 0
+    ) -> "FaultPlan":
+        """Member ``member`` of a supervised process group SIGKILLs itself
+        at the start of fit ``iteration`` during gang ``epoch`` — a real
+        OS-level death (no atexit, no socket shutdown handshake), the kind
+        quarantine/speculation/gang-recovery exist for. Enacted worker-side
+        via the serialized directive (:meth:`process_kill_directives`);
+        the supervisor marks it fired when it observes the death
+        (:meth:`mark_process_killed`)."""
+        self._kill_process.append({
+            "member": int(member), "iteration": int(iteration),
+            "epoch": int(epoch),
+        })
+        return self
+
+    def kill_random_process(
+        self, num_members: int, iteration: int = 0, epoch: int = 0
+    ) -> "FaultPlan":
+        """Seeded kill-one-process chaos, reproducible run to run."""
+        return self.kill_process(
+            int(self._rng.integers(num_members)), iteration, epoch
+        )
+
+    def process_kill_directives(self) -> List[dict]:
+        """JSON-serializable process-kill directives for the supervisor to
+        embed in the epoch spec it hands each worker."""
+        with self._lock:
+            return [dict(d) for d in self._kill_process]
+
+    def mark_process_killed(self, member: int) -> bool:
+        """Driver-side acknowledgement: the supervisor observed member
+        ``member`` die for a registered directive. Pops the first
+        directive for that member and books it in ``fired`` (kind
+        ``kill_process``, third field = the directive's epoch)."""
+        with self._lock:
+            for i, d in enumerate(self._kill_process):
+                if d["member"] == int(member):
+                    popped = self._kill_process.pop(i)
+                    break
+            else:
+                return False
+        self.fired.append(("kill_process", int(member), int(popped["epoch"])))
+        return True
+
+    @staticmethod
+    def should_die(
+        directives: List[dict], member: int, iteration: int, epoch: int
+    ) -> bool:
+        """Worker-side check against the directives shipped in the epoch
+        spec: True when this (member, iteration, epoch) is a designated
+        death point. Static so workers need no live FaultPlan object."""
+        for d in directives or []:
+            if (
+                int(d.get("member", -1)) == int(member)
+                and int(d.get("iteration", 0)) == int(iteration)
+                and int(d.get("epoch", 0)) == int(epoch)
+            ):
+                return True
+        return False
 
     def http_storm(
         self,
@@ -168,6 +241,7 @@ class FaultPlan:
             return (
                 len(self._kill) + len(self._delay) + len(self._drop_beat)
                 + len(self._slow) + len(self._corrupt)
+                + len(self._kill_process)
                 + sum(d["n"] for d in self._http)
             )
 

@@ -1,5 +1,6 @@
 """Model serving (reference Spark Serving, SURVEY.md §2.16)."""
 
+from mmlspark_tpu.serving.replicas import ReplicaSupervisor
 from mmlspark_tpu.serving.server import (
     DistributedServingServer,
     RegistrationService,
@@ -12,6 +13,7 @@ from mmlspark_tpu.serving.server import (
 __all__ = [
     "DistributedServingServer",
     "RegistrationService",
+    "ReplicaSupervisor",
     "ServiceInfo",
     "ServingServer",
     "recover_model",

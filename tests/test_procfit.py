@@ -1,0 +1,268 @@
+"""Process-parallel fit tests (``mmlspark_tpu.lightgbm.procfit``).
+
+Fast tests cover the option gate (shard-dependent semantics are rejected,
+not silently divergent), the TrainOptions JSON round-trip, and the
+distributed model-text comparator. The ``slow`` tests spawn REAL worker
+processes: 2-process histogram-allreduce fit with AUC and model-text
+parity against the single-process fit, and the tentpole chaos claim — a
+member SIGKILL'd mid-collective, the gang re-formed, and the fit resumed
+from the journal with ZERO re-execution of committed iterations
+(bitwise-identical final model, ``TaskRecovered`` per restored
+iteration).
+"""
+
+import dataclasses
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.lightgbm.procfit import (
+    model_texts_close,
+    options_from_payload,
+    options_to_payload,
+    validate_process_options,
+)
+from mmlspark_tpu.lightgbm.train import TrainOptions
+
+
+def _auc(scores, labels):
+    order = np.argsort(scores, kind="stable")
+    ranks = np.empty(len(scores))
+    ranks[order] = np.arange(1, len(scores) + 1)
+    pos = labels > 0
+    return (ranks[pos].sum() - pos.sum() * (pos.sum() + 1) / 2) / (
+        pos.sum() * (~pos).sum()
+    )
+
+
+def _toy(n=400, f=5, seed=5):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f)).astype(np.float32)
+    y = (X[:, 0] - X[:, 1] + rng.normal(scale=0.4, size=n) > 0).astype(
+        np.float32
+    )
+    return X, y
+
+
+class TestOptionGate:
+    def test_defaults_pass(self):
+        validate_process_options(TrainOptions(objective="binary"))
+
+    @pytest.mark.parametrize(
+        "kwargs,needle",
+        [
+            (dict(bagging_fraction=0.8, bagging_freq=1), "bagging"),
+            (dict(pos_bagging_fraction=0.5, bagging_freq=1), "bagging"),
+            (dict(boosting_type="goss"), "goss"),
+            (dict(boosting_type="dart"), "dart"),
+            (dict(objective="quantile"), "quantile"),
+            (dict(tree_learner="voting_parallel"), "voting_parallel"),
+            (dict(use_quantized_grad=True), "quantized"),
+            (dict(provide_training_metric=True), "training_metric"),
+            (dict(early_stopping_round=5), "early stopping"),
+        ],
+    )
+    def test_shard_dependent_options_rejected(self, kwargs, needle):
+        base = dict(objective="binary")
+        base.update(kwargs)
+        with pytest.raises(ValueError, match=needle):
+            validate_process_options(TrainOptions(**base))
+
+    def test_feature_fraction_allowed(self):
+        # feature draws depend only on the (global) schedule, never on
+        # local row counts — identical on every shard
+        validate_process_options(
+            TrainOptions(objective="binary", feature_fraction=0.7)
+        )
+
+
+class TestOptionsPayload:
+    def test_json_round_trip_restores_tuples(self):
+        opts = TrainOptions(
+            objective="multiclass", num_class=3, categorical_slots=(1, 3),
+            onehot_slots=(2,), num_iterations=7, seed=11,
+        )
+        import json
+
+        payload = json.loads(json.dumps(options_to_payload(opts)))
+        back = options_from_payload(payload)
+        assert back == opts
+        assert isinstance(back.categorical_slots, tuple)
+        assert isinstance(back.onehot_slots, tuple)
+
+
+class TestModelTextComparator:
+    HEADER = "tree\nversion=v3\nsplit_feature=0 1 2\n"
+
+    def test_identical(self):
+        a = self.HEADER + "leaf_value=0.5 0.25\n"
+        assert model_texts_close(a, a)
+
+    def test_float_jitter_ok_structure_not(self):
+        a = self.HEADER + "leaf_value=0.5 0.25\n"
+        b = self.HEADER + "leaf_value=0.50000001 0.25\n"
+        assert model_texts_close(a, b)
+        c = "tree\nversion=v3\nsplit_feature=0 2 1\nleaf_value=0.5 0.25\n"
+        assert not model_texts_close(a, c)
+
+    def test_tree_sizes_exempt_but_counted(self):
+        a = self.HEADER + "tree_sizes=100 200\n"
+        b = self.HEADER + "tree_sizes=101 199\n"
+        c = self.HEADER + "tree_sizes=100\n"
+        assert model_texts_close(a, b)
+        assert not model_texts_close(a, c)
+
+    def test_large_float_divergence_fails(self):
+        a = self.HEADER + "leaf_value=0.5 0.25\n"
+        b = self.HEADER + "leaf_value=0.9 0.25\n"
+        assert not model_texts_close(a, b)
+
+
+@pytest.mark.slow
+class TestProcessFitLive:
+    def _reference(self, X, y, opts):
+        from mmlspark_tpu.lightgbm.binning import bin_dataset
+        from mmlspark_tpu.lightgbm.train import train
+
+        bins, mapper = bin_dataset(X, max_bin=opts.max_bin)
+        return train(bins, y, opts, mapper=mapper)
+
+    def test_two_process_parity(self):
+        from mmlspark_tpu.lightgbm.procfit import fit_process_group
+
+        X, y = _toy()
+        opts = TrainOptions(
+            objective="binary", num_iterations=6, num_leaves=7,
+            max_bin=32, min_data_in_leaf=5, seed=2,
+        )
+        ref = self._reference(X, y, opts)
+        ref_text = ref.booster.model_to_string()
+        result = fit_process_group(
+            X, y, opts, num_processes=2,
+            group_options={"epoch_timeout_s": 180.0},
+        )
+        assert result.epochs == 1
+        assert result.recovered_iterations == 0
+        assert result.iterations == 6
+        # structure byte-identical; float cells within shard-sum tolerance
+        assert model_texts_close(result.model_text, ref_text)
+        auc_ref = _auc(ref.booster.raw_margin(X).ravel(), y)
+        auc_proc = _auc(result.booster.raw_margin(X).ravel(), y)
+        assert abs(auc_ref - auc_proc) < 1e-6, (auc_ref, auc_proc)
+
+    def test_sigkill_mid_fit_resumes_with_zero_reexecution(self, tmp_path):
+        from mmlspark_tpu import observability as obs
+        from mmlspark_tpu.lightgbm.procfit import fit_process_group
+        from mmlspark_tpu.runtime.faults import FaultPlan
+
+        event_log = str(tmp_path / "events.jsonl")
+        os.environ["MMLSPARK_TPU_EVENT_LOG"] = event_log
+        try:
+            X, y = _toy()
+            opts = TrainOptions(
+                objective="binary", num_iterations=6, num_leaves=7,
+                max_bin=32, min_data_in_leaf=5, seed=2,
+            )
+            baseline = fit_process_group(
+                X, y, opts, num_processes=2,
+                group_options={"epoch_timeout_s": 180.0},
+            )
+            kill_at = 3
+            plan = FaultPlan(seed=11).kill_process(1, iteration=kill_at)
+            result = fit_process_group(
+                X, y, opts, num_processes=2,
+                group_options={"faults": plan, "epoch_timeout_s": 180.0},
+            )
+        finally:
+            del os.environ["MMLSPARK_TPU_EVENT_LOG"]
+
+        # the recovered fit IS the undisturbed fit, bit for bit
+        assert result.model_text == baseline.model_text
+        assert result.epochs == 2
+        assert result.recovered_iterations == kill_at
+        assert plan.fired == [("kill_process", 1, 0)]
+        killed = [s for s in result.exit_statuses if s.reason == "signal:9"]
+        assert killed and killed[0].member == 1
+
+        events = obs.replay(event_log)
+        names = [type(e).__name__ for e in events]
+        assert names.count("ProcessLost") == 1
+        assert names.count("GroupReformed") == 1
+        # one TaskRecovered per committed iteration NOT re-executed
+        recovered = [e for e in events if type(e).__name__ == "TaskRecovered"]
+        assert sorted(e.task_id for e in recovered) == list(range(kill_at))
+
+    def test_two_deaths_quarantine_worker(self, tmp_path):
+        from mmlspark_tpu import observability as obs
+        from mmlspark_tpu.lightgbm.procfit import fit_process_group
+        from mmlspark_tpu.runtime.faults import FaultPlan
+
+        event_log = str(tmp_path / "events.jsonl")
+        os.environ["MMLSPARK_TPU_EVENT_LOG"] = event_log
+        try:
+            X, y = _toy()
+            opts = TrainOptions(
+                objective="binary", num_iterations=6, num_leaves=7,
+                max_bin=32, min_data_in_leaf=5, seed=2,
+            )
+            baseline = fit_process_group(
+                X, y, opts, num_processes=2,
+                group_options={"epoch_timeout_s": 180.0},
+            )
+            # kill member 1 twice: second death quarantines it, and the
+            # gang SHRINKS to one member that still finishes the fit
+            plan = (
+                FaultPlan(seed=12)
+                .kill_process(1, iteration=2)
+                .kill_process(1, iteration=4, epoch=1)
+            )
+            result = fit_process_group(
+                X, y, opts, num_processes=2,
+                group_options={"faults": plan, "epoch_timeout_s": 180.0},
+            )
+        finally:
+            del os.environ["MMLSPARK_TPU_EVENT_LOG"]
+
+        # after the shrink the survivor holds ALL rows, so its tail-tree
+        # histogram sums are single-shard — structure-identical to the
+        # baseline but not bitwise (same reason 2-proc vs 1-proc isn't)
+        assert model_texts_close(result.model_text, baseline.model_text)
+        assert result.epochs == 3
+        assert len([s for s in result.exit_statuses
+                    if s.reason == "signal:9"]) == 2
+        events = obs.replay(event_log)
+        names = [type(e).__name__ for e in events]
+        assert names.count("WorkerQuarantined") == 1
+        assert names.count("GroupReformed") == 2
+
+    def test_estimator_num_processes(self):
+        from mmlspark_tpu.data.table import Table
+        from mmlspark_tpu.lightgbm.classifier import LightGBMClassifier
+
+        X, y = _toy()
+        t = Table({"features": X.astype(np.float64), "label": y.astype(np.float64)})
+        kwargs = dict(numIterations=6, numLeaves=7, seed=2)
+        m_ref = LightGBMClassifier(**kwargs).fit(t)
+        est = LightGBMClassifier(numProcesses=2, **kwargs)
+        m_proc = est.fit(t)
+        assert model_texts_close(
+            m_ref.get_model_string(), m_proc.get_model_string()
+        )
+        assert est._process_fit.epochs == 1
+        p_ref = np.asarray(m_ref.transform(t).column("prediction"))
+        p_proc = np.asarray(m_proc.transform(t).column("prediction"))
+        assert (p_ref == p_proc).all()
+
+    def test_estimator_rejects_bagging(self):
+        from mmlspark_tpu.data.table import Table
+        from mmlspark_tpu.lightgbm.classifier import LightGBMClassifier
+
+        X, y = _toy(n=80)
+        t = Table({"features": X.astype(np.float64), "label": y.astype(np.float64)})
+        est = LightGBMClassifier(
+            numProcesses=2, baggingFraction=0.8, baggingFreq=1, numIterations=2
+        )
+        with pytest.raises(ValueError, match="bagging"):
+            est.fit(t)
