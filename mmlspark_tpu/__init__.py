@@ -32,6 +32,9 @@ Subpackages mirror the reference's component inventory (SURVEY.md §2):
 - ``lime``      — model-agnostic interpretability
 - ``isolationforest`` — anomaly detection
 - ``io``        — HTTP-on-TPU client stack + low-latency serving
+- ``streaming`` — Structured-Streaming-analogue micro-batch engine:
+  offset-tracked sources, checkpointed exactly-once queries, incremental
+  warm-start fit sinks feeding zero-downtime model hot swap in serving
 - ``resilience`` — request-plane fault tolerance: circuit breakers,
   deadline propagation (``X-Deadline-Ms``), retry budgets, admission
   control shared by serving and every outbound HTTP caller

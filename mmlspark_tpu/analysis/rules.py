@@ -242,11 +242,14 @@ class LockDisciplineRule(Rule):
     description = (
         "No blocking call (thread join, sleep, queue get/put, network I/O) "
         "while holding a threading.Lock/RLock in runtime/, serving/, "
-        "observability/ or resilience/: the lock serializes every heartbeat, "
-        "reply, breaker-decision and metrics-scrape path behind the wait."
+        "streaming/, observability/ or resilience/: the lock serializes "
+        "every heartbeat, reply, epoch-commit, breaker-decision and "
+        "metrics-scrape path behind the wait."
     )
 
-    _PATH_PARTS = ("runtime", "serving", "observability", "resilience")
+    _PATH_PARTS = (
+        "runtime", "serving", "streaming", "observability", "resilience",
+    )
     _NETWORK_PREFIXES = (
         "urllib.request.urlopen", "urlopen", "requests.", "socket.",
         "http.client.",

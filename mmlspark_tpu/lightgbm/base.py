@@ -651,9 +651,28 @@ def _merge_boosters(boosters: List[Booster]) -> Booster:
         return boosters[0]
     first = boosters[0]
 
-    def cat(field):
+    def cat(field, pad=0):
         arrs = [getattr(b, field) for b in boosters]
-        return None if any(a is None for a in arrs) else np.concatenate(arrs)
+        if any(a is None for a in arrs):
+            return None
+        arrs = [np.asarray(a) for a in arrs]
+        # Pad trailing (node/bitmask) axes to the widest booster before
+        # stacking trees: a model-text round-trip shrinks node arrays to
+        # each tree's true width, so chained-fit boosters legitimately
+        # disagree on M. Dead slots are unreachable (child indices only
+        # point inside the original tree); is_leaf pads True so even an
+        # accidental visit terminates.
+        ndim = arrs[0].ndim
+        target = tuple(max(a.shape[d] for a in arrs) for d in range(1, ndim))
+        padded = []
+        for a in arrs:
+            widths = [(0, 0)] + [
+                (0, t - a.shape[d + 1]) for d, t in enumerate(target)
+            ]
+            if any(w for _, w in widths):
+                a = np.pad(a, widths, constant_values=pad)
+            padded.append(a)
+        return np.concatenate(padded)
 
     return Booster(
         split_feature=cat("split_feature"),
@@ -661,7 +680,7 @@ def _merge_boosters(boosters: List[Booster]) -> Booster:
         split_threshold=cat("split_threshold"),
         left_child=cat("left_child"),
         right_child=cat("right_child"),
-        is_leaf=cat("is_leaf"),
+        is_leaf=cat("is_leaf", pad=1),
         leaf_values=cat("leaf_values"),
         cover=cat("cover"),
         split_gain=cat("split_gain"),

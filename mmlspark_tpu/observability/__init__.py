@@ -37,12 +37,16 @@ from mmlspark_tpu.observability.events import (
     EventLogSink,
     GroupReformed,
     ModelCommitted,
+    ModelSwapped,
     ProcessLost,
     ProcessStarted,
     RequestServed,
     RequestShed,
     StageCompleted,
     StageStarted,
+    StreamEpochCommitted,
+    StreamEpochStarted,
+    StreamSourceAdvanced,
     TaskDispatched,
     TaskFailed,
     TaskRecovered,
@@ -77,6 +81,7 @@ __all__ = [
     "Histogram",
     "MetricsRegistry",
     "ModelCommitted",
+    "ModelSwapped",
     "ProcessLost",
     "ProcessStarted",
     "RequestServed",
@@ -84,6 +89,9 @@ __all__ = [
     "Span",
     "StageCompleted",
     "StageStarted",
+    "StreamEpochCommitted",
+    "StreamEpochStarted",
+    "StreamSourceAdvanced",
     "TaskDispatched",
     "TaskFailed",
     "TaskRecovered",

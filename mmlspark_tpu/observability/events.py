@@ -233,6 +233,53 @@ class ModelCommitted(Event):
     detail: str = ""
 
 
+# -- streaming ---------------------------------------------------------------
+
+
+@_event
+class StreamEpochStarted(Event):
+    """The micro-batch engine planned epoch ``epoch`` over source offsets
+    ``[start, end)`` and durably logged the plan (the offset-WAL write —
+    Spark's ``StreamingQueryListener.QueryProgressEvent`` start edge)."""
+
+    query: str
+    epoch: int
+    start: int
+    end: int
+
+
+@_event
+class StreamSourceAdvanced(Event):
+    """A source exposed new offsets that epoch planning consumed;
+    ``units`` is the manifest length (files / blocks in the batch)."""
+
+    query: str
+    start: int
+    end: int
+    units: int = 0
+
+
+@_event
+class StreamEpochCommitted(Event):
+    """Epoch ``epoch`` ran the sink and wrote its commit-log entry —
+    the exactly-once boundary; a restart never re-plans this epoch."""
+
+    query: str
+    epoch: int
+    rows: int
+    duration: float = 0.0
+
+
+@_event
+class ModelSwapped(Event):
+    """A serving listener hot-swapped its live model to ModelStore
+    version ``version`` between requests — zero downtime, no restart."""
+
+    name: str
+    version: int
+    server: str = ""
+
+
 # -- resilience --------------------------------------------------------------
 
 
@@ -406,6 +453,9 @@ def timeline(events: Iterable[Event]) -> Dict[str, Any]:
     models: List[str] = []
     shed = 0
     breaker_trips: Dict[str, int] = {}
+    streaming = {"epochs": 0, "rows": 0, "source_units": 0}
+    stream_epochs: Dict[str, List[int]] = {}
+    swaps: List[Dict[str, Any]] = []
     for ev in events:
         if isinstance(ev, StageStarted):
             stages.setdefault(
@@ -456,6 +506,15 @@ def timeline(events: Iterable[Event]) -> Dict[str, Any]:
             statuses[ev.status] = statuses.get(ev.status, 0) + 1
         elif isinstance(ev, ModelCommitted):
             models.append(ev.model)
+        elif isinstance(ev, StreamSourceAdvanced):
+            streaming["source_units"] += ev.units
+        elif isinstance(ev, StreamEpochCommitted):
+            streaming["epochs"] += 1
+            streaming["rows"] += ev.rows
+            stream_epochs.setdefault(ev.query, []).append(ev.epoch)
+        elif isinstance(ev, ModelSwapped):
+            swaps.append({"name": ev.name, "version": ev.version,
+                          "server": ev.server})
         elif isinstance(ev, RequestShed):
             shed += 1
         elif isinstance(ev, BreakerTripped):
@@ -473,6 +532,8 @@ def timeline(events: Iterable[Event]) -> Dict[str, Any]:
         "batches": batches,
         "requests": requests,
         "models": models,
+        "streaming": dict(streaming, queries=stream_epochs),
+        "swaps": swaps,
         "breaker_trips": breaker_trips,
         "quarantines": quarantines,
         "paroles": paroles,
@@ -526,6 +587,20 @@ def format_timeline(summary: Dict[str, Any]) -> str:
         lines.append("== quarantine == " + ", ".join(
             f"w{wid} x{n}" for wid, n in sorted(quarantines.items())
         ) + f" paroled={summary.get('paroles', 0)}")
+    streaming = summary.get("streaming") or {}
+    if streaming.get("epochs"):
+        line = (
+            f"== streaming == epochs={streaming['epochs']} "
+            f"rows={streaming['rows']} "
+            f"source_units={streaming.get('source_units', 0)}"
+        )
+        queries = streaming.get("queries") or {}
+        if queries:
+            line += " (" + ", ".join(
+                f"{q}: epochs {min(eps)}..{max(eps)}"
+                for q, eps in sorted(queries.items())
+            ) + ")"
+        lines.append(line)
     b, r = summary["batches"], summary["requests"]
     lines.append(f"== serving == batches={b['count']} rows={b['rows']} "
                  f"requests={r['count']} shed={r.get('shed', 0)}")
@@ -541,4 +616,11 @@ def format_timeline(summary: Dict[str, Any]) -> str:
         )
     if summary["models"]:
         lines.append("== models == " + ", ".join(summary["models"]))
+    swaps = summary.get("swaps") or []
+    if swaps:
+        lines.append("== swaps == " + ", ".join(
+            f"{s['name']} -> v{s['version']}"
+            + (f" @{s['server']}" if s.get("server") else "")
+            for s in swaps
+        ))
     return "\n".join(lines)

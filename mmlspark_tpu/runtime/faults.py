@@ -30,6 +30,15 @@ granularity — these faults kill *real* processes, not worker threads:
   death is a genuine ``SIGKILL`` with no Python cleanup; the driver marks
   the fault fired when it observes the corpse.
 
+The streaming plane (``mmlspark_tpu.streaming``) injects at epoch
+boundaries — the query consults the ambient plan at its two designated
+crash windows and SIGKILLs its own process:
+
+- ``kill_stream(epoch, point)`` — the query dies at ``point`` of epoch
+  ``epoch``: ``"post_wal"`` (offsets logged, nothing processed) or
+  ``"pre_commit"`` (sink done, commit log missing — the window where only
+  idempotent epoch-keyed sinks keep delivery exactly-once).
+
 The request plane (``mmlspark_tpu.resilience``) injects at the HTTP
 boundary instead of the task boundary — the outbound clients consult the
 ambient plan before every wire call:
@@ -84,6 +93,9 @@ class FaultPlan:
         #: [{member, iteration, epoch}] process-kill directives, serialized
         #: into the process group's epoch spec and enacted worker-side
         self._kill_process: List[dict] = []
+        #: [{epoch, point}] streaming-query kill points, enacted in-process
+        #: by StreamingQuery._maybe_die as a real SIGKILL
+        self._kill_stream: List[dict] = []
         #: ordered HTTP fault directives, consumed first-match per request
         self._http: List[dict] = []
         self._http_seq = 0
@@ -177,6 +189,35 @@ class FaultPlan:
         self.fired.append(("kill_process", int(member), int(popped["epoch"])))
         return True
 
+    def kill_stream(self, epoch: int, point: str = "pre_commit") -> "FaultPlan":
+        """The streaming query SIGKILLs its own process at ``point`` of
+        epoch ``epoch`` — ``"post_wal"`` (plan durably logged, nothing
+        processed yet) or ``"pre_commit"`` (sink ran, commit log not yet
+        written: the nastiest window, where restart re-delivers the epoch
+        and only sink idempotence keeps it exactly-once)."""
+        if point not in ("post_wal", "pre_commit"):
+            raise ValueError(
+                f"unknown stream kill point {point!r} "
+                "(expected 'post_wal' or 'pre_commit')"
+            )
+        self._kill_stream.append({"epoch": int(epoch), "point": str(point)})
+        return self
+
+    def should_kill_stream(self, epoch: int, point: str) -> bool:
+        """Consulted by the streaming query at each designated crash
+        window. Pops the first matching directive and books it in
+        ``fired`` (kind ``kill_stream``); the caller then SIGKILLs
+        itself — this return value is its death warrant."""
+        with self._lock:
+            for i, d in enumerate(self._kill_stream):
+                if d["epoch"] == int(epoch) and d["point"] == str(point):
+                    self._kill_stream.pop(i)
+                    break
+            else:
+                return False
+        self.fired.append(("kill_stream", int(epoch), 0))
+        return True
+
     @staticmethod
     def should_die(
         directives: List[dict], member: int, iteration: int, epoch: int
@@ -241,7 +282,7 @@ class FaultPlan:
             return (
                 len(self._kill) + len(self._delay) + len(self._drop_beat)
                 + len(self._slow) + len(self._corrupt)
-                + len(self._kill_process)
+                + len(self._kill_process) + len(self._kill_stream)
                 + sum(d["n"] for d in self._http)
             )
 

@@ -308,6 +308,19 @@ class ModelStore:
             return None
         return data.decode("utf-8")
 
+    def current_version(self, name: str = "model") -> Optional[int]:
+        """The version the ``CURRENT`` pointer names, or None — one small
+        read, no model-text load or CRC verification, so a hot-swap
+        watcher can poll it cheaply between requests (verification
+        happens in :meth:`latest` when the watcher decides to load)."""
+        try:
+            with open(self._current_path(name), "r", encoding="utf-8") as fh:
+                cur = json.load(fh)
+            m = re.search(r"-(\d{6})\.txt$", str(cur["file"]))
+            return int(m.group(1)) if m else None
+        except (OSError, ValueError, KeyError):
+            return None
+
     def latest(self, name: str = "model") -> Optional[Tuple[int, str]]:
         """(version, text) of the last committed model, or None. CURRENT
         is trusted when its target verifies; otherwise scan versions

@@ -365,7 +365,7 @@ def _clear_backends() -> None:
     try:
         import jax
         from jax._src import xla_bridge
-    except Exception:  # pragma: no cover - jax-free unit-test workers
+    except Exception:  # noqa: BLE001 - pragma: no cover - jax-free unit-test workers
         return
     if getattr(xla_bridge, "_backends", None) and hasattr(
         xla_bridge, "_clear_backends"

@@ -43,7 +43,12 @@ import numpy as np
 
 from mmlspark_tpu.core.pipeline import Transformer
 from mmlspark_tpu.data.table import Table
-from mmlspark_tpu.observability.events import BatchFormed, RequestServed, get_bus
+from mmlspark_tpu.observability.events import (
+    BatchFormed,
+    ModelSwapped,
+    RequestServed,
+    get_bus,
+)
 from mmlspark_tpu.observability.registry import get_registry
 from mmlspark_tpu.observability.tracing import Span, get_tracer
 from mmlspark_tpu.resilience.admission import AdmissionController
@@ -85,11 +90,17 @@ class _PendingRequest:
 
 @dataclass
 class ServiceInfo:
-    """One worker endpoint (``HTTPSourceV2.scala:318-410`` ServiceInfo)."""
+    """One worker endpoint (``HTTPSourceV2.scala:318-410`` ServiceInfo).
+
+    ``model_version`` is lease metadata: the ModelStore version this
+    replica currently serves (None = untracked). Hot swaps and warm
+    restarts refresh it, so ``GET /services`` shows which version each
+    replica answers with."""
 
     name: str
     host: str
     port: int
+    model_version: Optional[int] = None
 
     @property
     def url(self) -> str:
@@ -436,6 +447,7 @@ class _ListenerMixin:
             "name": getattr(self, "name", "serving"),
             "uptime_seconds": round(now - self._started_at, 3),
             "model_epoch": loop._epoch,
+            "model_version": getattr(self, "model_version", None),
             "last_batch_age_seconds": (
                 round(now - last, 3) if last is not None else None
             ),
@@ -619,10 +631,97 @@ class ServingServer(_ListenerMixin):
         )
         self._httpd = _Server((host, port), self._make_handler(self.loop, input_col))
         self.info = ServiceInfo(name, host, self._httpd.server_address[1])
+        #: ModelStore version currently served (None = untracked); set by
+        #: warm_restart_server and advanced by the hot-swap watcher
+        self.model_version: Optional[int] = None
+        self._swap_stop: Optional[threading.Event] = None
+        self._swap_thread: Optional[threading.Thread] = None
 
     @property
     def model(self):
         return self.loop.model
+
+    # -- hot swap (live model replacement, zero downtime) --------------------
+
+    def enable_hot_swap(
+        self,
+        loader: Callable[[str], Any],
+        root: Optional[str] = None,
+        name: str = "model",
+        poll_s: float = 0.25,
+    ) -> "ServingServer":
+        """Watch the ModelStore ``CURRENT`` pointer under ``root`` and swap
+        the live model the moment a new version commits — between requests,
+        with no listener restart: the batch loop reads ``loop.model`` per
+        micro-batch, so one attribute assignment is the whole cutover.
+        Polling reads only the small CURRENT pointer
+        (:meth:`~mmlspark_tpu.runtime.journal.ModelStore.current_version`);
+        the model text is loaded and CRC-verified only when the version
+        actually moved. A version that fails to load is skipped (the old
+        model keeps serving) and retried next poll."""
+        import os as _os
+
+        from mmlspark_tpu.runtime.journal import ModelStore, default_checkpoint_dir
+
+        root = root or default_checkpoint_dir()
+        if root is None:
+            raise ValueError(
+                "hot swap needs a ModelStore root: pass root= or set "
+                "MMLSPARK_TPU_CHECKPOINT_DIR"
+            )
+        store = ModelStore(_os.path.join(root, "models"))
+        reg = self.loop.registry
+        swaps = reg.counter(
+            "serving_model_swaps_total", "Live model hot swaps"
+        ).labels(server=self.name)
+        version_g = reg.gauge(
+            "serving_model_version", "ModelStore version currently served"
+        ).labels(server=self.name)
+        if self.model_version is not None:
+            version_g.set(self.model_version)
+        stop = threading.Event()
+
+        def _watch() -> None:
+            while not stop.wait(poll_s):
+                try:
+                    v = store.current_version(name)
+                    if v is None or v == self.model_version:
+                        continue
+                    latest = store.latest(name)
+                    if latest is None:
+                        continue
+                    version, text = latest
+                    if version == self.model_version:
+                        continue
+                    model = loader(text)
+                except Exception as e:  # noqa: BLE001 - keep serving old model
+                    logger.warning(
+                        "hot swap of %r failed (%s: %s); keeping v%s",
+                        name, type(e).__name__, e, self.model_version,
+                    )
+                    continue
+                # single attribute store = the atomic cutover: in-flight
+                # batches finish on the old model, the next batch reads new
+                self.loop.model = model
+                self.model_version = version
+                self.info.model_version = version
+                swaps.inc()
+                version_g.set(version)
+                logger.info(
+                    "hot-swapped %r to v%06d on %s", name, version, self.name
+                )
+                bus = get_bus()
+                if bus.active:
+                    bus.publish(ModelSwapped(
+                        name=name, version=version, server=self.name,
+                    ))
+
+        self._swap_stop = stop
+        self._swap_thread = threading.Thread(
+            target=_watch, daemon=True, name=f"hot-swap-{self.name}"
+        )
+        self._swap_thread.start()
+        return self
 
     def start(self) -> "ServingServer":
         threading.Thread(target=self._httpd.serve_forever, daemon=True).start()
@@ -631,6 +730,11 @@ class ServingServer(_ListenerMixin):
         return self
 
     def stop(self) -> None:
+        if self._swap_stop is not None:
+            self._swap_stop.set()
+            if self._swap_thread is not None:
+                self._swap_thread.join(timeout=5.0)
+            self._swap_stop = self._swap_thread = None
         # graceful drain: stop accepting, answer what was admitted, THEN
         # stop the loop — reversing the old order, which could kill the
         # loop while listeners still held admitted-but-unanswered requests
@@ -691,10 +795,19 @@ class RegistrationService:
                     self.send_response(400)
                     self.end_headers()
                     return
+                try:
+                    raw_version = info.get("model_version")
+                    model_version = (
+                        int(raw_version) if raw_version is not None else None
+                    )
+                except (TypeError, ValueError):
+                    self.send_response(400)
+                    self.end_headers()
+                    return
                 if self.path == "/heartbeat":
                     # lease refresh only: an unknown (expired/never-seen)
                     # name gets 404 so the replica knows to re-register
-                    if not registry.heartbeat(name):
+                    if not registry.heartbeat(name, model_version):
                         self.send_response(404)
                         self.end_headers()
                         return
@@ -702,7 +815,10 @@ class RegistrationService:
                     self.end_headers()
                     return
                 try:
-                    svc = ServiceInfo(name, info["host"], int(info["port"]))
+                    svc = ServiceInfo(
+                        name, info["host"], int(info["port"]),
+                        model_version=model_version,
+                    )
                 except (KeyError, TypeError, ValueError) as e:
                     logger.debug("rejected malformed /register payload: %s", e)
                     self.send_response(400)
@@ -772,14 +888,19 @@ class RegistrationService:
             self._services[svc.name] = svc
             self._last_seen[svc.name] = self._clock()
 
-    def heartbeat(self, name: str) -> bool:
+    def heartbeat(self, name: str, model_version: Optional[int] = None) -> bool:
         """Refresh ``name``'s lease; False when the service is unknown
-        (expired or never registered) — the replica must re-register."""
+        (expired or never registered) — the replica must re-register.
+        ``model_version`` updates the lease metadata so ``/services``
+        tracks which model version the replica currently serves (a hot
+        swap shows up on the next heartbeat without re-registration)."""
         with self._lock:
             self._prune_expired()
             if name not in self._services:
                 return False
             self._last_seen[name] = self._clock()
+            if model_version is not None:
+                self._services[name].model_version = int(model_version)
             return True
 
     def start(self) -> "RegistrationService":
@@ -895,7 +1016,7 @@ class DistributedServingServer:
         already expired) falls back to a full re-registration."""
         if self._registry is not None:
             for info in self.service_info:
-                if not self._registry.heartbeat(info.name):
+                if not self._registry.heartbeat(info.name, info.model_version):
                     self._registry.register(info)
         if self._registry_url:
             import urllib.request
@@ -904,7 +1025,10 @@ class DistributedServingServer:
             for info in self.service_info:
                 req = urllib.request.Request(
                     base + "/heartbeat",
-                    data=json.dumps({"name": info.name}).encode(),
+                    data=json.dumps({
+                        "name": info.name,
+                        "model_version": info.model_version,
+                    }).encode(),
                     method="POST",
                     headers={"Content-Type": "application/json"},
                 )
@@ -1007,13 +1131,20 @@ def warm_restart_server(
     loader: Callable[[str], Any],
     root: Optional[str] = None,
     name: str = "model",
+    watch: bool = False,
+    poll_s: float = 0.25,
     **server_kwargs,
 ) -> ServingServer:
     """Build a :class:`ServingServer` from the last committed model —
     the process-kill recovery path: the server that died mid-serve comes
     back serving exactly the model version that was last atomically
-    committed. Raises ``FileNotFoundError`` when no committed model
-    exists (nothing safe to serve)."""
+    committed. The recovered version is stamped into the server's
+    :class:`ServiceInfo` lease metadata, so registering/heartbeating it
+    against a :class:`RegistrationService` reports which version this
+    replica serves. ``watch=True`` additionally starts the CURRENT-pointer
+    watcher (:meth:`ServingServer.enable_hot_swap`), so later commits
+    hot-swap in with no further restarts. Raises ``FileNotFoundError``
+    when no committed model exists (nothing safe to serve)."""
     recovered = recover_model(loader, root=root, name=name)
     if recovered is None:
         raise FileNotFoundError(
@@ -1022,4 +1153,9 @@ def warm_restart_server(
         )
     version, model = recovered
     logger.info("warm restart: serving committed model %s v%06d", name, version)
-    return ServingServer(model, **server_kwargs)
+    server = ServingServer(model, **server_kwargs)
+    server.model_version = version
+    server.info.model_version = version
+    if watch:
+        server.enable_hot_swap(loader, root=root, name=name, poll_s=poll_s)
+    return server

@@ -1,0 +1,44 @@
+"""Micro-batch streaming engine (reference Spark Structured Streaming +
+Spark Serving ingestion, PAPER.md layer 4).
+
+Continuous ingest → incremental fit → durable model commit → hot serving:
+
+    source = FileStreamSource("/data/incoming", pattern="part-*.npz")
+    sink = ModelCommitSink(lambda: LightGBMClassifier(numIterations=10))
+    with StreamingQuery(source, sink, trigger=AvailableNow()) as query:
+        query.await_termination()
+"""
+
+from mmlspark_tpu.streaming.query import (
+    AvailableNow,
+    Once,
+    ProcessingTime,
+    StreamingQuery,
+    Trigger,
+)
+from mmlspark_tpu.streaming.sink import (
+    ForeachBatchSink,
+    MemorySink,
+    ModelCommitSink,
+    Sink,
+)
+from mmlspark_tpu.streaming.source import (
+    FileStreamSource,
+    MemoryStream,
+    StreamSource,
+)
+
+__all__ = [
+    "AvailableNow",
+    "FileStreamSource",
+    "ForeachBatchSink",
+    "MemorySink",
+    "MemoryStream",
+    "ModelCommitSink",
+    "Once",
+    "ProcessingTime",
+    "Sink",
+    "StreamSource",
+    "StreamingQuery",
+    "Trigger",
+]

@@ -1,0 +1,204 @@
+"""Streaming sources — the Structured Streaming ``Source`` analogue.
+
+Spark's micro-batch engine (PAPER.md layer 4, ``sql/execution/streaming/``)
+talks to a source through three ideas: a monotonically-growing *offset*
+(how much data exists), a *planned batch* (the exact slice an epoch will
+process, durably logged before processing so a restarted query replays the
+identical slice), and the *batch load* itself. This module is that
+contract for the TPU framework:
+
+- :class:`StreamSource` — the three-method contract. Offsets are plain
+  ints (units consumed so far); a plan is a JSON-serializable *manifest*
+  naming the exact units, so the offset WAL pins a replayed epoch to the
+  same bytes even if the directory grew in between;
+- :class:`FileStreamSource` — the ``FileStreamSource`` analogue: a
+  directory watcher consuming files in lexicographic name order
+  (producers write ``part-00000.npz``, ``part-00001.npz``, ... — atomic
+  rename into place; ``*.tmp`` and dotfiles are invisible). ``.npz``
+  files load as named columns, ``.json``/``.jsonl`` as row objects;
+- :class:`MemoryStream` — the in-memory test source (Spark's
+  ``MemoryStream``): each :meth:`MemoryStream.add` call appends one
+  block; not durable across processes, by design.
+
+``max_per_trigger`` is the ``maxFilesPerTrigger`` rate limit: the query
+caps each epoch at that many new units so a backlog drains as several
+bounded micro-batches instead of one giant one.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import json
+import os
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from mmlspark_tpu.core.profiling import get_logger
+from mmlspark_tpu.data.table import Table
+
+logger = get_logger("mmlspark_tpu.streaming")
+
+
+class StreamSource:
+    """Offset-tracked input contract for the micro-batch engine.
+
+    Offsets are integers counting units (files, blocks) available so
+    far; they only grow. ``plan_batch`` turns an offset range into a
+    JSON-serializable manifest; ``load_batch`` materializes a manifest
+    into a :class:`~mmlspark_tpu.data.table.Table`. The split exists so
+    the query's offset WAL can pin a replayed epoch to the exact units
+    the crashed run planned, not whatever the source sees now.
+    """
+
+    #: per-epoch unit cap (the ``maxFilesPerTrigger`` rate limit); None = all
+    max_per_trigger: Optional[int] = None
+
+    def latest_offset(self) -> int:
+        raise NotImplementedError
+
+    def plan_batch(self, start: int, end: int) -> List[Any]:
+        raise NotImplementedError
+
+    def load_batch(self, manifest: Sequence[Any]) -> Table:
+        raise NotImplementedError
+
+
+def _load_npz(path: str) -> Table:
+    with np.load(path, allow_pickle=False) as npz:
+        return Table({name: npz[name] for name in npz.files})
+
+
+def _load_json_rows(path: str) -> Table:
+    rows: List[Dict[str, Any]] = []
+    with open(path, "r", encoding="utf-8") as fh:
+        text = fh.read().strip()
+    if text.startswith("["):
+        rows = json.loads(text)
+    else:
+        rows = [json.loads(line) for line in text.splitlines() if line.strip()]
+    return Table.from_rows(rows)
+
+
+_LOADERS: Dict[str, Callable[[str], Table]] = {
+    ".npz": _load_npz,
+    ".json": _load_json_rows,
+    ".jsonl": _load_json_rows,
+}
+
+
+class FileStreamSource(StreamSource):
+    """Directory watcher consuming files in lexicographic name order.
+
+    The offset is "how many files (sorted by name) have been made
+    available"; producers therefore name files monotonically
+    (``part-00000.npz``, ``part-00001.npz``, ...) and publish them
+    atomically (write ``name.tmp``, then rename) — ``*.tmp`` and
+    dotfiles never enter the listing, so a half-written file is
+    invisible exactly the way an uncommitted Spark output file is.
+    """
+
+    def __init__(
+        self,
+        path: str,
+        pattern: str = "*",
+        loader: Optional[Callable[[str], Table]] = None,
+        max_per_trigger: Optional[int] = None,
+    ):
+        self.path = path
+        self.pattern = pattern
+        self._loader = loader
+        self.max_per_trigger = max_per_trigger
+        #: ordered names already exposed through ``latest_offset`` — a name
+        #: never moves once listed, so offsets stay stable across rescans
+        self._files: List[str] = []
+
+    def _scan(self) -> List[str]:
+        try:
+            names = os.listdir(self.path)
+        except OSError:
+            return []
+        return sorted(
+            n for n in names
+            if fnmatch.fnmatch(n, self.pattern)
+            and not n.startswith(".")
+            and not n.endswith(".tmp")
+        )
+
+    def latest_offset(self) -> int:
+        seen = set(self._files)
+        fresh = [n for n in self._scan() if n not in seen]
+        if fresh:
+            # append-only: files already listed keep their index even if a
+            # late-arriving name would sort before them
+            self._files.extend(sorted(fresh))
+        return len(self._files)
+
+    def plan_batch(self, start: int, end: int) -> List[str]:
+        if end > len(self._files):
+            self.latest_offset()
+        if not 0 <= start <= end <= len(self._files):
+            raise ValueError(
+                f"offset range [{start}, {end}) outside the {len(self._files)} "
+                f"files listed under {self.path}"
+            )
+        return list(self._files[start:end])
+
+    def load_batch(self, manifest: Sequence[str]) -> Table:
+        tables = [self._load_one(name) for name in manifest]
+        if not tables:
+            return Table({})
+        return Table.concat(tables)
+
+    def _load_one(self, name: str) -> Table:
+        full = os.path.join(self.path, name)
+        if self._loader is not None:
+            return self._loader(full)
+        ext = os.path.splitext(name)[1].lower()
+        loader = _LOADERS.get(ext)
+        if loader is None:
+            raise ValueError(
+                f"no loader for {name!r} (supported: {sorted(_LOADERS)}; "
+                "pass loader= for custom formats)"
+            )
+        return loader(full)
+
+
+class MemoryStream(StreamSource):
+    """In-memory block source for tests (Spark's ``MemoryStream``): each
+    :meth:`add` appends one block of rows; offsets count blocks. State
+    lives in this process only — checkpointed queries over a
+    ``MemoryStream`` replay nothing after a restart, exactly like the
+    Spark original."""
+
+    def __init__(self, max_per_trigger: Optional[int] = None):
+        self._blocks: List[Table] = []
+        self.max_per_trigger = max_per_trigger
+
+    def add(self, table: Table) -> int:
+        """Append one block; returns the new latest offset."""
+        self._blocks.append(table)
+        return len(self._blocks)
+
+    def latest_offset(self) -> int:
+        return len(self._blocks)
+
+    def plan_batch(self, start: int, end: int) -> List[int]:
+        if not 0 <= start <= end <= len(self._blocks):
+            raise ValueError(
+                f"offset range [{start}, {end}) outside {len(self._blocks)} "
+                "blocks"
+            )
+        return list(range(start, end))
+
+    def load_batch(self, manifest: Sequence[int]) -> Table:
+        missing = [i for i in manifest if not 0 <= i < len(self._blocks)]
+        if missing:
+            raise ValueError(
+                f"blocks {missing} not present (MemoryStream state does not "
+                "survive a restart; use FileStreamSource for durable replay)"
+            )
+        tables = [self._blocks[i] for i in manifest]
+        if not tables:
+            return Table({})
+        return Table.concat(tables)

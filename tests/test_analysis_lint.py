@@ -259,6 +259,22 @@ class TestLockDiscipline:
         )
         assert lint_source(src, path=self.PATH, select=["lock-discipline"]) == []
 
+    def test_streaming_paths_covered(self):
+        src = (
+            "import threading, time\n"
+            "lock = threading.Lock()\n"
+            "def f():\n"
+            "    with lock:\n"
+            "        time.sleep(1)\n"
+        )
+        assert rules_of(
+            lint_source(
+                src,
+                path="mmlspark_tpu/streaming/fake.py",
+                select=["lock-discipline"],
+            )
+        ) == ["lock-discipline"]
+
     def test_outside_runtime_serving_not_flagged(self):
         src = (
             "import threading, time\n"

@@ -1,0 +1,489 @@
+"""streaming/ tests — micro-batch engine semantics against the Structured
+Streaming contract: offset/WAL/commit bookkeeping, restart-from-checkpoint
+with exactly-once epoch delivery, incremental-fit parity with the
+``numBatches`` chaining machinery, and the live hot-swap of a served model."""
+
+import json
+import os
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core.pipeline import Transformer
+from mmlspark_tpu.data.table import Table
+from mmlspark_tpu.observability.events import (
+    ModelSwapped,
+    StreamEpochCommitted,
+    StreamEpochStarted,
+    format_timeline,
+    get_bus,
+    timeline,
+)
+from mmlspark_tpu.runtime.faults import FaultPlan, inject_faults
+from mmlspark_tpu.runtime.journal import ModelStore
+from mmlspark_tpu.serving import RegistrationService, ServiceInfo, ServingServer
+from mmlspark_tpu.streaming import (
+    AvailableNow,
+    FileStreamSource,
+    ForeachBatchSink,
+    MemorySink,
+    MemoryStream,
+    ModelCommitSink,
+    Once,
+    ProcessingTime,
+    StreamingQuery,
+)
+
+
+def _chunk(rng, rows=40, cols=4):
+    X = rng.normal(size=(rows, cols))
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float64)
+    return Table({"features": X, "label": y})
+
+
+def _drop_npz(d, index, table):
+    final = os.path.join(d, f"part-{index:05d}.npz")
+    np.savez(
+        final + ".tmp.npz",
+        **{name: table.column(name) for name in table.columns},
+    )
+    os.rename(final + ".tmp.npz", final)
+
+
+def _auc(y, score):
+    order = np.argsort(score)
+    ranks = np.empty(len(y))
+    ranks[order] = np.arange(1, len(y) + 1)
+    pos = y > 0
+    n_pos, n_neg = pos.sum(), (~pos).sum()
+    return (ranks[pos].sum() - n_pos * (n_pos + 1) / 2) / (n_pos * n_neg)
+
+
+class TestSources:
+    def test_memory_stream_offsets_and_blocks(self):
+        ms = MemoryStream()
+        assert ms.latest_offset() == 0
+        ms.add(Table({"x": np.arange(3)}))
+        ms.add(Table({"x": np.arange(3, 7)}))
+        assert ms.latest_offset() == 2
+        assert ms.plan_batch(0, 2) == [0, 1]
+        assert ms.load_batch([1]).num_rows == 4
+        with pytest.raises(ValueError):
+            ms.plan_batch(0, 3)
+        with pytest.raises(ValueError, match="not survive a restart"):
+            ms.load_batch([9])
+
+    def test_file_source_orders_and_hides_partials(self, tmp_path):
+        d = str(tmp_path)
+        rng = np.random.default_rng(0)
+        _drop_npz(d, 1, _chunk(rng))
+        _drop_npz(d, 0, _chunk(rng))
+        # half-written outputs and dotfiles never become offsets
+        open(os.path.join(d, "part-00002.npz.tmp"), "w").close()
+        open(os.path.join(d, ".hidden.npz"), "w").close()
+        src = FileStreamSource(d, pattern="part-*")
+        assert src.latest_offset() == 2
+        assert src.plan_batch(0, 2) == ["part-00000.npz", "part-00001.npz"]
+        assert src.load_batch(src.plan_batch(0, 2)).num_rows == 80
+
+    def test_file_source_offsets_stable_across_rescans(self, tmp_path):
+        d = str(tmp_path)
+        rng = np.random.default_rng(0)
+        _drop_npz(d, 5, _chunk(rng))
+        src = FileStreamSource(d)
+        assert src.latest_offset() == 1
+        # a late-arriving earlier name must NOT shift existing offsets
+        _drop_npz(d, 1, _chunk(rng))
+        assert src.latest_offset() == 2
+        assert src.plan_batch(0, 2) == ["part-00005.npz", "part-00001.npz"]
+
+    def test_file_source_jsonl_and_unknown_ext(self, tmp_path):
+        d = str(tmp_path)
+        with open(os.path.join(d, "rows.jsonl"), "w") as fh:
+            fh.write('{"a": 1}\n{"a": 2}\n')
+        src = FileStreamSource(d, pattern="*.jsonl")
+        assert src.load_batch(src.plan_batch(0, src.latest_offset())).num_rows == 2
+        with open(os.path.join(d, "bad.xyz"), "w") as fh:
+            fh.write("nope")
+        src2 = FileStreamSource(d, pattern="*.xyz")
+        with pytest.raises(ValueError, match="no loader"):
+            src2.load_batch(src2.plan_batch(0, src2.latest_offset()))
+
+
+class TestQuery:
+    def test_once_and_available_now(self, tmp_path):
+        ms = MemoryStream(max_per_trigger=1)
+        for i in range(3):
+            ms.add(Table({"x": np.full(2, i)}))
+        sink = MemorySink()
+        q = StreamingQuery(ms, sink, trigger=Once(),
+                           checkpoint_dir=str(tmp_path / "q"))
+        q.start()
+        assert q.await_termination(10)
+        assert [e for e, _ in sink.batches] == [0]  # Once = one rate-limited epoch
+        q2 = StreamingQuery(ms, sink, trigger=AvailableNow(),
+                            checkpoint_dir=str(tmp_path / "q"))
+        q2.start()
+        assert q2.await_termination(10)
+        assert q2.exception is None
+        assert [e for e, _ in sink.batches] == [0, 1, 2]
+        assert sink.rows == 6
+        assert q2.committed_epochs == [0, 1, 2]
+
+    def test_processing_time_picks_up_live_data(self, tmp_path):
+        ms = MemoryStream()
+        sink = MemorySink()
+        q = StreamingQuery(ms, sink, trigger=ProcessingTime(0.02),
+                           checkpoint_dir=str(tmp_path / "q"))
+        with q:
+            ms.add(Table({"x": np.arange(2)}))
+            deadline = time.monotonic() + 10
+            while sink.rows < 2 and time.monotonic() < deadline:
+                time.sleep(0.01)
+            ms.add(Table({"x": np.arange(3)}))
+            while sink.rows < 5 and time.monotonic() < deadline:
+                time.sleep(0.01)
+        assert sink.rows == 5
+        assert not q.active
+
+    def test_restart_resumes_from_commit_log(self, tmp_path):
+        d, ckpt = str(tmp_path / "in"), str(tmp_path / "ckpt")
+        os.makedirs(d)
+        rng = np.random.default_rng(1)
+        for i in range(2):
+            _drop_npz(d, i, _chunk(rng, rows=5))
+        sink = MemorySink()
+        q = StreamingQuery(FileStreamSource(d, max_per_trigger=1), sink,
+                           trigger=AvailableNow(), checkpoint_dir=ckpt)
+        q.start()
+        assert q.await_termination(10) and q.exception is None
+        assert q.committed_epochs == [0, 1]
+        # restart: fresh source + sink on the same checkpoint, plus new data
+        _drop_npz(d, 2, _chunk(rng, rows=5))
+        sink2 = MemorySink()
+        q2 = StreamingQuery(FileStreamSource(d, max_per_trigger=1), sink2,
+                            trigger=AvailableNow(), checkpoint_dir=ckpt)
+        assert q2._next_epoch == 2 and q2._offset == 2
+        q2.start()
+        assert q2.await_termination(10) and q2.exception is None
+        # only the NEW epoch processed; committed epochs never re-deliver
+        assert [e for e, _ in sink2.batches] == [2]
+        assert q2.committed_epochs == [0, 1, 2]
+
+    def test_wal_replay_pins_manifest(self, tmp_path):
+        """An uncommitted planned epoch replays the exact WAL manifest,
+        even though the directory has since grown."""
+        d, ckpt = str(tmp_path / "in"), str(tmp_path / "ckpt")
+        os.makedirs(d)
+        rng = np.random.default_rng(2)
+        _drop_npz(d, 0, _chunk(rng, rows=5))
+        # hand-build the crashed run's checkpoint: epoch 0 planned, no commit
+        os.makedirs(os.path.join(ckpt, "offsets"))
+        with open(os.path.join(ckpt, "offsets", "000000.json"), "w") as fh:
+            json.dump({"epoch": 0, "start": 0, "end": 1,
+                       "manifest": ["part-00000.npz"]}, fh)
+        _drop_npz(d, 1, _chunk(rng, rows=7))  # arrives after the "crash"
+        sink = MemorySink()
+        q = StreamingQuery(FileStreamSource(d), sink, trigger=AvailableNow(),
+                           checkpoint_dir=ckpt)
+        assert q._replay is not None
+        q.start()
+        assert q.await_termination(10) and q.exception is None
+        # epoch 0 = the pinned single-file manifest; epoch 1 = the rest
+        assert sink.batches[0][0] == 0 and sink.batches[0][1].num_rows == 5
+        assert sink.batches[1][0] == 1 and sink.batches[1][1].num_rows == 7
+
+    def test_sinks_dedupe_replayed_epochs(self):
+        seen = []
+        fb = ForeachBatchSink(lambda t, e: seen.append(e))
+        t = Table({"x": np.arange(2)})
+        fb.process_batch(0, t)
+        fb.process_batch(0, t)  # WAL replay duplicate
+        assert seen == [0]
+        ms = MemorySink()
+        ms.process_batch(3, t)
+        ms.process_batch(3, t)
+        assert len(ms.batches) == 1
+
+    def test_kill_stream_directive_sigkills(self, monkeypatch):
+        kills = []
+        monkeypatch.setattr(
+            "mmlspark_tpu.streaming.query.os.kill",
+            lambda pid, sig: kills.append((pid, sig)),
+        )
+        ms = MemoryStream()
+        ms.add(Table({"x": np.arange(2)}))
+        q = StreamingQuery(ms, MemorySink(), checkpoint_dir=None)
+        plan = FaultPlan(seed=0).kill_stream(0, "pre_commit")
+        with inject_faults(plan):
+            q.process_all_available()
+        assert kills and kills[0][0] == os.getpid()
+        assert plan.fired == [("kill_stream", 0, 0)]
+        with pytest.raises(ValueError):
+            FaultPlan(seed=0).kill_stream(0, "mid_sink")
+
+    def test_streaming_events_fold_into_timeline(self, tmp_path):
+        events = []
+        bus = get_bus()
+        bus.add_listener(events.append)
+        try:
+            ms = MemoryStream()
+            ms.add(Table({"x": np.arange(4)}))
+            q = StreamingQuery(ms, MemorySink(), trigger=Once(),
+                               name="tq", checkpoint_dir=str(tmp_path))
+            q.start()
+            assert q.await_termination(10)
+        finally:
+            bus.remove_listener(events.append)
+        assert any(isinstance(e, StreamEpochStarted) for e in events)
+        committed = [e for e in events if isinstance(e, StreamEpochCommitted)]
+        assert committed and committed[0].rows == 4
+        summary = timeline(events)
+        assert summary["streaming"]["epochs"] == 1
+        assert summary["streaming"]["rows"] == 4
+        assert summary["streaming"]["queries"] == {"tq": [0]}
+        assert "== streaming ==" in format_timeline(summary)
+
+
+@pytest.mark.slow
+class TestModelCommitSink:
+    """Incremental-fit parity: the streamed path must not silently shift
+    models relative to the manual modelString chaining it is built on."""
+
+    def _chunks(self, k=3, rows=40):
+        rng = np.random.default_rng(9)
+        return [_chunk(rng, rows=rows) for _ in range(k)]
+
+    def _factory(self):
+        from mmlspark_tpu.lightgbm import LightGBMClassifier
+
+        return LightGBMClassifier(numIterations=4, numLeaves=7, seed=3)
+
+    def _run_stream(self, chunks, root, name="m"):
+        ms = MemoryStream(max_per_trigger=1)
+        for c in chunks:
+            ms.add(c)
+        sink = ModelCommitSink(self._factory, name=name, root=root)
+        q = StreamingQuery(ms, sink, trigger=AvailableNow(),
+                           checkpoint_dir=os.path.join(root, "q"))
+        q.start()
+        assert q.await_termination(300)
+        if q.exception is not None:
+            raise q.exception
+        return sink
+
+    def test_streamed_fit_matches_manual_chaining(self, tmp_path):
+        from mmlspark_tpu.lightgbm.base import _merge_boosters
+        from mmlspark_tpu.lightgbm.booster import Booster
+
+        chunks = self._chunks()
+        sink = self._run_stream(chunks, str(tmp_path))
+        assert sink.committed_epochs == [0, 1, 2]
+        assert sink.versions == {0: 1, 1: 2, 2: 3}
+        # manual modelString chaining over the same chunks, byte-for-byte
+        text = None
+        for c in chunks:
+            est = self._factory()
+            if text:
+                est.set("modelString", text)
+            delta = est.fit(c).booster
+            merged = (
+                _merge_boosters([Booster.from_string(text), delta])
+                if text else delta
+            )
+            text = merged.model_to_string()
+        assert text == sink.latest_text()
+
+    def test_streamed_fit_auc_parity_with_concat_fit(self, tmp_path):
+        from mmlspark_tpu.lightgbm.booster import Booster
+
+        chunks = self._chunks(k=3, rows=80)
+        sink = self._run_stream(chunks, str(tmp_path))
+        streamed = Booster.from_string(sink.latest_text())
+        concat = Table.concat(chunks)
+        # one warm-start-free fit over everything, same total tree budget
+        from mmlspark_tpu.lightgbm import LightGBMClassifier
+
+        single = LightGBMClassifier(
+            numIterations=12, numLeaves=7, seed=3
+        ).fit(concat).booster
+        rng = np.random.default_rng(77)
+        Xt = rng.normal(size=(300, 4))
+        yt = (Xt[:, 0] + 0.5 * Xt[:, 1] > 0).astype(np.float64)
+        auc_stream = _auc(yt, streamed.raw_margin(Xt)[:, 0])
+        auc_single = _auc(yt, single.raw_margin(Xt)[:, 0])
+        assert streamed.num_trees == 12
+        assert auc_stream > 0.85
+        assert abs(auc_stream - auc_single) < 0.08
+
+    def test_merge_round_trip_preserves_margins(self):
+        from mmlspark_tpu.lightgbm.base import _merge_boosters
+        from mmlspark_tpu.lightgbm.booster import Booster
+
+        a, b = self._chunks(k=2)
+        base = self._factory().fit(a).booster
+        est = self._factory()
+        est.set("modelString", base.model_to_string())
+        delta = est.fit(b).booster
+        merged = _merge_boosters(
+            [Booster.from_string(base.model_to_string()), delta]
+        )
+        again = Booster.from_string(merged.model_to_string())
+        X = np.asarray(a.column("features"))
+        np.testing.assert_allclose(
+            merged.raw_margin(X), again.raw_margin(X), rtol=1e-6
+        )
+        np.testing.assert_allclose(
+            merged.raw_margin(X),
+            base.raw_margin(X) + delta.raw_margin(X),
+            rtol=1e-5, atol=1e-6,
+        )
+
+    def test_duplicate_epoch_never_refits_or_recommits(self, tmp_path):
+        calls = []
+        factory = self._factory
+
+        def counting_factory():
+            calls.append(1)
+            return factory()
+
+        root = str(tmp_path)
+        chunks = self._chunks(k=2)
+        sink = ModelCommitSink(counting_factory, name="m", root=root)
+        sink.process_batch(0, chunks[0])
+        v = sink.process_batch(0, chunks[0])  # WAL replay duplicate
+        assert len(calls) == 1
+        assert v == 1 and sink.versions == {0: 1}
+        sink.close()
+        # a fresh sink instance (restarted process) restores the journal
+        # and also refuses to refit the journaled epoch
+        sink2 = ModelCommitSink(counting_factory, name="m", root=root)
+        assert sink2.committed_epochs == [0]
+        v = sink2.process_batch(0, chunks[0])
+        assert len(calls) == 1 and v == 1
+        assert ModelStore(os.path.join(root, "models")).current_version("m") == 1
+        sink2.process_batch(1, chunks[1])
+        assert len(calls) == 2 and sink2.versions[1] == 2
+        sink2.close()
+
+    def test_requires_durable_root(self, monkeypatch):
+        monkeypatch.delenv("MMLSPARK_TPU_CHECKPOINT_DIR", raising=False)
+        with pytest.raises(ValueError, match="durable root"):
+            ModelCommitSink(self._factory)
+
+
+class _Scaler(Transformer):
+    """Cheap text-loadable model for hot-swap tests: scales input by k."""
+
+    def __init__(self, k, **kw):
+        super().__init__(**kw)
+        self.k = k
+
+    def transform(self, table):
+        x = np.asarray(table.column("input"), dtype=np.float64)
+        return table.with_column("prediction", x * self.k)
+
+
+def _post(url, payload, timeout=10):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(), method="POST",
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read())
+
+
+def _get(url, timeout=10):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+class TestHotSwap:
+    def test_current_swap_between_requests_without_restart(self, tmp_path):
+        store = ModelStore(str(tmp_path / "models"))
+        store.commit("3.0", name="scaler")
+        swapped = []
+        bus = get_bus()
+        bus.add_listener(
+            lambda e: swapped.append(e) if isinstance(e, ModelSwapped) else None
+        )
+        srv = ServingServer(_Scaler(1.0), max_latency_ms=1.0)
+        srv.enable_hot_swap(
+            lambda text: _Scaler(float(text)), root=str(tmp_path),
+            name="scaler", poll_s=0.02,
+        )
+        with srv:
+            deadline = time.monotonic() + 10
+            while srv.model_version != 1 and time.monotonic() < deadline:
+                time.sleep(0.01)
+            status, out = _post(srv.info.url, {"input": 7.0})
+            assert status == 200 and out["prediction"] == 21.0
+            assert _get(srv.info.url + "healthz")["model_version"] == 1
+            # a new commit lands; the SAME listener swaps between requests
+            store.commit("5.0", name="scaler")
+            while srv.model_version != 2 and time.monotonic() < deadline:
+                time.sleep(0.01)
+            status, out = _post(srv.info.url, {"input": 7.0})
+            assert status == 200 and out["prediction"] == 35.0
+            assert _get(srv.info.url + "healthz")["model_version"] == 2
+            assert srv.info.model_version == 2
+        assert [e.version for e in swapped] == [1, 2]
+        assert all(e.server == "serving" for e in swapped)
+
+    def test_bad_commit_keeps_serving_old_model(self, tmp_path):
+        store = ModelStore(str(tmp_path / "models"))
+        store.commit("2.0", name="scaler")
+        srv = ServingServer(_Scaler(1.0), max_latency_ms=1.0)
+        srv.enable_hot_swap(
+            lambda text: _Scaler(float(text)), root=str(tmp_path),
+            name="scaler", poll_s=0.02,
+        )
+        with srv:
+            deadline = time.monotonic() + 10
+            while srv.model_version != 1 and time.monotonic() < deadline:
+                time.sleep(0.01)
+            store.commit("not-a-number", name="scaler")  # loader will raise
+            time.sleep(0.2)
+            status, out = _post(srv.info.url, {"input": 4.0})
+            assert status == 200 and out["prediction"] == 8.0  # still v1
+            assert srv.model_version == 1
+
+    def test_hot_swap_requires_root(self, monkeypatch):
+        monkeypatch.delenv("MMLSPARK_TPU_CHECKPOINT_DIR", raising=False)
+        srv = ServingServer(_Scaler(1.0))
+        with pytest.raises(ValueError, match="ModelStore root"):
+            srv.enable_hot_swap(lambda text: _Scaler(float(text)))
+
+
+class TestRegistryModelVersion:
+    def test_services_reports_model_version(self):
+        with RegistrationService() as reg:
+            reg.register(ServiceInfo("a", "127.0.0.1", 1234, model_version=3))
+            svcs = _get(reg.info.url + "services")
+            assert svcs == [{"name": "a", "host": "127.0.0.1", "port": 1234,
+                             "model_version": 3}]
+            # a heartbeat carrying a new version updates the lease metadata
+            assert reg.heartbeat("a", model_version=4)
+            assert _get(reg.info.url + "services")[0]["model_version"] == 4
+
+    def test_http_register_and_heartbeat_carry_version(self):
+        with RegistrationService() as reg:
+            base = reg.info.url.rstrip("/")
+            req = urllib.request.Request(
+                base + "/register",
+                data=json.dumps({"name": "w", "host": "127.0.0.1",
+                                 "port": 9, "model_version": 7}).encode(),
+                method="POST",
+            )
+            assert urllib.request.urlopen(req, timeout=10).status == 200
+            assert _get(base + "/services")[0]["model_version"] == 7
+            req = urllib.request.Request(
+                base + "/heartbeat",
+                data=json.dumps({"name": "w", "model_version": 8}).encode(),
+                method="POST",
+            )
+            assert urllib.request.urlopen(req, timeout=10).status == 200
+            assert _get(base + "/services")[0]["model_version"] == 8

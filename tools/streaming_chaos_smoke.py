@@ -1,0 +1,180 @@
+#!/usr/bin/env python
+"""Kill-and-restart smoke for the streaming micro-batch engine (CI:
+streaming-chaos).
+
+A child process runs a checkpointed :class:`StreamingQuery` (file source,
+``max_per_trigger=1`` -> one epoch per chunk) whose :class:`ModelCommitSink`
+incrementally fits a LightGBM model. The parent SIGKILLs the child at BOTH
+designated crash windows — ``post_wal`` (epoch planned + logged, nothing
+processed) and ``pre_commit`` (sink ran and journaled, commit log missing) —
+via the ambient :class:`FaultPlan`'s ``kill_stream`` directive, then restarts.
+The headline exactly-once invariants, asserted against an undisturbed
+reference run:
+
+  * every epoch lands in the commit log exactly once;
+  * a journaled epoch is NEVER refitted across restarts (the fit journal
+    holds exactly one record per epoch over all runs combined);
+  * the final ModelStore version AND model bytes equal the undisturbed
+    run's — no skipped, duplicated, or double-applied epoch anywhere;
+  * a warm-restarted server serves that same version.
+
+Exit code 0 + "streaming chaos smoke OK" on success.
+
+Usage: python tools/streaming_chaos_smoke.py                 # the smoke
+       python tools/streaming_chaos_smoke.py --child R I [E P]  # victim
+"""
+
+import glob
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import zlib
+
+# runnable both installed (CI) and straight from a checkout
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+NUM_CHUNKS = 4
+MODEL = "chaos"
+
+
+def make_chunks(incoming: str) -> None:
+    rng = np.random.default_rng(11)
+    os.makedirs(incoming, exist_ok=True)
+    for i in range(NUM_CHUNKS):
+        X = rng.normal(size=(60, 4))
+        y = (X[:, 0] - 0.5 * X[:, 2] > 0).astype(np.float64)
+        final = os.path.join(incoming, f"part-{i:05d}.npz")
+        np.savez(final + ".tmp.npz", features=X, label=y)
+        os.rename(final + ".tmp.npz", final)
+
+
+def run_child(root: str, incoming: str, kill_epoch=None, kill_point=None) -> None:
+    """One (re)start of the query; dies mid-epoch when a kill is armed."""
+    os.environ["MMLSPARK_TPU_CHECKPOINT_DIR"] = root
+    from mmlspark_tpu.lightgbm import LightGBMClassifier
+    from mmlspark_tpu.runtime.faults import FaultPlan, inject_faults
+    from mmlspark_tpu.streaming import (
+        FileStreamSource,
+        ModelCommitSink,
+        StreamingQuery,
+    )
+
+    source = FileStreamSource(incoming, pattern="part-*.npz", max_per_trigger=1)
+    sink = ModelCommitSink(
+        lambda: LightGBMClassifier(numIterations=4, numLeaves=7, seed=5),
+        name=MODEL,
+    )
+    query = StreamingQuery(source, sink, name="chaos")
+    plan = FaultPlan()
+    if kill_epoch is not None:
+        plan.kill_stream(int(kill_epoch), kill_point)
+    with inject_faults(plan):
+        query.process_all_available()
+    sink.close()
+
+
+def spawn(root: str, incoming: str, kill=None) -> subprocess.Popen:
+    argv = [sys.executable, os.path.abspath(__file__), "--child", root, incoming]
+    if kill is not None:
+        argv += [str(kill[0]), kill[1]]
+    return subprocess.Popen(argv, env={**os.environ, "JAX_PLATFORMS": "cpu"})
+
+
+def final_state(root: str):
+    """(version, crc32-of-model-text, committed epochs, journal epochs)."""
+    from mmlspark_tpu.runtime.journal import ModelStore
+
+    store = ModelStore(os.path.join(root, "models"))
+    version, text = store.latest(MODEL)
+    commits = sorted(
+        int(os.path.basename(p)[:-5])
+        for p in glob.glob(os.path.join(root, "streaming", "chaos", "commits", "*.json"))
+    )
+    journal_epochs = []
+    for path in glob.glob(os.path.join(root, "streaming-models", "**", "journal.jsonl"),
+                          recursive=True):
+        with open(path, "r", encoding="utf-8") as fh:
+            for line in fh:
+                if line.strip():
+                    journal_epochs.append(int(json.loads(line)["task"]))
+    return version, zlib.crc32(text.encode()), commits, sorted(journal_epochs)
+
+
+def main() -> int:
+    work = tempfile.mkdtemp(prefix="mmlspark-tpu-streamchaos-")
+    incoming = os.path.join(work, "incoming")
+    make_chunks(incoming)
+
+    # undisturbed reference run (own checkpoint root, fresh process)
+    ref_root = os.path.join(work, "ref")
+    child = spawn(ref_root, incoming)
+    assert child.wait(timeout=600) == 0, "undisturbed run failed"
+    ref_version, ref_crc, ref_commits, ref_journal = final_state(ref_root)
+    assert ref_commits == list(range(NUM_CHUNKS)), ref_commits
+    print(f"undisturbed run: v{ref_version:06d} crc={ref_crc:08x} "
+          f"epochs={ref_commits}")
+
+    # chaos run: die at post_wal of epoch 1, restart, die at pre_commit of
+    # epoch 2, restart, finish — both crash windows, one checkpoint
+    chaos_root = os.path.join(work, "chaos")
+    for kill in [(1, "post_wal"), (2, "pre_commit")]:
+        child = spawn(chaos_root, incoming, kill=kill)
+        child.wait(timeout=600)
+        assert child.returncode == -signal.SIGKILL, (
+            f"expected SIGKILL death at {kill}, got rc={child.returncode}"
+        )
+        print(f"child SIGKILLed at epoch {kill[0]} ({kill[1]})")
+    child = spawn(chaos_root, incoming)
+    assert child.wait(timeout=600) == 0, "final restart failed"
+
+    version, crc, commits, journal = final_state(chaos_root)
+    print(f"chaos run:       v{version:06d} crc={crc:08x} epochs={commits}")
+    assert commits == list(range(NUM_CHUNKS)), (
+        f"each epoch must commit exactly once: {commits}"
+    )
+    assert journal == list(range(NUM_CHUNKS)), (
+        f"a journaled epoch was refitted (or skipped): {journal}"
+    )
+    assert (version, crc) == (ref_version, ref_crc), (
+        f"diverged from undisturbed run: v{version} crc={crc:08x} "
+        f"!= v{ref_version} crc={ref_crc:08x}"
+    )
+
+    # the serving plane recovers the identical version after the chaos
+    os.environ["MMLSPARK_TPU_CHECKPOINT_DIR"] = chaos_root
+    from mmlspark_tpu.lightgbm import LightGBMClassificationModel
+    from mmlspark_tpu.serving import recover_model, warm_restart_server
+
+    recovered = recover_model(
+        LightGBMClassificationModel.from_model_string, name=MODEL
+    )
+    assert recovered is not None and recovered[0] == ref_version
+    server = warm_restart_server(
+        LightGBMClassificationModel.from_model_string, name=MODEL
+    )
+    try:
+        assert server.model_version == ref_version
+        assert server.info.model_version == ref_version
+    finally:
+        server._httpd.server_close()
+    print(f"warm restart serves v{server.model_version:06d} "
+          f"(matches undisturbed run)")
+    print("streaming chaos smoke OK")
+    return 0
+
+
+if __name__ == "__main__":
+    if len(sys.argv) >= 4 and sys.argv[1] == "--child":
+        kill = sys.argv[4:6]
+        run_child(
+            sys.argv[2], sys.argv[3],
+            kill_epoch=kill[0] if kill else None,
+            kill_point=kill[1] if kill else None,
+        )
+        sys.exit(0)
+    sys.exit(main())
