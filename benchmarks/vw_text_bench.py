@@ -64,6 +64,22 @@ def main():
     feats = feat.transform(t_all)
     featurize_s = time.perf_counter() - t0
 
+    # combined featurizer + namespace-crossing pass (both column-vectorized)
+    from mmlspark_tpu.vw import VowpalWabbitInteractions
+
+    feat2 = VowpalWabbitFeaturizer(
+        inputCols=["text"], outputCol="features2", numBits=NUM_BITS,
+        stringSplit=True, prefixStringsWithColumnName=False,
+    )
+    inter = VowpalWabbitInteractions(
+        inputCols=["features", "features2"], outputCol="crossed",
+        numBits=NUM_BITS,
+    )
+    inter_docs = min(20_000, N_DOCS)
+    t0 = time.perf_counter()
+    inter.transform(feat2.transform(feats.head(inter_docs)))
+    featurize_inter_s = time.perf_counter() - t0
+
     tr = feats.slice(0, N_DOCS)
     te = feats.slice(N_DOCS, N_DOCS + N_TEST)
     yte = y[N_DOCS:]
@@ -79,7 +95,14 @@ def main():
     from sklearn.linear_model import SGDClassifier
 
     def to_csr(tbl):
-        col = tbl.column("features")  # object column of (indices, values)
+        from mmlspark_tpu.data.sparse import SparseRows
+
+        col = tbl.column("features")
+        if isinstance(col, SparseRows):  # CSR column: three array handoffs
+            return csr_matrix(
+                (col.values, col.indices, col.indptr),
+                shape=(tbl.num_rows, 1 << NUM_BITS),
+            )
         lens = np.array([len(rv[0]) for rv in col])
         indptr = np.concatenate([[0], np.cumsum(lens)])
         cols = np.concatenate([np.asarray(rv[0]) for rv in col])
@@ -108,6 +131,8 @@ def main():
         "tpu_fit_secs": round(fit_s, 3),
         "cpu_fit_secs": round(cpu_s, 3),
         "featurize_secs": round(featurize_s, 3),
+        "featurize_interactions_secs": round(featurize_inter_s, 3),
+        "featurize_interactions_docs": inter_docs,
         "acc_tpu": round(acc_tpu, 4),
         "acc_cpu": round(acc_cpu, 4),
         "docs": N_DOCS,

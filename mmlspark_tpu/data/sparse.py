@@ -36,6 +36,34 @@ class SparseBatch:
         np.add.at(out, (rows, self.indices.reshape(-1)), self.values.reshape(-1))
         return out
 
+    @staticmethod
+    def from_csr(
+        indices: np.ndarray,
+        values: np.ndarray,
+        indptr: np.ndarray,
+        dim: int,
+        pad_to: int = 0,
+    ) -> "SparseBatch":
+        """Pad CSR arrays straight into the (N, K) device layout with one
+        scatter — the fast path ``from_lists`` assembly reduces to when rows
+        arrive as flat (indices, values, indptr) instead of n Python lists.
+        Assumes duplicate indices are already combined (see
+        :func:`combine_csr`); K matches ``from_lists`` (max row length,
+        floor 1, or ``pad_to``)."""
+        indptr = np.asarray(indptr, dtype=np.int64)
+        counts = np.diff(indptr)
+        n = len(counts)
+        k = int(max(counts.max() if n else 0, 1, pad_to))
+        ind2d = np.zeros((n, k), dtype=np.int32)
+        val2d = np.zeros((n, k), dtype=np.float32)
+        nnz = int(indptr[-1]) if n else 0
+        if nnz:
+            row_ids = np.repeat(np.arange(n, dtype=np.int64), counts)
+            within = np.arange(nnz, dtype=np.int64) - indptr[row_ids]
+            ind2d[row_ids, within] = indices[:nnz]
+            val2d[row_ids, within] = values[:nnz]
+        return SparseBatch(indices=ind2d, values=val2d, dim=dim)
+
 
 @dataclasses.dataclass
 class CSRMatrix:
@@ -158,13 +186,141 @@ class CSRMatrix:
         return out
 
 
+class SparseRows:
+    """CSR-backed sparse column — a drop-in for the object column of per-row
+    ``(indices, values)`` tuples the VW featurizer used to emit, without
+    materializing n Python tuples. Three flat arrays back the whole column
+    (``indices`` int32, ``values`` float32, ``indptr`` int64 row pointers),
+    so consumers that understand CSR (``column_to_batch``,
+    ``csr_column_to_matrix``) move batches with scatters instead of per-row
+    loops, while row access (``col[i]`` -> (idx, val) views), iteration,
+    masking, and fancy indexing keep the old column contract for everything
+    else. Duck-types just enough of a 1-D object ndarray to live inside a
+    :class:`~mmlspark_tpu.data.table.Table`."""
+
+    dtype = np.dtype(object)
+    ndim = 1
+
+    def __init__(
+        self,
+        indices: np.ndarray,
+        values: np.ndarray,
+        indptr: np.ndarray,
+        dim: int,
+    ):
+        self.indices = np.asarray(indices, dtype=np.int32)
+        self.values = np.asarray(values, dtype=np.float32)
+        self.indptr = np.asarray(indptr, dtype=np.int64)
+        self.dim = int(dim)
+
+    @property
+    def shape(self) -> Tuple[int]:
+        return (len(self.indptr) - 1,)
+
+    @property
+    def nnz(self) -> int:
+        return int(self.indptr[-1])
+
+    def __len__(self) -> int:
+        return len(self.indptr) - 1
+
+    def __getitem__(self, key):
+        if isinstance(key, (int, np.integer)):
+            i = int(key)
+            n = len(self)
+            if i < 0:
+                i += n
+            if not 0 <= i < n:
+                raise IndexError(f"row {key} out of range for {n} rows")
+            a, b = self.indptr[i], self.indptr[i + 1]
+            return (self.indices[a:b], self.values[a:b])
+        if isinstance(key, slice):
+            start, stop, step = key.indices(len(self))
+            if step == 1:
+                stop = max(stop, start)
+                a = self.indptr[start]
+                return SparseRows(
+                    self.indices[a : self.indptr[stop]],
+                    self.values[a : self.indptr[stop]],
+                    self.indptr[start : stop + 1] - a,
+                    self.dim,
+                )
+            return self.take(np.arange(start, stop, step))
+        key = np.asarray(key)
+        if key.dtype == bool:
+            key = np.nonzero(key)[0]
+        return self.take(key)
+
+    def take(self, rows: np.ndarray) -> "SparseRows":
+        rows = np.asarray(rows, dtype=np.int64)
+        counts = np.diff(self.indptr)[rows]
+        new_indptr = np.zeros(len(rows) + 1, dtype=np.int64)
+        np.cumsum(counts, out=new_indptr[1:])
+        total = int(new_indptr[-1])
+        # source position of each gathered entry: row start + offset-in-row
+        pos = (
+            np.repeat(self.indptr[rows], counts)
+            + np.arange(total, dtype=np.int64)
+            - np.repeat(new_indptr[:-1], counts)
+        )
+        return SparseRows(self.indices[pos], self.values[pos], new_indptr, self.dim)
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def copy(self) -> "SparseRows":
+        return SparseRows(
+            self.indices.copy(), self.values.copy(), self.indptr.copy(), self.dim
+        )
+
+    def to_object_column(self) -> np.ndarray:
+        """Materialize the legacy object column of (indices, values) tuples."""
+        out = np.empty(len(self), dtype=object)
+        for i in range(len(self)):
+            out[i] = self[i]
+        return out
+
+    @staticmethod
+    def concat(parts: Sequence["SparseRows"]) -> "SparseRows":
+        dim = max(p.dim for p in parts)
+        indptrs = [parts[0].indptr]
+        for p in parts[1:]:
+            indptrs.append(p.indptr[1:] + (indptrs[-1][-1] - p.indptr[0]))
+        return SparseRows(
+            np.concatenate([p.indices for p in parts]),
+            np.concatenate([p.values for p in parts]),
+            np.concatenate(indptrs),
+            dim,
+        )
+
+    def __repr__(self) -> str:
+        return f"SparseRows[{len(self)} rows, nnz={self.nnz}, dim={self.dim}]"
+
+
 def csr_column_to_matrix(column: np.ndarray, num_features: int = 0) -> CSRMatrix:
-    """Interpret an object column of (indices, values) tuples as a CSRMatrix."""
+    """Interpret an object column of (indices, values) tuples as a CSRMatrix.
+    :class:`SparseRows` columns convert with three array casts — no row loop."""
+    if isinstance(column, SparseRows):
+        f = int(num_features or column.dim)
+        if column.nnz and int(column.indices.max()) >= f:
+            raise ValueError(
+                f"sparse feature index {int(column.indices.max())} out of "
+                f"range for num_features={f}"
+            )
+        return CSRMatrix(
+            data=column.values,
+            indices=column.indices,
+            indptr=column.indptr,
+            shape=(len(column), f),
+        )
     return CSRMatrix.from_rows(list(column), num_features=num_features)
 
 
 def is_sparse_column(column: np.ndarray) -> bool:
-    """True when an object column holds per-row (indices, values) tuples."""
+    """True when a column holds per-row (indices, values) sparse rows."""
+    if isinstance(column, SparseRows):
+        return True
     if column.dtype != object or len(column) == 0:
         return False
     head = column[0]
@@ -217,6 +373,181 @@ def from_lists(
     return SparseBatch(indices=indices, values=values, dim=dim)
 
 
+def _combine_ones_padded(
+    indices: np.ndarray,
+    values: np.ndarray,
+    indptr: np.ndarray,
+    counts: np.ndarray,
+    K: int,
+    sum_collisions: bool,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """combine_csr fast path for all-ones values: rows scatter into a padded
+    (n, K+1) int32 matrix whose rows sort independently (SIMD sorting
+    networks on short rows beat a global radix sort by ~15x), duplicates
+    collapse to runs, and a group's summed value is just its run length.
+    The extra sentinel column guarantees every row ends with a padding run,
+    so each valid run's extent is bounded by the next boundary in the SAME
+    row. No zero-trim pass: combined values are always >= 1."""
+    n = len(counts)
+    nnz = int(indptr[-1])
+    sent = np.int32(2**31 - 1)
+    W = K + 1
+    m = np.full((n, W), sent, dtype=np.int32)
+    if bool((counts == K).all()):
+        m[:, :K] = indices.astype(np.int32, copy=False).reshape(n, K)
+    else:
+        row_ids = np.repeat(np.arange(n, dtype=np.int64), counts)
+        within = np.arange(nnz, dtype=np.int64) - np.repeat(indptr[:-1], counts)
+        m[row_ids, within] = indices
+    ms = np.sort(m, axis=1)  # sentinels sort to the tail of each row
+    b2 = np.empty((n, W), dtype=bool)
+    b2[:, 0] = True
+    np.not_equal(ms[:, 1:], ms[:, :-1], out=b2[:, 1:])
+    # each row contributes exactly one padding run (the sentinel column
+    # guarantees it), so distinct indices per row = boundaries - 1
+    ucounts = np.count_nonzero(b2, axis=1) - 1
+    has_dup = ucounts < counts
+    if not has_dup.any():
+        return indices.astype(np.int32, copy=False), values, indptr
+    # Duplicate-free rows have ucounts == counts, so the kept run stream IS
+    # the output — no per-group destination scatter at all. Values are 1 for
+    # singleton runs, so only indices of duplicate-free rows need an
+    # original-order overwrite afterwards.
+    q = np.flatnonzero(b2.ravel())  # run starts, row-major => sorted per row
+    vals_q = ms.ravel()[q]
+    keep_q = vals_q != sent  # drop each row's padding run (and empty rows)
+    out_idx = vals_q[keep_q]
+    if sum_collisions:
+        runs = np.empty(len(q), dtype=np.int64)
+        np.subtract(q[1:], q[:-1], out=runs[:-1])
+        runs[-1] = n * W - q[-1]  # last boundary is always a padding run
+        out_val = runs[keep_q].astype(np.float32)
+    else:
+        out_val = np.ones(len(out_idx), dtype=np.float32)  # first of a 1 is 1
+    out_indptr = np.zeros(n + 1, dtype=np.int64)
+    np.cumsum(ucounts, out=out_indptr[1:])
+    # duplicate-free rows: restore original entry order; work is O(their nnz)
+    nd_rows = np.flatnonzero(~has_dup)
+    c_nd = counts[nd_rows]
+    tot_nd = int(c_nd.sum())
+    if tot_nd:
+        seg = np.arange(tot_nd, dtype=np.int64) - np.repeat(
+            np.cumsum(c_nd) - c_nd, c_nd
+        )
+        src = np.repeat(indptr[nd_rows], c_nd) + seg
+        dst = np.repeat(out_indptr[nd_rows], c_nd) + seg
+        out_idx[dst] = indices[src]
+    return out_idx, out_val, out_indptr
+
+
+def combine_csr(
+    indices: np.ndarray,
+    values: np.ndarray,
+    indptr: np.ndarray,
+    sum_collisions: bool = True,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Row-wise duplicate-index combine + zero-value trim over flat CSR
+    arrays — the vectorized equivalent of ``from_lists`` collision handling
+    followed by ``batch_to_column`` trimming, bit-exact with both:
+
+    - a row WITHOUT duplicate indices keeps its original entry order
+      (``from_lists`` only touches a row when ``np.unique`` shrinks it);
+    - a row WITH duplicates becomes sorted-unique, values summed in float32
+      in original occurrence order (``sumCollisions=True``) or taken from
+      the first occurrence (``False``);
+    - entries whose combined value is exactly 0 are dropped (the padded
+      batch cannot distinguish them from padding).
+
+    Returns combined ``(indices int32, values float32, indptr int64)``.
+    """
+    indices = np.asarray(indices)
+    if indices.dtype.kind != "i":
+        indices = indices.astype(np.int64)
+    values = np.asarray(values, dtype=np.float32)
+    indptr = np.asarray(indptr, dtype=np.int64)
+    n = len(indptr) - 1
+    nnz = int(indptr[-1]) if n else 0
+    if nnz == 0:
+        return (
+            np.zeros(0, dtype=np.int32),
+            np.zeros(0, dtype=np.float32),
+            np.zeros(n + 1, dtype=np.int64),
+        )
+    counts = np.diff(indptr)
+    all_ones = bool((values == np.float32(1.0)).all())
+    K = int(counts.max())
+    if (
+        all_ones
+        and int(indices.max()) < 2**31 - 1
+        and K < (1 << 24)  # run lengths stay exact in f32
+        and n * (K + 1) <= 2 * nnz + 4096  # padding waste bounded
+    ):
+        # All-ones columns (hashed text, the hot path): group values are just
+        # run lengths, so the expensive global (row, index) radix sort
+        # collapses to a per-row np.sort over a padded int32 matrix — an
+        # order of magnitude cheaper on short rows.
+        return _combine_ones_padded(indices, values, indptr, counts, K, sum_collisions)
+    # One stable sort over (row, index) keys groups duplicates per row while
+    # preserving original occurrence order inside each group. Integer keys
+    # take numpy's radix path, so this is bandwidth- not comparison-bound.
+    span = int(indices.max()) + 1
+    key = np.repeat(np.arange(n, dtype=np.int64), counts) * span
+    key += indices
+    order = np.argsort(key, kind="stable")
+    sk = key[order]
+    newgrp = np.ones(nnz, dtype=bool)
+    np.not_equal(sk[1:], sk[:-1], out=newgrp[1:])
+    gstart = np.flatnonzero(newgrp)  # group start positions, sorted order
+    n_groups = len(gstart)
+    sk_g = sk[gstart]
+    grp_row = sk_g // span
+    idx_g = sk_g - grp_row * span
+    ucounts = np.bincount(grp_row, minlength=n)
+    has_dup = ucounts < counts
+    if not has_dup.any():
+        # fast path: nothing to combine, just trim exact zeros
+        out_idx, out_val, out_counts = indices, values, counts
+    else:
+        # Duplicate-free rows have ucounts == counts, so the group stream IS
+        # the output; they just get an original-order overwrite afterwards
+        # (their groups are singletons, but sorted, not occurrence-ordered).
+        out_counts = ucounts
+        out_indptr = np.zeros(n + 1, dtype=np.int64)
+        np.cumsum(ucounts, out=out_indptr[1:])
+        out_idx = idx_g
+        if not sum_collisions:
+            out_val = values[order[gstart]]  # stable sort => first occurrence
+        elif all_ones:
+            # each group sums to its size, exact in f32
+            out_val = np.diff(np.append(gstart, nnz)).astype(np.float32)
+        else:
+            gid = np.cumsum(newgrp) - 1
+            out_val = np.zeros(n_groups, dtype=np.float32)
+            np.add.at(out_val, gid, values[order])  # f32 accumulate, like from_lists
+        # duplicate-free rows: restore original entry order, O(their nnz)
+        nd_rows = np.flatnonzero(~has_dup)
+        c_nd = counts[nd_rows]
+        tot_nd = int(c_nd.sum())
+        if tot_nd:
+            seg = np.arange(tot_nd, dtype=np.int64) - np.repeat(
+                np.cumsum(c_nd) - c_nd, c_nd
+            )
+            src = np.repeat(indptr[nd_rows], c_nd) + seg
+            dst = np.repeat(out_indptr[nd_rows], c_nd) + seg
+            out_idx[dst] = indices[src]
+            out_val[dst] = values[src]
+    if np.count_nonzero(out_val) == len(out_val):
+        # no exact-zero values to trim
+        final_indptr = np.zeros(n + 1, dtype=np.int64)
+        np.cumsum(out_counts, out=final_indptr[1:])
+        return out_idx.astype(np.int32, copy=False), out_val.astype(np.float32, copy=False), final_indptr
+    keep = out_val != 0
+    out_row = np.repeat(np.arange(n, dtype=np.int64), out_counts)
+    final_indptr = np.zeros(n + 1, dtype=np.int64)
+    np.cumsum(np.bincount(out_row[keep], minlength=n).astype(np.int64), out=final_indptr[1:])
+    return out_idx[keep].astype(np.int32, copy=False), out_val[keep].astype(np.float32, copy=False), final_indptr
+
+
 def dense_to_batch(dense: np.ndarray, dim: int) -> SparseBatch:
     """View a dense (N, F) matrix as a SparseBatch whose feature j is index j.
     ``dim`` must be > F; the extra tail slots are free for e.g. a bias term."""
@@ -232,7 +563,14 @@ def dense_to_batch(dense: np.ndarray, dim: int) -> SparseBatch:
 
 
 def column_to_batch(column: np.ndarray, dim: int) -> SparseBatch:
-    """Interpret an object column of (indices, values) tuples as a SparseBatch."""
+    """Interpret a sparse column as a SparseBatch. :class:`SparseRows`
+    columns (already duplicate-combined by construction) pad with one
+    scatter; legacy object columns of (indices, values) tuples fall back to
+    the per-row ``from_lists`` assembly."""
+    if isinstance(column, SparseRows):
+        return SparseBatch.from_csr(
+            column.indices, column.values, column.indptr, dim
+        )
     idx_lists = [np.asarray(x[0]) for x in column]
     val_lists = [np.asarray(x[1]) for x in column]
     return from_lists(idx_lists, val_lists, dim)

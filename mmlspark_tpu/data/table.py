@@ -23,11 +23,13 @@ from typing import Any, Callable, Dict, Iterator, List, Mapping, Optional, Seque
 
 import numpy as np
 
+from mmlspark_tpu.data.sparse import SparseRows
+
 ColumnLike = Union[np.ndarray, Sequence[Any]]
 
 
 def _as_column(values: ColumnLike) -> np.ndarray:
-    if isinstance(values, np.ndarray):
+    if isinstance(values, (np.ndarray, SparseRows)):
         return values
     try:
         import jax
@@ -248,6 +250,15 @@ class Table:
         cols = {}
         for n in names:
             parts = [t.column(n) for t in tables]
+            if any(isinstance(p, SparseRows) for p in parts):
+                if all(isinstance(p, SparseRows) for p in parts):
+                    cols[n] = SparseRows.concat(parts)
+                    continue
+                # mixed with legacy tuple columns: fall back to object merge
+                parts = [
+                    p.to_object_column() if isinstance(p, SparseRows) else p
+                    for p in parts
+                ]
             if any(p.dtype == object for p in parts):
                 merged = np.empty(sum(len(p) for p in parts), dtype=object)
                 i = 0
@@ -314,7 +325,12 @@ class Table:
     def to_pandas(self) -> Any:
         import pandas as pd
 
-        return pd.DataFrame({k: list(v) if v.ndim > 1 else v for k, v in self._columns.items()})
+        return pd.DataFrame(
+            {
+                k: list(v) if (v.ndim > 1 or isinstance(v, SparseRows)) else v
+                for k, v in self._columns.items()
+            }
+        )
 
     def to_dict(self) -> Dict[str, np.ndarray]:
         return dict(self._columns)

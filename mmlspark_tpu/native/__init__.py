@@ -1,0 +1,274 @@
+"""ctypes bindings for the host-side C++ library (``native/``).
+
+The reference loads its native hot loops from jar-shipped shared objects
+(``core/env/NativeLoader.java``); here the ``.so`` is built by
+``make -C native`` and discovered next to the repo (or via
+``MMLSPARK_TPU_NATIVE`` for installed layouts). Every entry point has a
+numpy fallback, so the library is an acceleration, not a dependency:
+
+- :func:`apply_bins_native` — float64 features -> uint8 bins
+  (bit-identical to ``lightgbm.binning.apply_bins``);
+- :func:`murmur3_bytes_native` / :func:`murmur3_ints_native` /
+  :func:`murmur3_strings_native` — MurmurHash3 matching ``ops.hashing``
+  (the strings entry hashes a whole packed array of byte strings per call).
+
+Set ``MMLSPARK_TPU_NATIVE=off`` to force the numpy fallbacks (CI runs the
+suite both ways so the fallback path stays load-bearing).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional
+
+import numpy as np
+
+_LIB: Optional[ctypes.CDLL] = None
+_LOAD_ATTEMPTED = False
+
+#: MMLSPARK_TPU_NATIVE values that force the numpy fallback paths.
+_DISABLE_VALUES = ("off", "0", "disable", "disabled", "none")
+
+
+def _repo_root() -> str:
+    here = os.path.dirname(os.path.abspath(__file__))  # mmlspark_tpu/native/
+    return os.path.dirname(os.path.dirname(here))
+
+
+def native_disabled() -> bool:
+    return os.environ.get("MMLSPARK_TPU_NATIVE", "").lower() in _DISABLE_VALUES
+
+
+def _candidate_paths():
+    env = os.environ.get("MMLSPARK_TPU_NATIVE")
+    if env:
+        yield env
+    yield os.path.join(_repo_root(), "native", "libmmlspark_native.so")
+
+
+def load_library(path: Optional[str] = None) -> Optional[ctypes.CDLL]:
+    """Load the native library; None when unavailable. Auto-discovery is
+    memoized; an explicit ``path`` always loads fresh (so ``build`` can
+    swap in a rebuilt .so) and never poisons later auto-discovery."""
+    global _LIB, _LOAD_ATTEMPTED
+    if native_disabled():
+        return None
+    if path is None:
+        if _LIB is not None:
+            return _LIB
+        if _LOAD_ATTEMPTED:
+            return None
+        _LOAD_ATTEMPTED = True
+        paths = list(_candidate_paths())
+    else:
+        paths = [path]
+    for p in paths:
+        if p and os.path.exists(p):
+            lib = ctypes.CDLL(p)
+            lib.apply_bins_u8.argtypes = [
+                ctypes.POINTER(ctypes.c_double), ctypes.c_int64, ctypes.c_int64,
+                ctypes.POINTER(ctypes.c_double), ctypes.c_int64,
+                ctypes.POINTER(ctypes.c_uint8), ctypes.c_int32,
+            ]
+            lib.apply_bins_u8.restype = None
+            lib.murmur3_x86_32.argtypes = [
+                ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64, ctypes.c_uint32,
+            ]
+            lib.murmur3_x86_32.restype = ctypes.c_uint32
+            lib.murmur3_ints_u32.argtypes = [
+                ctypes.POINTER(ctypes.c_uint32), ctypes.c_int64,
+                ctypes.c_uint32, ctypes.POINTER(ctypes.c_uint32),
+            ]
+            lib.murmur3_ints_u32.restype = None
+            # Older prebuilt .so may predate the strings entry; probe so a
+            # stale library degrades to the numpy fallback instead of an
+            # AttributeError at call time.
+            try:
+                lib.murmur3_strings_u32.argtypes = [
+                    ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64,
+                    ctypes.POINTER(ctypes.c_uint8),
+                    ctypes.POINTER(ctypes.c_int64),
+                    ctypes.POINTER(ctypes.c_int32),
+                    ctypes.c_int64, ctypes.c_uint32,
+                    ctypes.POINTER(ctypes.c_uint32),
+                ]
+                lib.murmur3_strings_u32.restype = None
+            except AttributeError:
+                lib.murmur3_strings_u32 = None
+            try:
+                lib.murmur3_split_hash_u32.argtypes = [
+                    ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64,
+                    ctypes.POINTER(ctypes.c_uint8),
+                    ctypes.POINTER(ctypes.c_int64),
+                    ctypes.POINTER(ctypes.c_int64),
+                    ctypes.c_int64, ctypes.c_uint32,
+                    ctypes.POINTER(ctypes.c_uint32),
+                    ctypes.POINTER(ctypes.c_int64),
+                    ctypes.POINTER(ctypes.c_uint8),
+                ]
+                lib.murmur3_split_hash_u32.restype = ctypes.c_int64
+            except AttributeError:
+                lib.murmur3_split_hash_u32 = None
+            _LIB = lib
+            return lib
+    return None
+
+
+def native_available() -> bool:
+    return load_library() is not None
+
+
+def build(repo_root: Optional[str] = None) -> str:
+    """Compile the library with the in-tree Makefile (g++ required)."""
+    root = repo_root or _repo_root()
+    native_dir = os.path.join(root, "native")
+    subprocess.run(["make", "-C", native_dir], check=True, capture_output=True)
+    global _LIB, _LOAD_ATTEMPTED
+    _LIB = None  # drop any stale handle so the rebuilt .so takes over
+    _LOAD_ATTEMPTED = False
+    path = os.path.join(native_dir, "libmmlspark_native.so")
+    if load_library(path) is None:
+        raise RuntimeError(f"built {path} but could not load it")
+    return path
+
+
+# -- entry points (native with numpy fallback) -------------------------------
+
+
+def apply_bins_native(X: np.ndarray, edges: np.ndarray, max_bin: int) -> Optional[np.ndarray]:
+    """uint8 bins via C++; None when the library is unavailable or shapes
+    exceed its contract (edges per feature must fit the 256-slot buffer)."""
+    lib = load_library()
+    if lib is None or edges.shape[1] > 256:
+        return None
+    Xc = np.ascontiguousarray(X, dtype=np.float64)
+    ec = np.ascontiguousarray(edges, dtype=np.float64)
+    n, f = Xc.shape
+    if ec.shape[0] != f:
+        raise ValueError(f"edges rows {ec.shape[0]} != features {f}")
+    out = np.empty((n, f), dtype=np.uint8)
+    lib.apply_bins_u8(
+        Xc.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        ctypes.c_int64(n), ctypes.c_int64(f),
+        ec.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        ctypes.c_int64(ec.shape[1]),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        ctypes.c_int32(max_bin),
+    )
+    return out
+
+
+def murmur3_bytes_native(data: bytes, seed: int = 0) -> Optional[int]:
+    lib = load_library()
+    if lib is None:
+        return None
+    buf = (ctypes.c_uint8 * len(data)).from_buffer_copy(data) if data else (ctypes.c_uint8 * 1)()
+    return int(
+        lib.murmur3_x86_32(
+            ctypes.cast(buf, ctypes.POINTER(ctypes.c_uint8)),
+            ctypes.c_int64(len(data)),
+            ctypes.c_uint32(seed & 0xFFFFFFFF),
+        )
+    )
+
+
+def murmur3_strings_native(
+    buf: np.ndarray,
+    starts: np.ndarray,
+    lens: np.ndarray,
+    seed: int = 0,
+    prefix: bytes = b"",
+) -> Optional[np.ndarray]:
+    """Hash a packed array of byte strings (string i is
+    ``buf[starts[i] : starts[i] + lens[i]]``) with ``prefix`` virtually
+    prepended to each — ONE library call per featurizer column. None when
+    the library is unavailable."""
+    lib = load_library()
+    if lib is None or getattr(lib, "murmur3_strings_u32", None) is None:
+        return None
+    bc = np.ascontiguousarray(buf, dtype=np.uint8)
+    sc = np.ascontiguousarray(starts, dtype=np.int64)
+    lc = np.ascontiguousarray(lens, dtype=np.int32)
+    if sc.shape != lc.shape:
+        raise ValueError(f"starts shape {sc.shape} != lens shape {lc.shape}")
+    pbuf = (
+        (ctypes.c_uint8 * len(prefix)).from_buffer_copy(prefix)
+        if prefix
+        else (ctypes.c_uint8 * 1)()
+    )
+    out = np.empty(sc.size, dtype=np.uint32)
+    lib.murmur3_strings_u32(
+        ctypes.cast(pbuf, ctypes.POINTER(ctypes.c_uint8)),
+        ctypes.c_int64(len(prefix)),
+        bc.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        sc.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        lc.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        ctypes.c_int64(sc.size),
+        ctypes.c_uint32(seed & 0xFFFFFFFF),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+    )
+    return out
+
+
+def murmur3_split_hash_native(
+    buf: np.ndarray,
+    row_starts: np.ndarray,
+    row_lens: np.ndarray,
+    seed: int = 0,
+    prefix: bytes = b"",
+):
+    """Fused whitespace-split + murmur over packed string-column rows: ONE
+    C pass replaces the numpy splitter's full-buffer mask passes and the
+    separate batch hash call. Returns ``(hashes uint32, counts int64,
+    suspect uint8)`` — per-row token counts, with rows that may contain
+    non-ASCII whitespace flagged (count 0) for a Python re-split. None when
+    the library lacks the entry."""
+    lib = load_library()
+    if lib is None or getattr(lib, "murmur3_split_hash_u32", None) is None:
+        return None
+    bc = np.ascontiguousarray(buf, dtype=np.uint8)
+    sc = np.ascontiguousarray(row_starts, dtype=np.int64)
+    lc = np.ascontiguousarray(row_lens, dtype=np.int64)
+    if sc.shape != lc.shape:
+        raise ValueError(f"row_starts shape {sc.shape} != row_lens shape {lc.shape}")
+    if bc.size == 0:
+        bc = np.zeros(1, dtype=np.uint8)  # keep the data pointer valid
+    pbuf = (
+        (ctypes.c_uint8 * len(prefix)).from_buffer_copy(prefix)
+        if prefix
+        else (ctypes.c_uint8 * 1)()
+    )
+    max_tokens = (int(lc.sum()) + sc.size) // 2 + 1
+    hashes = np.empty(max_tokens, dtype=np.uint32)
+    counts = np.empty(sc.size, dtype=np.int64)
+    suspect = np.empty(sc.size, dtype=np.uint8)
+    total = lib.murmur3_split_hash_u32(
+        ctypes.cast(pbuf, ctypes.POINTER(ctypes.c_uint8)),
+        ctypes.c_int64(len(prefix)),
+        bc.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        sc.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        lc.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        ctypes.c_int64(sc.size),
+        ctypes.c_uint32(seed & 0xFFFFFFFF),
+        hashes.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+        counts.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        suspect.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+    )
+    return hashes[:total], counts, suspect
+
+
+def murmur3_ints_native(values: np.ndarray, seed: int = 0) -> Optional[np.ndarray]:
+    lib = load_library()
+    if lib is None:
+        return None
+    vc = np.ascontiguousarray(values, dtype=np.uint32)
+    out = np.empty(vc.shape, dtype=np.uint32)
+    lib.murmur3_ints_u32(
+        vc.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+        ctypes.c_int64(vc.size),
+        ctypes.c_uint32(seed & 0xFFFFFFFF),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+    )
+    return out.reshape(values.shape)

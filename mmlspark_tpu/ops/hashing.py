@@ -67,6 +67,20 @@ def _fmix(h: np.ndarray) -> np.ndarray:
     return h ^ (h >> np.uint32(16))
 
 
+def _coerce_u32(values) -> np.ndarray:
+    """One dtype-coercion rule for integer hashing, shared by the native and
+    numpy paths: round toward the int64 grid first, then reinterpret as
+    uint32. Without the int64 hop, float inputs hit C float->unsigned
+    conversion (undefined for negatives and platform-dependent), so
+    ``murmur32_ints(np.zeros(1))`` (float64) and
+    ``murmur32_ints(np.zeros(1, np.uint32))`` could diverge between paths."""
+    arr = np.asarray(values)
+    if arr.dtype == np.uint32:
+        return arr
+    with np.errstate(over="ignore", invalid="ignore"):
+        return arr.astype(np.int64).astype(np.uint32)
+
+
 def murmur32_ints(values: np.ndarray, seed: int = 0) -> np.ndarray:
     """Hash each int32/uint32 value as a 4-byte murmur3 block (VW's
     ``hash_uniform`` over integer feature ids). Dispatches to the host C++
@@ -74,11 +88,11 @@ def murmur32_ints(values: np.ndarray, seed: int = 0) -> np.ndarray:
     _require_host(values)
     from mmlspark_tpu.native import murmur3_ints_native
 
-    native = murmur3_ints_native(np.asarray(values), seed)
+    k = _coerce_u32(values)
+    native = murmur3_ints_native(k, seed)
     if native is not None:
         return native
     with np.errstate(over="ignore"):
-        k = np.asarray(values, dtype=np.uint32)
         h = np.full(k.shape, np.uint32(seed & 0xFFFFFFFF), dtype=np.uint32)
         h = _mix_h(h, _mix_k(k))
         h = h ^ np.uint32(4)  # length
@@ -110,6 +124,87 @@ def murmur32_bytes(data: bytes, seed: int = 0) -> int:
         return int(_fmix(h))
 
 
+def batch_hash_is_native() -> bool:
+    """True when :func:`murmur32_bytes_batch` will dispatch to the C++
+    array-of-strings entry — callers use this to decide whether host-side
+    token dedup is worth its sort (it never is when the C path is one call)."""
+    from mmlspark_tpu.native import load_library
+
+    lib = load_library()
+    return lib is not None and getattr(lib, "murmur3_strings_u32", None) is not None
+
+
+def murmur32_bytes_batch(
+    buf: np.ndarray,
+    starts: np.ndarray,
+    lens: np.ndarray,
+    seed: int = 0,
+    prefix: bytes = b"",
+) -> np.ndarray:
+    """murmur3_x86_32 over an ARRAY of byte strings packed in one buffer:
+    string i is ``buf[starts[i] : starts[i] + lens[i]]``, with ``prefix``
+    virtually prepended to every string (the namespace/column-name prefix —
+    never materialized per token). This is the batch entry the VW featurizer
+    hashes whole columns through: one native call when the C++ library is
+    built, otherwise a vectorized numpy block mixer that walks murmur's
+    4-byte blocks across all strings at once — no per-token Python.
+
+    Exactly equal to ``murmur32_bytes(prefix + s, seed)`` for every string.
+    """
+    from mmlspark_tpu.native import murmur3_strings_native
+
+    starts = np.asarray(starts, dtype=np.int64)
+    lens = np.asarray(lens, dtype=np.int64)
+    if starts.size == 0:
+        return np.zeros(0, dtype=np.uint32)
+    native = murmur3_strings_native(buf, starts, lens, seed, prefix)
+    if native is not None:
+        return native
+
+    buf = np.ascontiguousarray(buf, dtype=np.uint8)
+    if buf.size == 0:
+        buf = np.zeros(1, dtype=np.uint8)  # keep masked gathers in-bounds
+    pre = np.frombuffer(prefix, dtype=np.uint8)
+    P = len(prefix)
+    last = buf.size - 1
+    with np.errstate(over="ignore"):
+        total = lens + P
+        h = np.full(starts.shape, np.uint32(seed & 0xFFFFFFFF), dtype=np.uint32)
+        # Whole 4-byte blocks of prefix+string, one vectorized pass per block
+        # position. Position p = 4*b + j is the same scalar for every string,
+        # so prefix bytes (p < P) mix in as scalars — only string bytes
+        # gather. Strings too short for block b keep their state via where().
+        for b in range(int(total.max()) // 4):
+            active = total >= 4 * (b + 1)
+            if not active.any():
+                break
+            k = np.zeros(starts.shape, dtype=np.uint32)
+            for j in range(4):
+                p = 4 * b + j
+                if p < P:
+                    k |= np.uint32(pre[p]) << np.uint32(8 * j)
+                else:
+                    g = buf[np.minimum(starts + (p - P), last)]
+                    k |= np.where(active, g, 0).astype(np.uint32) << np.uint32(8 * j)
+            h = np.where(active, _mix_h(h, _mix_k(k)), h)
+        # 1-3 byte tails (per-string tail offsets differ, so prefix bytes can
+        # land in a tail too when P % 4 != 0 and the string is short).
+        tail_len = (total & 3).astype(np.int64)
+        tail_base = total - tail_len
+        k = np.zeros(starts.shape, dtype=np.uint32)
+        for j in range(3):
+            has = tail_len > j
+            p = tail_base + j
+            g = buf[np.minimum(np.maximum(starts + (p - P), 0), last)]
+            if P:
+                from_pre = pre[np.minimum(np.maximum(p, 0), P - 1)]
+                g = np.where(p < P, from_pre, g)
+            k = np.where(has, k ^ (g.astype(np.uint32) << np.uint32(8 * j)), k)
+        h = np.where(tail_len > 0, h ^ _mix_k(k), h)
+        h = h ^ total.astype(np.uint32)
+        return _fmix(h)
+
+
 def murmur32_strings(
     values: Iterable[str], seed: int = 0, cache: Optional[dict] = None
 ) -> np.ndarray:
@@ -135,4 +230,6 @@ def namespace_seed(namespace: str, seed: int = 0) -> int:
 
 
 def mask_bits(h: np.ndarray, num_bits: int) -> np.ndarray:
-    return (h & np.uint32((1 << num_bits) - 1)).astype(np.int32)
+    # masked values fit in 30 bits (num_bits <= 30), so the int32 reinterpret
+    # is free and exact — no astype copy
+    return (h & np.uint32((1 << num_bits) - 1)).view(np.int32)

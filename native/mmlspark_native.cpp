@@ -203,6 +203,185 @@ uint32_t murmur3_x86_32(const uint8_t* data, int64_t len, uint32_t seed) {
   return fmix32(h1);
 }
 
+// Array-of-strings murmur: hash `count` byte strings packed into one buffer
+// (string i occupies buf[starts[i] .. starts[i]+lens[i])), with an optional
+// namespace/column prefix virtually prepended to every string — the VW
+// featurizer's "column-name + token" hashing without materializing count
+// concatenated strings. One call per column replaces the per-token ctypes
+// round-trip that dominated vw_text_bench host time.
+static inline uint32_t mix_k1(uint32_t k1) {
+  k1 *= 0xcc9e2d51u;
+  k1 = rotl32(k1, 15);
+  return k1 * 0x1b873593u;
+}
+
+static inline uint32_t mix_h1(uint32_t h1, uint32_t k1) {
+  h1 ^= k1;
+  h1 = rotl32(h1, 13);
+  return h1 * 5 + 0xe6546b64u;
+}
+
+// murmur3_x86_32 of the concatenation a+b without copying: byte-at-a-time
+// block assembly across the segment boundary (only used when the prefix
+// length is not a multiple of 4).
+static uint32_t murmur3_concat2(const uint8_t* a, int64_t la,
+                                const uint8_t* b, int64_t lb, uint32_t seed) {
+  const int64_t total = la + lb;
+  const int64_t nblocks = total / 4;
+  uint32_t h1 = seed;
+  for (int64_t i = 0; i < nblocks; ++i) {
+    uint32_t k1 = 0;
+    for (int64_t j = 0; j < 4; ++j) {
+      const int64_t p = i * 4 + j;
+      const uint8_t byte = p < la ? a[p] : b[p - la];
+      k1 |= static_cast<uint32_t>(byte) << (8 * j);
+    }
+    h1 = mix_h1(h1, mix_k1(k1));
+  }
+  uint32_t k1 = 0;
+  for (int64_t p = nblocks * 4; p < total; ++p) {
+    const uint8_t byte = p < la ? a[p] : b[p - la];
+    k1 |= static_cast<uint32_t>(byte) << (8 * (p & 3));
+  }
+  if (total & 3) h1 ^= mix_k1(k1);
+  h1 ^= static_cast<uint32_t>(total);
+  return fmix32(h1);
+}
+
+void murmur3_strings_u32(const uint8_t* prefix, int64_t prefix_len,
+                         const uint8_t* buf, const int64_t* starts,
+                         const int32_t* lens, int64_t count, uint32_t seed,
+                         uint32_t* out) {
+  if (prefix_len % 4 == 0) {
+    // Aligned prefix (including the empty one): fold its whole blocks into
+    // the seed state ONCE, then each string continues block-aligned — the
+    // VowpalWabbitMurmurWithPrefix trick, but for a packed batch.
+    uint32_t h_pref = seed;
+    for (int64_t i = 0; i < prefix_len / 4; ++i) {
+      uint32_t k1;
+      std::memcpy(&k1, prefix + i * 4, 4);
+      h_pref = mix_h1(h_pref, mix_k1(k1));
+    }
+    for (int64_t s = 0; s < count; ++s) {
+      const uint8_t* data = buf + starts[s];
+      const int64_t len = lens[s];
+      const int64_t nblocks = len / 4;
+      uint32_t h1 = h_pref;
+      for (int64_t i = 0; i < nblocks; ++i) {
+        uint32_t k1;
+        std::memcpy(&k1, data + i * 4, 4);
+        h1 = mix_h1(h1, mix_k1(k1));
+      }
+      uint32_t k1 = 0;
+      switch (len & 3) {
+        case 3:
+          k1 ^= static_cast<uint32_t>(data[nblocks * 4 + 2]) << 16;
+          [[fallthrough]];
+        case 2:
+          k1 ^= static_cast<uint32_t>(data[nblocks * 4 + 1]) << 8;
+          [[fallthrough]];
+        case 1:
+          k1 ^= data[nblocks * 4];
+          h1 ^= mix_k1(k1);
+      }
+      h1 ^= static_cast<uint32_t>(prefix_len + len);
+      out[s] = fmix32(h1);
+    }
+    return;
+  }
+  for (int64_t s = 0; s < count; ++s) {
+    out[s] = murmur3_concat2(prefix, prefix_len, buf + starts[s], lens[s], seed);
+  }
+}
+
+// Fused whitespace-split + murmur for string columns: one pass over the
+// packed row bytes replaces the numpy splitter's ~8 full-buffer passes
+// (whitespace LUT gather, shifted masks, two flatnonzero) AND the separate
+// hashing call. Rows are split on the ASCII bytes str.split() treats as
+// whitespace; each token hashes as prefix+token from `seed`. Rows containing
+// a byte that can START a non-ASCII whitespace code point in utf-8 (0xC2,
+// 0xE1, 0xE2, 0xE3) emit no tokens and set out_suspect[r]=1 — the caller
+// re-splits those few rows with Python str.split for exactness. Returns the
+// total token count written to out_hashes (caller allocates the worst case:
+// (buf_len + n_rows) / 2 + 1 tokens).
+int64_t murmur3_split_hash_u32(const uint8_t* prefix, int64_t prefix_len,
+                               const uint8_t* buf, const int64_t* row_starts,
+                               const int64_t* row_lens, int64_t n_rows,
+                               uint32_t seed, uint32_t* out_hashes,
+                               int64_t* out_counts, uint8_t* out_suspect) {
+  bool ws[256] = {false};
+  ws[9] = ws[10] = ws[11] = ws[12] = ws[13] = true;
+  ws[28] = ws[29] = ws[30] = ws[31] = ws[32] = true;
+  bool sus[256] = {false};
+  sus[0xC2] = sus[0xE1] = sus[0xE2] = sus[0xE3] = true;
+  const bool aligned = (prefix_len % 4) == 0;
+  uint32_t h_pref = seed;
+  if (aligned) {
+    for (int64_t i = 0; i < prefix_len / 4; ++i) {
+      uint32_t k1;
+      std::memcpy(&k1, prefix + i * 4, 4);
+      h_pref = mix_h1(h_pref, mix_k1(k1));
+    }
+  }
+  int64_t t = 0;
+  for (int64_t r = 0; r < n_rows; ++r) {
+    const uint8_t* p = buf + row_starts[r];
+    const int64_t L = row_lens[r];
+    const int64_t t_row = t;
+    bool flagged = false;
+    int64_t i = 0;
+    while (i < L) {
+      while (i < L && ws[p[i]]) ++i;  // whitespace bytes are never suspect
+      if (i >= L) break;
+      const int64_t tok0 = i;
+      while (i < L && !ws[p[i]]) {
+        if (sus[p[i]]) {
+          flagged = true;
+          break;
+        }
+        ++i;
+      }
+      if (flagged) break;
+      const int64_t len = i - tok0;
+      const uint8_t* data = p + tok0;
+      if (aligned) {
+        const int64_t nblocks = len / 4;
+        uint32_t h1 = h_pref;
+        for (int64_t b = 0; b < nblocks; ++b) {
+          uint32_t k1;
+          std::memcpy(&k1, data + b * 4, 4);
+          h1 = mix_h1(h1, mix_k1(k1));
+        }
+        uint32_t k1 = 0;
+        switch (len & 3) {
+          case 3:
+            k1 ^= static_cast<uint32_t>(data[nblocks * 4 + 2]) << 16;
+            [[fallthrough]];
+          case 2:
+            k1 ^= static_cast<uint32_t>(data[nblocks * 4 + 1]) << 8;
+            [[fallthrough]];
+          case 1:
+            k1 ^= data[nblocks * 4];
+            h1 ^= mix_k1(k1);
+        }
+        h1 ^= static_cast<uint32_t>(prefix_len + len);
+        out_hashes[t++] = fmix32(h1);
+      } else {
+        out_hashes[t++] = murmur3_concat2(prefix, prefix_len, data, len, seed);
+      }
+    }
+    if (flagged) {
+      t = t_row;  // roll back this row's tokens; Python re-splits it
+      out_counts[r] = 0;
+      out_suspect[r] = 1;
+    } else {
+      out_counts[r] = t - t_row;
+      out_suspect[r] = 0;
+    }
+  }
+  return t;
+}
+
 // Hash each uint32 as one 4-byte block (VW integer-feature hashing);
 // vectorized over `count` values.
 void murmur3_ints_u32(const uint32_t* values, int64_t count, uint32_t seed,

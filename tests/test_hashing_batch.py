@@ -1,0 +1,122 @@
+"""Property tests for the batched murmur path (ops.hashing.murmur32_bytes_batch)
+and the integer dtype-coercion contract — native and numpy-fallback paths must
+agree with the scalar reference on every input, or the VW feature space
+silently shifts between environments."""
+
+import numpy as np
+import pytest
+
+import mmlspark_tpu.native as native_mod
+from mmlspark_tpu.ops.hashing import (
+    _coerce_u32,
+    murmur32_bytes,
+    murmur32_bytes_batch,
+    murmur32_ints,
+)
+
+
+def _pack(tokens):
+    bs = [t.encode("utf-8") for t in tokens]
+    lens = np.array([len(b) for b in bs], dtype=np.int64)
+    starts = np.zeros(len(bs), dtype=np.int64)
+    np.cumsum(lens[:-1], out=starts[1:])
+    return np.frombuffer(b"".join(bs), dtype=np.uint8), starts, lens
+
+
+def _random_tokens(rng, count):
+    """Unicode strings covering 1-3 byte utf-8 tails, empty strings, and
+    multi-byte codepoints (2, 3, and 4 byte encodings)."""
+    pieces = list("abcdefgh 0123") + ["é", "ß", "χ", "漢", "字", "™", "𝔘", "🎉"]
+    return [
+        "".join(rng.choice(pieces, size=int(rng.integers(0, 14))))
+        for _ in range(count)
+    ]
+
+
+@pytest.fixture(params=["native", "fallback"])
+def hash_path(request, monkeypatch):
+    """Run the test body under both dispatch paths. The native param skips
+    when no library is loadable (fallback still runs)."""
+    if request.param == "native":
+        if native_mod.load_library() is None:
+            pytest.skip("native library unavailable")
+    else:
+        monkeypatch.setattr(native_mod, "_LIB", None)
+        monkeypatch.setattr(native_mod, "_LOAD_ATTEMPTED", True)
+    return request.param
+
+
+class TestBatchedMurmurProperty:
+    @pytest.mark.parametrize("seed", [0, 1, 0xCAFEBABE])
+    @pytest.mark.parametrize("prefix", [b"", b"x", b"ns", b"col", b"abcd", b"colname!"])
+    def test_batch_equals_scalar_on_random_unicode(self, hash_path, seed, prefix):
+        rng = np.random.default_rng(seed + len(prefix))
+        tokens = _random_tokens(rng, 200)
+        buf, starts, lens = _pack(tokens)
+        got = murmur32_bytes_batch(buf, starts, lens, seed, prefix)
+        want = np.array(
+            [murmur32_bytes(prefix + t.encode("utf-8"), seed) for t in tokens],
+            dtype=np.uint32,
+        )
+        np.testing.assert_array_equal(got, want)
+
+    def test_edge_tokens(self, hash_path):
+        """Empty string, 1-3 byte tails, embedded NULs, 4-byte codepoints."""
+        tokens = ["", "a", "ab", "abc", "abcd", "\x00", "a\x00b", "🎉", "é™", "x" * 65]
+        buf, starts, lens = _pack(tokens)
+        got = murmur32_bytes_batch(buf, starts, lens, 3, b"p!")
+        want = np.array(
+            [murmur32_bytes(b"p!" + t.encode("utf-8"), 3) for t in tokens],
+            dtype=np.uint32,
+        )
+        np.testing.assert_array_equal(got, want)
+
+    def test_golden_row_tokens_match_scalar(self, hash_path):
+        """The exact tokens pinned by the featurizer golden fixture hash the
+        same through the batch entry as through the old per-token scalar."""
+        from tests.test_vw_featurizer_golden import golden_table
+
+        t = golden_table()
+        tokens = []
+        for v in t.column("text"):
+            if v is not None:
+                tokens.extend(v.split())
+        for v in t.column("tags"):
+            if v:
+                tokens.extend(str(x) for x in v)
+        buf, starts, lens = _pack(tokens)
+        for prefix in (b"", b"text", b"tags"):
+            got = murmur32_bytes_batch(buf, starts, lens, 0, prefix)
+            want = np.array(
+                [murmur32_bytes(prefix + tok.encode("utf-8"), 0) for tok in tokens],
+                dtype=np.uint32,
+            )
+            np.testing.assert_array_equal(got, want)
+
+    def test_empty_batch(self, hash_path):
+        z = np.zeros(0, dtype=np.int64)
+        out = murmur32_bytes_batch(np.zeros(0, dtype=np.uint8), z, z, 9, b"p")
+        assert out.size == 0 and out.dtype == np.uint32
+
+
+class TestIntDtypeCoercion:
+    def test_int_and_float_inputs_never_diverge(self, hash_path):
+        """murmur32_ints(float64 zeros) was fed straight to C, where
+        float->unsigned conversion is undefined for negatives; the int64 hop
+        makes every dtype land on the same uint32 grid in both paths."""
+        vals = [0.0, 1.0, -1.0, 2.0, 255.0, 4294967295.0, -2147483648.0]
+        as_f64 = np.array(vals, dtype=np.float64)
+        as_i64 = as_f64.astype(np.int64)
+        as_u32 = as_i64.astype(np.uint32)
+        h_f = murmur32_ints(as_f64, seed=5)
+        h_i = murmur32_ints(as_i64, seed=5)
+        h_u = murmur32_ints(as_u32, seed=5)
+        np.testing.assert_array_equal(h_f, h_i)
+        np.testing.assert_array_equal(h_f, h_u)
+
+    def test_coerce_u32_rule(self):
+        np.testing.assert_array_equal(
+            _coerce_u32(np.array([0.0, -1.0, 2.5])),
+            np.array([0, 4294967295, 2], dtype=np.uint32),
+        )
+        assert _coerce_u32(np.zeros(3, dtype=np.uint32)).dtype == np.uint32

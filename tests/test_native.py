@@ -13,8 +13,17 @@ from mmlspark_tpu.native import (
     build,
     murmur3_bytes_native,
     murmur3_ints_native,
+    murmur3_strings_native,
     native_available,
 )
+
+
+def _pack(tokens, encoding="utf-8"):
+    bs = [t.encode(encoding) for t in tokens]
+    lens = np.array([len(b) for b in bs], dtype=np.int64)
+    starts = np.zeros(len(bs), dtype=np.int64)
+    np.cumsum(lens[:-1], out=starts[1:])
+    return np.frombuffer(b"".join(bs), dtype=np.uint8), starts, lens
 
 
 @pytest.fixture(scope="module", autouse=True)
@@ -110,6 +119,46 @@ class TestMurmurParity:
             m.setattr(native_mod, "_LIB", None)
             m.setattr(native_mod, "_LOAD_ATTEMPTED", True)
             pure = murmur32_ints(vals, seed=7)
+        np.testing.assert_array_equal(native_vals, pure)
+
+
+class TestMurmurStringsParity:
+    """The array-of-strings entry (one call per featurizer column) must agree
+    byte-for-byte with the scalar bytes hash — prefixes of every alignment,
+    1-3 byte tails, empty strings, multi-byte codepoints."""
+
+    TOKENS = [
+        "", "a", "ab", "abc", "abcd", "abcde", "héllo", "wörld", "漢字", "™",
+        "χρώμα", "x" * 37, "the quick brown fox", "𝔘𝔫𝔦𝔠𝔬𝔡𝔢",
+    ]
+
+    @pytest.mark.parametrize("prefix", [b"", b"c", b"ns!", b"text", b"abcdefgh"])
+    @pytest.mark.parametrize("seed", [0, 7, 0xDEADBEEF])
+    def test_matches_scalar_bytes_hash(self, prefix, seed):
+        buf, starts, lens = _pack(self.TOKENS)
+        got = murmur3_strings_native(buf, starts, lens, seed, prefix)
+        assert got is not None
+        want = [
+            murmur3_bytes_native(prefix + t.encode("utf-8"), seed)
+            for t in self.TOKENS
+        ]
+        np.testing.assert_array_equal(got, np.array(want, dtype=np.uint32))
+
+    def test_random_strings_match_numpy_fallback(self, monkeypatch):
+        from mmlspark_tpu.ops.hashing import murmur32_bytes_batch
+
+        rng = np.random.default_rng(11)
+        alphabet = list("abc 01\t\n") + ["é", "漢", "™", "𝔘", " ", " "]
+        tokens = [
+            "".join(rng.choice(alphabet, size=rng.integers(0, 12)))
+            for _ in range(300)
+        ]
+        buf, starts, lens = _pack(tokens)
+        native_vals = murmur32_bytes_batch(buf, starts, lens, 5, b"pfx")
+        with monkeypatch.context() as m:
+            m.setattr(native_mod, "_LIB", None)
+            m.setattr(native_mod, "_LOAD_ATTEMPTED", True)
+            pure = murmur32_bytes_batch(buf, starts, lens, 5, b"pfx")
         np.testing.assert_array_equal(native_vals, pure)
 
 

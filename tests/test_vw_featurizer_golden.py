@@ -1,0 +1,156 @@
+"""Pinned VW featurizer feature-space goldens.
+
+``tests/fixtures/golden_matrix_vw.csv`` stores the EXACT (indices, values)
+the featurizer emits for a fixed table under a matrix of configs — string
+split/unsplit columns, string arrays, maps, numeric/bool/dense columns,
+collision-rich small spaces, both ``sumCollisions`` modes. The fixture was
+generated from the original per-row implementation; any rewrite (including
+the batched one) must reproduce it byte-for-byte, or the hashed feature
+space has silently shifted and every downstream model breaks.
+
+Regenerate (only when the feature space is INTENTIONALLY changed):
+
+    python tests/test_vw_featurizer_golden.py --regen
+"""
+
+import csv
+import os
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.data.table import Table
+from mmlspark_tpu.vw import VowpalWabbitFeaturizer
+
+GOLDEN = os.path.join(os.path.dirname(__file__), "fixtures", "golden_matrix_vw.csv")
+
+
+def golden_table() -> Table:
+    text = np.array(
+        [
+            "the quick brown fox",
+            "jumps over the lazy dog the the",
+            "meh",
+            "",
+            "héllo wörld 漢字 ™",
+            "dup dup dup",
+            "  spaced\ttabs\nnewline  ",
+            None,
+        ],
+        dtype=object,
+    )
+    tags = np.empty(8, dtype=object)
+    for i, v in enumerate(
+        [
+            ["red", "green", "blue"],
+            ["red", "red"],
+            [],
+            None,
+            ["solo"],
+            ["χρώμα", "色"],
+            ["x", "y", "z", "x"],
+            ["end"],
+        ]
+    ):
+        tags[i] = v
+    kv = np.empty(8, dtype=object)
+    for i, v in enumerate(
+        [
+            {"a": 1.0, "b": 2.0},
+            {},
+            None,
+            {"c": 0.5},
+            {"a": 1.0},
+            {"d": -1.0, "e": 4.0},
+            {"f": 2.25},
+            {"g": 1.0},
+        ]
+    ):
+        kv[i] = v
+    rng_vec = np.arange(24, dtype=np.float64).reshape(8, 3) * 0.25 - 2.0
+    return Table(
+        {
+            "text": text,
+            "tags": tags,
+            "kv": kv,
+            "num": np.array([1.5, -2.0, 0.0, 3.25, -0.5, 1024.0, 7.0, 0.125]),
+            "count": np.arange(1, 9, dtype=np.int32),
+            "flag": np.array([True, False, True, True, False, False, True, False]),
+            "vec": rng_vec,
+        }
+    )
+
+
+#: config name -> VowpalWabbitFeaturizer kwargs (inputCols included).
+GOLDEN_CONFIGS = {
+    "split": dict(inputCols=["text"], stringSplit=True, numBits=18),
+    "array": dict(inputCols=["tags"], numBits=18),
+    "nosplit": dict(inputCols=["text"], stringSplit=False, numBits=18),
+    "noprefix": dict(
+        inputCols=["text"], stringSplit=True, numBits=12,
+        prefixStringsWithColumnName=False, hashSeed=7,
+    ),
+    "nosum": dict(
+        inputCols=["text", "tags"], stringSplit=True, numBits=6,
+        sumCollisions=False,
+    ),
+    "lowbits_sum": dict(inputCols=["text", "tags"], stringSplit=True, numBits=4),
+    "mixed": dict(
+        inputCols=["num", "text", "vec", "flag", "kv", "count"],
+        stringSplit=True, numBits=18,
+    ),
+}
+
+
+def compute_rows():
+    t = golden_table()
+    out = []
+    for cfg, kwargs in GOLDEN_CONFIGS.items():
+        feats = VowpalWabbitFeaturizer(outputCol="features", **kwargs).transform(t)
+        col = feats.column("features")
+        for i in range(t.num_rows):
+            idx, val = col[i]
+            out.append(
+                {
+                    "cfg": cfg,
+                    "row": i,
+                    "indices": " ".join(str(int(x)) for x in idx),
+                    "values": " ".join("%.9g" % float(v) for v in val),
+                }
+            )
+    return out
+
+
+def test_feature_space_matches_golden():
+    if not os.path.exists(GOLDEN):
+        pytest.fail(f"golden fixture missing: {GOLDEN} (run --regen)")
+    with open(GOLDEN, newline="") as f:
+        golden = list(csv.DictReader(f))
+    computed = compute_rows()
+    assert len(golden) == len(computed)
+    for g, c in zip(golden, computed):
+        where = f"{c['cfg']} row {c['row']}"
+        assert g["cfg"] == c["cfg"] and int(g["row"]) == int(c["row"]), where
+        assert g["indices"] == c["indices"], f"{where}: index drift"
+        assert g["values"] == c["values"], f"{where}: value drift"
+
+
+def test_golden_covers_text_and_array_columns():
+    """The fixture must pin at least the two row families the rewrite can
+    silently shift: a string-split column and a string-array column."""
+    with open(GOLDEN, newline="") as f:
+        cfgs = {r["cfg"] for r in csv.DictReader(f)}
+    assert {"split", "array"} <= cfgs
+
+
+if __name__ == "__main__":
+    import sys
+
+    if "--regen" not in sys.argv:
+        sys.exit("refusing to overwrite golden without --regen")
+    rows = compute_rows()
+    with open(GOLDEN, "w", newline="") as f:
+        w = csv.DictWriter(f, fieldnames=["cfg", "row", "indices", "values"])
+        w.writeheader()
+        w.writerows(rows)
+    print(f"wrote {len(rows)} rows to {GOLDEN}")
