@@ -225,6 +225,12 @@ def _fit_cpu(X, y, Xt, max_bin=MAX_BIN, cat_idx=None):
 
 
 def main():
+    # the BENCH artifact carries its own attribution: per-program
+    # compile/execute timing and the roofline section ride in "profiler"
+    from mmlspark_tpu.observability.profiler import get_profiler
+
+    prof = get_profiler().enable()
+
     X, y = _make_data(N_ROWS + N_TEST, N_FEATURES)
     Xtr, ytr = X[:N_ROWS], y[:N_ROWS]
     Xte, yte = X[N_ROWS:], y[N_ROWS:]
@@ -355,6 +361,7 @@ def main():
                 "cpu_engine": "sklearn.HistGradientBoostingClassifier(median of 3)",
                 **mixed,
                 **quant,
+                "profiler": prof.snapshot(),
             }
         )
     )

@@ -9,6 +9,7 @@ jitted 20-iteration ``fori_loop`` whose input is perturbed per iteration
 (or XLA hoists the loop-invariant call), synced by fetching a small slice.
 """
 
+import json
 import sys
 import time
 
@@ -17,6 +18,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from mmlspark_tpu.observability.profiler import get_profiler
 from mmlspark_tpu.ops.histogram import build_histograms
 from mmlspark_tpu.ops.u_histogram import (
     build_histograms_u,
@@ -39,7 +41,10 @@ def sync(x):
 
 
 def timed(make_loop, *args, label=""):
-    loop = jax.jit(make_loop)
+    # the profiler wrap books the first (compiling) call as
+    # ProfileCompiled with the program's cost_analysis FLOPs/bytes, the
+    # warm call as ProfileExecuted — the BENCH JSON's profiler section
+    loop = get_profiler().wrap(jax.jit(make_loop), name=label or "loop")
     sync(loop(*args))  # compile
     t0 = time.perf_counter()
     sync(loop(*args))
@@ -49,6 +54,7 @@ def timed(make_loop, *args, label=""):
 
 
 def main():
+    prof = get_profiler().enable()
     rng = np.random.default_rng(0)
     bins = rng.integers(0, B, size=(N, F)).astype(np.uint8)
     g = rng.normal(size=N).astype(np.float32)
@@ -108,6 +114,31 @@ def main():
                  label="U pass (stat rows hoisted per tree)")
 
     print(f"speedup vs compare-built: {t_cmp / min(t_u, t_uh):.2f}x")
+
+    # ONE JSON line (the bench.py artifact convention): headline numbers
+    # plus the profiler section. Each profiled program is a REPS-iteration
+    # fori_loop, so per-iteration timing/FLOPs = the program totals / REPS.
+    snap = prof.snapshot()
+    per_iter = {
+        name: {
+            "compile_s": f["compile_seconds"],
+            "exec_ms_per_iter": (
+                f["device_seconds"] / max(f["executions"], 1) / REPS * 1e3
+            ),
+            "flops_per_iter": f["flops"] / REPS,
+            "bytes_per_iter": f["bytes_accessed"] / REPS,
+        }
+        for name, f in snap["functions"].items()
+    }
+    print(json.dumps({
+        "bench": "hist_u_ab",
+        "n": N, "f": F, "b": B, "nodes": KN, "reps": REPS,
+        "ms_per_pass": {
+            "compare_built": t_cmp, "u": t_u, "u_hoisted": t_uh,
+        },
+        "speedup_vs_compare_built": t_cmp / min(t_u, t_uh),
+        "profiler": dict(snap, per_iteration=per_iter),
+    }))
 
 
 if __name__ == "__main__":

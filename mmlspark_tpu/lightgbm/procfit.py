@@ -37,6 +37,7 @@ import dataclasses
 import json
 import os
 import pickle
+import time
 from pathlib import Path
 from typing import Any, Dict, List, Optional
 
@@ -237,13 +238,21 @@ def worker_fit(ctx) -> Dict[str, Any]:
             ))
 
     state = {"it": k}
+    # per-member allreduce timing, summarized into the worker result so
+    # the driver can fold the wire-vs-device split per member (the
+    # worker's own registry/profiler dies with the process)
+    wire = {"calls": 0, "seconds": 0.0}
 
     def hist_reduce(h):
         # first collective of iteration `it`: the designated death point
         # for kill_process chaos — peers are already blocked in this same
         # allreduce when the victim goes down
         ctx.maybe_die(state["it"])
-        return ctx.allreduce(h)
+        t0 = time.perf_counter()
+        out = ctx.allreduce(h)
+        wire["calls"] += 1
+        wire["seconds"] += time.perf_counter() - t0
+        return out
 
     def hook(it, tree):
         tree_np = jax.tree.map(
@@ -277,6 +286,10 @@ def worker_fit(ctx) -> Dict[str, Any]:
         "iterations": len(trees), "recovered": k, "rank": ctx.rank,
         "world": ctx.world, "rows": int(y_l.shape[0]),
         "journal_appended": journal.appended,
+        "profile": {
+            "allreduce_calls": wire["calls"],
+            "allreduce_seconds": wire["seconds"],
+        },
     }
     if ctx.rank == 0:
         booster = _pack_booster(
@@ -408,6 +421,21 @@ def fit_process_group(
         raise RuntimeError(
             f"no member produced a model; results: {worker_results}"
         )
+    # fold the per-member allreduce timing into the driver's profiler —
+    # the process-spanning analogue of the in-process hist_allreduce wrap
+    from mmlspark_tpu.observability.profiler import get_profiler
+
+    prof = get_profiler()
+    if prof.active:
+        for member in sorted(worker_results):
+            p = (worker_results[member] or {}).get("profile") or {}
+            if p.get("allreduce_calls"):
+                prof.merge(
+                    f"procfit.allreduce[m{member}]",
+                    executions=int(p["allreduce_calls"]),
+                    device_seconds=float(p.get("allreduce_seconds", 0.0)),
+                )
+
     model_text = Path(model_path).read_text()
     booster = Booster.from_string(model_text)
     # the text round-trip keeps only [min:max] per feature; restore the

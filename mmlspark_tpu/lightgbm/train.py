@@ -37,6 +37,7 @@ from __future__ import annotations
 import collections
 import dataclasses
 import math
+import time
 from functools import lru_cache, partial
 from typing import Any, Dict, List, NamedTuple, Optional, Sequence, Tuple
 
@@ -46,6 +47,7 @@ import numpy as np
 from jax import lax
 
 from mmlspark_tpu.lightgbm.binning import BinMapper
+from mmlspark_tpu.observability.profiler import get_profiler
 from mmlspark_tpu.lightgbm.booster import Booster
 from mmlspark_tpu.lightgbm.objectives import (
     METRICS,
@@ -1171,12 +1173,16 @@ _PROGRAM_CACHE_SIZE = 256
 
 def _cached_program(key, make):
     fn = _PROGRAM_CACHE.get(key)
+    hit = fn is not None
     if fn is None:
         fn = _PROGRAM_CACHE[key] = make()
         if len(_PROGRAM_CACHE) > _PROGRAM_CACHE_SIZE:
             _PROGRAM_CACHE.popitem(last=False)
     else:
         _PROGRAM_CACHE.move_to_end(key)
+    prof = get_profiler()
+    if prof.active:
+        prof.note_program_cache(hit=hit, size=len(_PROGRAM_CACHE))
     return fn
 
 
@@ -1620,9 +1626,15 @@ def train(
     okey = (_opts_key(opts), num_bins, mesh, u_spec, objective.cache_token)
     if opts.boosting_type == "goss":
         okey = okey + (n,)  # GOSS bakes the unpadded row count into the program
+    _prof = get_profiler()
+    _prof_on = _prof.active
     if hist_reduce is not None:
         # the reduce hook closes over a live socket group — never share a
-        # compiled program holding it across fits
+        # compiled program holding it across fits. The profiler wrap times
+        # the host-side collective per call, splitting each iteration into
+        # histogram-build (device) vs allreduce (wire) time.
+        if _prof_on:
+            hist_reduce = _prof.wrap_host(hist_reduce, "gbdt.hist_allreduce")
         step_raw = _make_step(
             opts, objective, num_bins, mesh, n_real=n, u_spec=u_spec,
             hist_reduce=hist_reduce,
@@ -1787,6 +1799,14 @@ def train(
         parts = []
         for s0 in range(0, opts.num_iterations, seg):
             s1 = min(s0 + seg, opts.num_iterations)
+            # profiling forces a per-segment sync (an honest device window
+            # needs block_until_ready); the unprofiled fit keeps the async
+            # dispatch pipeline.
+            t_seg = time.perf_counter() if _prof_on else 0.0
+            cache_before = (
+                runner._cache_size() if _prof_on
+                and hasattr(runner, "_cache_size") else None
+            )
             margins, part = runner(
                 bins_dev, y_dev, w_dev, margins, edges_dev,
                 bag_arg[s0:s1] if bag_resampling else bag_arg,
@@ -1796,6 +1816,19 @@ def train(
                 u_dev_scan,
             )
             parts.append(part)
+            if _prof_on:
+                jax.block_until_ready((margins, part))
+                dt = time.perf_counter() - t_seg
+                compiled = (
+                    cache_before is not None
+                    and hasattr(runner, "_cache_size")
+                    and runner._cache_size() > cache_before
+                )
+                if compiled:
+                    _prof.note_compile("gbdt.scan", dt)
+                else:
+                    _prof.note_cache_hit("gbdt.scan")
+                _prof.note_execute("gbdt.scan", dt)
         stacked_trees = (
             parts[0]
             if len(parts) == 1
@@ -1863,6 +1896,11 @@ def train(
             else:
                 margins_in = margins
 
+            t_step = time.perf_counter() if _prof_on else 0.0
+            step_cache_before = (
+                step._cache_size() if _prof_on
+                and hasattr(step, "_cache_size") else None
+            )
             tree, new_margins = step(
                 bins_dev, y_dev, w_dev, margins_in, edges_dev, bag_dev, fm_dev,
                 jnp.int32(it), lr_it, u=u_dev,
@@ -1899,6 +1937,21 @@ def train(
             # and per-iteration sync is the barrier-execution-mode semantics
             # of the reference anyway (TrainUtils.scala:477-483).
             jax.block_until_ready(margins)
+            if _prof_on:
+                # the per-iteration device window: step dispatch through
+                # the mesh sync above (dart host work rides along on the
+                # rare dropped-tree iterations)
+                dt = time.perf_counter() - t_step
+                compiled = (
+                    step_cache_before is not None
+                    and hasattr(step, "_cache_size")
+                    and step._cache_size() > step_cache_before
+                )
+                if compiled:
+                    _prof.note_compile("gbdt.step", dt)
+                else:
+                    _prof.note_cache_hit("gbdt.step")
+                _prof.note_execute("gbdt.step", dt)
             # drop row_leaf, a (C, N) buffer per tree, before retaining
             trees.append(tree._replace(row_leaf=None))
             if iteration_hook is not None:

@@ -13,7 +13,16 @@ pieces wired through core, runtime, serving, stages, and lightgbm fit:
   propagates one trace id request -> batch -> model-apply across threads;
 - :mod:`~mmlspark_tpu.observability.registry` — Prometheus-style
   counters/gauges/latency-histograms with text exposition, served live at
-  ``GET /metrics`` (and ``GET /healthz``) on every serving endpoint.
+  ``GET /metrics`` (and ``GET /healthz``) on every serving endpoint;
+- :mod:`~mmlspark_tpu.observability.profiler` — device-performance
+  profiler (``MMLSPARK_TPU_PROFILE=1``): compile accounting,
+  ``block_until_ready`` execution windows, XLA ``cost_analysis()``
+  roofline attribution, HBM gauges, transfer counters;
+- :mod:`~mmlspark_tpu.observability.slo` — :class:`SLOReport` folding
+  the registry + event log into the serving-SLO verdict (JSON/markdown);
+- :mod:`~mmlspark_tpu.observability.history` — the History-Server
+  analogue: ``python -m mmlspark_tpu.observability.history <eventlog>``
+  renders one self-contained HTML report.
 
 Quick start::
 
@@ -40,6 +49,8 @@ from mmlspark_tpu.observability.events import (
     ModelSwapped,
     ProcessLost,
     ProcessStarted,
+    ProfileCompiled,
+    ProfileExecuted,
     RequestServed,
     RequestShed,
     StageCompleted,
@@ -57,25 +68,49 @@ from mmlspark_tpu.observability.events import (
     format_timeline,
     from_record,
     get_bus,
+    log_segments,
     replay,
     timeline,
 )
+from mmlspark_tpu.observability.profiler import (
+    DeviceProfiler,
+    FunctionProfile,
+    device_peaks,
+    get_profiler,
+)
 from mmlspark_tpu.observability.registry import (
+    DEFAULT_BUCKETS,
+    FIT_BUCKETS,
     Counter,
     Gauge,
     Histogram,
     MetricsRegistry,
     get_registry,
 )
+from mmlspark_tpu.observability.slo import SLOReport, SLOTargets
 from mmlspark_tpu.observability.tracing import Span, Tracer, get_tracer
+
+
+def __getattr__(name):
+    # lazy: importing history here eagerly would trip runpy's double-import
+    # warning under ``python -m mmlspark_tpu.observability.history``
+    if name == "render_report":
+        from mmlspark_tpu.observability.history import render_report
+
+        return render_report
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 __all__ = [
     "BatchFormed",
     "BreakerTripped",
     "Counter",
+    "DEFAULT_BUCKETS",
+    "DeviceProfiler",
     "Event",
     "EventBus",
     "EventLogSink",
+    "FIT_BUCKETS",
+    "FunctionProfile",
     "Gauge",
     "GroupReformed",
     "Histogram",
@@ -84,8 +119,12 @@ __all__ = [
     "ModelSwapped",
     "ProcessLost",
     "ProcessStarted",
+    "ProfileCompiled",
+    "ProfileExecuted",
     "RequestServed",
     "RequestShed",
+    "SLOReport",
+    "SLOTargets",
     "Span",
     "StageCompleted",
     "StageStarted",
@@ -100,11 +139,15 @@ __all__ = [
     "Tracer",
     "WorkerParoled",
     "WorkerQuarantined",
+    "device_peaks",
     "format_timeline",
     "from_record",
     "get_bus",
+    "get_profiler",
     "get_registry",
     "get_tracer",
+    "log_segments",
+    "render_report",
     "replay",
     "timeline",
 ]

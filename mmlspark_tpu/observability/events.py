@@ -280,6 +280,34 @@ class ModelSwapped(Event):
     server: str = ""
 
 
+# -- profiler ----------------------------------------------------------------
+
+
+@_event
+class ProfileCompiled(Event):
+    """The :class:`~mmlspark_tpu.observability.profiler.DeviceProfiler`
+    saw a wrapped function compile a new executable (an executable-cache
+    miss). ``seconds`` is the host wall time of the compiling call
+    (trace + XLA compile + first execution); ``flops``/``bytes_accessed``
+    are the XLA ``cost_analysis()`` estimates for one execution of the
+    program, 0.0 when the backend declines to say."""
+
+    name: str
+    seconds: float
+    flops: float = 0.0
+    bytes_accessed: float = 0.0
+    signature: str = ""
+
+
+@_event
+class ProfileExecuted(Event):
+    """One profiled execution window: call through ``block_until_ready``
+    on every output, against a warm executable cache."""
+
+    name: str
+    seconds: float
+
+
 # -- resilience --------------------------------------------------------------
 
 
@@ -349,19 +377,61 @@ class EventBus:
 class EventLogSink:
     """JSON-lines event log: one ``{"event": <type>, ...}`` object per
     line, appended and flushed per event so a crash loses at most the
-    in-flight record (the Spark event-log posture)."""
+    in-flight record (the Spark event-log posture).
 
-    def __init__(self, path: str):
+    The log is size-bounded (``spark.eventLog.rolling``): when a write
+    would push the live file past ``max_bytes`` (default from
+    ``MMLSPARK_TPU_EVENT_LOG_MAX_BYTES``; 0/unset = unbounded), the file
+    rotates to ``<path>.<seq>`` with a monotonically increasing ``seq``
+    and a fresh live file opens — a streaming/serving chaos run can no
+    longer grow one file without limit. :func:`replay` reads the rotated
+    segments oldest-first, then the live file, so the fold is unchanged.
+    """
+
+    def __init__(self, path: str, max_bytes: Optional[int] = None):
+        import os
+
+        if max_bytes is None:
+            max_bytes = int(
+                os.environ.get("MMLSPARK_TPU_EVENT_LOG_MAX_BYTES", 0)
+            ) or None
         self.path = path
+        self.max_bytes = max_bytes
         self._lock = threading.Lock()
+        existing = [seq for seq, _ in _numbered_segments(path)]
+        self._seq = max(existing) + 1 if existing else 1
         self._fh: Optional[IO[str]] = open(path, "a", encoding="utf-8")
+        self._size = self._fh.tell()
 
     def __call__(self, event: Event) -> None:
+        line = json.dumps(event.to_record()) + "\n"
         with self._lock:
             if self._fh is None:
                 return
-            self._fh.write(json.dumps(event.to_record()) + "\n")
+            # rotate BEFORE the write so a segment never exceeds the
+            # bound; an empty live file always accepts (one oversized
+            # event must not rotate forever)
+            if (
+                self.max_bytes
+                and self._size
+                and self._size + len(line) > self.max_bytes
+            ):
+                self._rotate()
+            self._fh.write(line)
             self._fh.flush()
+            self._size += len(line)
+
+    def _rotate(self) -> None:
+        """Close the live file and shelve it as the next numbered
+        segment (caller holds ``_lock``)."""
+        import os
+
+        assert self._fh is not None
+        self._fh.close()
+        os.replace(self.path, f"{self.path}.{self._seq}")
+        self._seq += 1
+        self._fh = open(self.path, "a", encoding="utf-8")
+        self._size = 0
 
     def close(self) -> None:
         with self._lock:
@@ -420,14 +490,43 @@ def from_record(rec: Dict[str, Any]) -> Event:
     return cls(**{k: v for k, v in rec.items() if k in fields})
 
 
+def _numbered_segments(path: str) -> List[tuple]:
+    """(seq, segment_path) pairs for the rotated segments of ``path``,
+    unsorted; ``<path>.<digits>`` only, so unrelated siblings never
+    count."""
+    import glob
+    import os
+
+    out = []
+    for p in glob.glob(glob.escape(path) + ".*"):
+        suffix = p[len(path) + 1:]
+        if suffix.isdigit() and os.path.isfile(p):
+            out.append((int(suffix), p))
+    return out
+
+
+def log_segments(path: str) -> List[str]:
+    """Every file of a (possibly rotated) event log in write order:
+    numbered segments oldest-first, then the live file."""
+    import os
+
+    out = [p for _, p in sorted(_numbered_segments(path))]
+    if os.path.exists(path) or not out:
+        out.append(path)
+    return out
+
+
 def replay(path: str) -> List[Event]:
-    """Read an event log back into typed events (skips blank lines)."""
+    """Read an event log back into typed events (skips blank lines).
+    Rotated segments (``<path>.1``, ``<path>.2``, ...) are read in
+    order before the live file, so a size-bounded log replays whole."""
     out: List[Event] = []
-    with open(path, "r", encoding="utf-8") as fh:
-        for line in fh:
-            line = line.strip()
-            if line:
-                out.append(from_record(json.loads(line)))
+    for segment in log_segments(path):
+        with open(segment, "r", encoding="utf-8") as fh:
+            for line in fh:
+                line = line.strip()
+                if line:
+                    out.append(from_record(json.loads(line)))
     return out
 
 
@@ -456,6 +555,8 @@ def timeline(events: Iterable[Event]) -> Dict[str, Any]:
     streaming = {"epochs": 0, "rows": 0, "source_units": 0}
     stream_epochs: Dict[str, List[int]] = {}
     swaps: List[Dict[str, Any]] = []
+    #: per-function compile/execute fold from Profile* events
+    profiler: Dict[str, Dict[str, Any]] = {}
     for ev in events:
         if isinstance(ev, StageStarted):
             stages.setdefault(
@@ -519,6 +620,22 @@ def timeline(events: Iterable[Event]) -> Dict[str, Any]:
             shed += 1
         elif isinstance(ev, BreakerTripped):
             breaker_trips[ev.breaker] = breaker_trips.get(ev.breaker, 0) + 1
+        elif isinstance(ev, (ProfileCompiled, ProfileExecuted)):
+            rec = profiler.setdefault(ev.name, {
+                "compiles": 0, "compile_seconds": 0.0,
+                "executions": 0, "device_seconds": 0.0,
+                "flops": 0.0, "bytes_accessed": 0.0,
+            })
+            if isinstance(ev, ProfileCompiled):
+                rec["compiles"] += 1
+                rec["compile_seconds"] += ev.seconds
+                if ev.flops:
+                    rec["flops"] = ev.flops
+                if ev.bytes_accessed:
+                    rec["bytes_accessed"] = ev.bytes_accessed
+            else:
+                rec["executions"] += 1
+                rec["device_seconds"] += ev.seconds
     requests: Dict[str, Any] = {
         "count": len(latencies), "statuses": statuses, "shed": shed,
     }
@@ -538,6 +655,7 @@ def timeline(events: Iterable[Event]) -> Dict[str, Any]:
         "quarantines": quarantines,
         "paroles": paroles,
         "processes": dict(processes, loss_reasons=loss_reasons),
+        "profiler": profiler,
     }
 
 
@@ -614,6 +732,25 @@ def format_timeline(summary: Dict[str, Any]) -> str:
             f"   latency p50={r['latency_p50'] * 1e3:.2f}ms "
             f"max={r['latency_max'] * 1e3:.2f}ms"
         )
+    profiler = summary.get("profiler") or {}
+    if profiler:
+        lines.append("== profiler ==")
+        for name in sorted(profiler):
+            p = profiler[name]
+            parts = []
+            if p["compiles"]:
+                parts.append(
+                    f"compiles={p['compiles']} ({p['compile_seconds']:.3f}s)"
+                )
+            if p["executions"]:
+                avg = p["device_seconds"] / p["executions"]
+                parts.append(
+                    f"execs={p['executions']} device={p['device_seconds']:.3f}s "
+                    f"avg={avg * 1e3:.2f}ms"
+                )
+            if p.get("flops"):
+                parts.append(f"flops={p['flops']:.3g}")
+            lines.append(f"   {name}: " + " ".join(parts))
     if summary["models"]:
         lines.append("== models == " + ", ".join(summary["models"]))
     swaps = summary.get("swaps") or []

@@ -41,6 +41,16 @@ DEFAULT_BUCKETS: Tuple[float, ...] = (
     0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
 )
 
+#: fit/compile-scale buckets (seconds to half an hour). DEFAULT_BUCKETS
+#: top out at 10 s, so fit-scale durations all land in +Inf and the
+#: interpolated p99 clamps to 10.0 — meaningless for a multi-minute fit
+#: or an XLA compile. Register fit and compile histograms with these;
+#: keep DEFAULT_BUCKETS for serving-latency metrics.
+FIT_BUCKETS: Tuple[float, ...] = (
+    0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+    30.0, 60.0, 120.0, 300.0, 600.0, 1800.0,
+)
+
 
 def _format_value(v: float) -> str:
     if v == math.inf:

@@ -1,0 +1,277 @@
+"""SLO reporting: fold the metrics registry + event log into one verdict.
+
+``docs/serving_latency.md`` claims sub-5 ms p50 applies, but nothing in
+the system folded the measured registry into a statement against targets
+(ROADMAP item 4). :class:`SLOReport` is that fold — the Spark
+structured-streaming "progress report" analogue for the serving plane:
+
+    report = SLOReport.fold(get_registry(), events=replay(log_path))
+    print(report.to_markdown())     # the docs/serving_latency.md table
+    open("slo.json", "w").write(report.to_json())
+
+Everything in the report is *derived*, never sampled twice: latency
+quantiles come from the same ``serving_*`` histograms the Prometheus
+endpoint exposes (so the report equals the registry fold exactly — the
+determinism test asserts it), request/shed/error counts come from the
+counters, and the end-to-end quantiles plus per-stage breakdown
+(queue -> batch -> apply -> reply) come from replaying
+:class:`~mmlspark_tpu.observability.events.RequestServed` latencies
+against the stage histograms.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any, Dict, Iterable, List, Optional, Union
+
+from mmlspark_tpu.observability.events import Event, RequestServed, RequestShed
+from mmlspark_tpu.observability.registry import MetricsRegistry
+
+
+@dataclasses.dataclass(frozen=True)
+class SLOTargets:
+    """The serving objectives the report judges against (defaults are the
+    docs/serving_latency.md claims: 5 ms median apply, 50 ms tail,
+    three-nines availability)."""
+
+    p50_ms: float = 5.0
+    p99_ms: float = 50.0
+    availability: float = 0.999
+
+    def to_dict(self) -> Dict[str, float]:
+        return dataclasses.asdict(self)
+
+
+def _quantile(sorted_vals: List[float], q: float) -> float:
+    """Linear-interpolated quantile of a sorted sample (0 when empty)."""
+    if not sorted_vals:
+        return 0.0
+    if len(sorted_vals) == 1:
+        return sorted_vals[0]
+    pos = q * (len(sorted_vals) - 1)
+    lo = int(pos)
+    hi = min(lo + 1, len(sorted_vals) - 1)
+    frac = pos - lo
+    return sorted_vals[lo] + (sorted_vals[hi] - sorted_vals[lo]) * frac
+
+
+def _scalar(summary: Dict[str, Any], name: str) -> float:
+    """Counter/gauge value from a registry ``summary()`` dict; labeled
+    series sum across children."""
+    v = summary.get(name)
+    if v is None:
+        return 0.0
+    if isinstance(v, dict):
+        return float(sum(v.values()))
+    return float(v)
+
+
+def _hist(summary: Dict[str, Any], name: str) -> Dict[str, float]:
+    v = summary.get(name)
+    if isinstance(v, dict) and "count" in v:
+        return {k: float(v[k]) for k in ("count", "sum", "p50", "p95", "p99")}
+    return {"count": 0.0, "sum": 0.0, "p50": 0.0, "p95": 0.0, "p99": 0.0}
+
+
+@dataclasses.dataclass
+class SLOReport:
+    """One serving-SLO verdict, derived from the registry + event log."""
+
+    targets: SLOTargets
+    requests: float
+    shed: float
+    expired: float
+    reply_failures: float
+    errors: float
+    #: end-to-end request latency quantiles (seconds), from RequestServed
+    e2e: Dict[str, float]
+    #: per-stage summaries (count/sum/p50/p95/p99, seconds)
+    stages: Dict[str, Dict[str, float]]
+    batches: float = 0.0
+
+    # -- derived -------------------------------------------------------------
+
+    @property
+    def shed_pct(self) -> float:
+        offered = self.requests + self.shed
+        return 100.0 * self.shed / offered if offered else 0.0
+
+    @property
+    def error_rate(self) -> float:
+        return self.errors / self.requests if self.requests else 0.0
+
+    @property
+    def error_budget_consumed(self) -> float:
+        """Fraction of the availability error budget spent (>1 = blown)."""
+        budget = 1.0 - self.targets.availability
+        return self.error_rate / budget if budget > 0 else 0.0
+
+    @property
+    def apply_p50_ms(self) -> float:
+        return self.stages.get("apply", {}).get("p50", 0.0) * 1e3
+
+    @property
+    def apply_p99_ms(self) -> float:
+        return self.stages.get("apply", {}).get("p99", 0.0) * 1e3
+
+    def ok(self) -> bool:
+        return (
+            self.apply_p50_ms <= self.targets.p50_ms
+            and self.apply_p99_ms <= self.targets.p99_ms
+            and self.error_budget_consumed <= 1.0
+        )
+
+    # -- construction --------------------------------------------------------
+
+    @classmethod
+    def fold(
+        cls,
+        registry: Union[MetricsRegistry, Dict[str, Any], None],
+        events: Optional[Iterable[Event]] = None,
+        targets: Optional[SLOTargets] = None,
+    ) -> "SLOReport":
+        """Fold a registry (or a ``registry.summary()`` dict — the history
+        server feeds a JSON snapshot) and an optional event stream into a
+        report. Counters and stage quantiles come straight from the
+        registry; the event stream adds end-to-end quantiles, HTTP-error
+        counts, and fills shed/served counts when no registry is given."""
+        targets = targets or SLOTargets()
+        if registry is None:
+            summary: Dict[str, Any] = {}
+        elif isinstance(registry, MetricsRegistry):
+            summary = registry.summary()
+        else:
+            summary = dict(registry)
+
+        stages = {
+            "queue": _hist(summary, "serving_queue_wait_seconds"),
+            "apply": _hist(summary, "serving_apply_latency_seconds"),
+        }
+        requests = _scalar(summary, "serving_requests_total")
+        shed = _scalar(summary, "serving_shed_total")
+        expired = _scalar(summary, "serving_expired_total")
+        reply_failures = _scalar(summary, "serving_replies_failed_total")
+        batches = _scalar(summary, "serving_batches_total")
+
+        latencies: List[float] = []
+        errors = 0.0
+        ev_served = 0.0
+        ev_shed = 0.0
+        for ev in events or ():
+            if isinstance(ev, RequestServed):
+                ev_served += 1
+                latencies.append(float(ev.latency))
+                if ev.status >= 500:
+                    errors += 1
+            elif isinstance(ev, RequestShed):
+                ev_shed += 1
+        if requests == 0.0:
+            requests = ev_served
+        if shed == 0.0:
+            shed = ev_shed
+
+        latencies.sort()
+        e2e = {
+            "count": float(len(latencies)),
+            "p50": _quantile(latencies, 0.50),
+            "p95": _quantile(latencies, 0.95),
+            "p99": _quantile(latencies, 0.99),
+        }
+        # reply overhead: whatever end-to-end time queue+apply don't explain
+        reply = max(
+            0.0,
+            e2e["p50"] - stages["queue"]["p50"] - stages["apply"]["p50"],
+        )
+        stages["reply"] = {
+            "count": e2e["count"], "sum": 0.0,
+            "p50": reply, "p95": 0.0, "p99": 0.0,
+        }
+        return cls(
+            targets=targets,
+            requests=requests,
+            shed=shed,
+            expired=expired,
+            reply_failures=reply_failures,
+            errors=errors,
+            e2e=e2e,
+            stages=stages,
+            batches=batches,
+        )
+
+    # -- renderers -----------------------------------------------------------
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "targets": self.targets.to_dict(),
+            "requests": self.requests,
+            "shed": self.shed,
+            "shed_pct": self.shed_pct,
+            "expired": self.expired,
+            "reply_failures": self.reply_failures,
+            "errors": self.errors,
+            "error_rate": self.error_rate,
+            "error_budget_consumed": self.error_budget_consumed,
+            "batches": self.batches,
+            "e2e": self.e2e,
+            "stages": self.stages,
+            "ok": self.ok(),
+        }
+
+    def to_json(self, indent: int = 2) -> str:
+        return json.dumps(self.to_dict(), indent=indent, sort_keys=True)
+
+    def to_markdown(self) -> str:
+        """The measured-SLO table docs/serving_latency.md embeds."""
+
+        def _status(ok: bool) -> str:
+            return "met" if ok else "**missed**"
+
+        t = self.targets
+        lines = [
+            "| objective | target | measured | status |",
+            "|---|---|---|---|",
+            (
+                f"| apply p50 | <= {t.p50_ms:g} ms | "
+                f"{self.apply_p50_ms:.2f} ms | "
+                f"{_status(self.apply_p50_ms <= t.p50_ms)} |"
+            ),
+            (
+                f"| apply p99 | <= {t.p99_ms:g} ms | "
+                f"{self.apply_p99_ms:.2f} ms | "
+                f"{_status(self.apply_p99_ms <= t.p99_ms)} |"
+            ),
+            (
+                f"| availability | >= {t.availability:.3%} | "
+                f"{1.0 - self.error_rate:.3%} | "
+                f"{_status(self.error_budget_consumed <= 1.0)} |"
+            ),
+            "",
+            (
+                f"Requests: {self.requests:.0f} served, {self.shed:.0f} shed "
+                f"({self.shed_pct:.1f}%), {self.expired:.0f} expired, "
+                f"{self.errors:.0f} server errors "
+                f"(error budget consumed: "
+                f"{self.error_budget_consumed:.1%})."
+            ),
+            "",
+            "| stage | count | p50 | p95 | p99 |",
+            "|---|---|---|---|---|",
+        ]
+        order = ["queue", "apply", "reply"]
+        for stage in order + sorted(set(self.stages) - set(order)):
+            s = self.stages.get(stage)
+            if s is None:
+                continue
+            lines.append(
+                f"| {stage} | {s['count']:.0f} | {s['p50'] * 1e3:.2f} ms "
+                f"| {s['p95'] * 1e3:.2f} ms | {s['p99'] * 1e3:.2f} ms |"
+            )
+        if self.e2e["count"]:
+            lines.append(
+                f"| end-to-end | {self.e2e['count']:.0f} "
+                f"| {self.e2e['p50'] * 1e3:.2f} ms "
+                f"| {self.e2e['p95'] * 1e3:.2f} ms "
+                f"| {self.e2e['p99'] * 1e3:.2f} ms |"
+            )
+        return "\n".join(lines)

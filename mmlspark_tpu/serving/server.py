@@ -49,6 +49,7 @@ from mmlspark_tpu.observability.events import (
     RequestServed,
     get_bus,
 )
+from mmlspark_tpu.observability.profiler import get_profiler
 from mmlspark_tpu.observability.registry import get_registry
 from mmlspark_tpu.observability.tracing import Span, get_tracer
 from mmlspark_tpu.resilience.admission import AdmissionController
@@ -347,8 +348,19 @@ class _BatchLoop:
             ):
                 with tracer.span("serving.apply"):
                     out = self._apply_model(Table({self.input_col: col}))
-            self._reg_apply.observe(time.perf_counter() - t0)
+            apply_dt = time.perf_counter() - t0
+            self._reg_apply.observe(apply_dt)
             values = out.column(self.output_col)
+            prof = get_profiler()
+            if prof.active:
+                prof.note_execute("serving.apply", apply_dt)
+                prof.note_transfer(
+                    getattr(col, "nbytes", 0), "h2d", name="serving.apply"
+                )
+                prof.note_transfer(
+                    getattr(np.asarray(values), "nbytes", 0),
+                    "d2h", name="serving.apply",
+                )
             for r, v in zip(batch, values):
                 self._reply(r, v)
                 self._reg_requests.inc()

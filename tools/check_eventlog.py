@@ -5,7 +5,12 @@ Checks every line against the typed-event registry
 (:mod:`mmlspark_tpu.observability.events`): the line must be a JSON
 object, name a known event type, carry every required field with a
 JSON-compatible scalar of the declared type, and carry no unknown
-fields. Timestamps must be monotonically sane (non-negative floats).
+fields. Timestamps must be monotonically sane (non-negative floats),
+and duration-valued fields (``seconds``/``latency``/``duration``, the
+Profile*/RequestServed/TaskFailed payloads) must be non-negative.
+
+Rotated logs (``MMLSPARK_TPU_EVENT_LOG_MAX_BYTES``) are validated whole:
+every ``<path>.<seq>`` segment plus the live file, in write order.
 
     python tools/check_eventlog.py /path/to/events.jsonl
 
@@ -69,6 +74,10 @@ def _check_record(rec: object) -> typing.List[str]:
     t = rec.get("t")
     if isinstance(t, (int, float)) and t < 0:
         problems.append(f"{kind}: negative timestamp {t}")
+    for dur_field in ("seconds", "latency", "duration"):
+        v = rec.get(dur_field)
+        if isinstance(v, (int, float)) and not isinstance(v, bool) and v < 0:
+            problems.append(f"{kind}.{dur_field}: negative duration {v}")
     return problems
 
 
@@ -80,30 +89,35 @@ def main(argv: typing.List[str]) -> int:
     path = argv[1]
     counts: typing.Dict[str, int] = {}
     bad = 0
-    with open(path, "r", encoding="utf-8") as fh:
-        for lineno, line in enumerate(fh, 1):
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                rec = json.loads(line)
-            except json.JSONDecodeError as e:
-                print(f"{path}:{lineno}: invalid JSON: {e}", file=sys.stderr)
-                bad += 1
-                continue
-            problems = _check_record(rec)
-            for p in problems:
-                print(f"{path}:{lineno}: {p}", file=sys.stderr)
-            if problems:
-                bad += 1
-            else:
-                counts[rec["event"]] = counts.get(rec["event"], 0) + 1
+    segments = ev.log_segments(path)
+    for seg in segments:
+        with open(seg, "r", encoding="utf-8") as fh:
+            for lineno, line in enumerate(fh, 1):
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError as e:
+                    print(f"{seg}:{lineno}: invalid JSON: {e}",
+                          file=sys.stderr)
+                    bad += 1
+                    continue
+                problems = _check_record(rec)
+                for p in problems:
+                    print(f"{seg}:{lineno}: {p}", file=sys.stderr)
+                if problems:
+                    bad += 1
+                else:
+                    counts[rec["event"]] = counts.get(rec["event"], 0) + 1
     total = sum(counts.values())
+    where = path if len(segments) == 1 else f"{path} ({len(segments)} segments)"
     if bad:
-        print(f"{path}: {bad} invalid line(s), {total} valid", file=sys.stderr)
+        print(f"{where}: {bad} invalid line(s), {total} valid",
+              file=sys.stderr)
         return 1
     breakdown = " ".join(f"{k}={v}" for k, v in sorted(counts.items()))
-    print(f"{path}: {total} events ok ({breakdown})")
+    print(f"{where}: {total} events ok ({breakdown})")
     return 0
 
 

@@ -125,6 +125,16 @@ def main() -> int:
     # least one request per batch must carry the full chain
     assert threaded >= 1, "no trace threads request -> batch -> apply"
 
+    # -- SLO fold: the registry-derived serving verdict -----------------------
+    from mmlspark_tpu.observability import SLOReport, get_registry
+
+    report = SLOReport.fold(get_registry(), events=events)
+    assert report.requests >= n_requests, report.to_dict()
+    assert report.e2e["count"] == n_requests, report.e2e
+    md = report.to_markdown()
+    assert "| apply p50 |" in md and "| queue |" in md, md
+    print(md)
+
     print(f"observability smoke ok: {n_requests} requests, "
           f"{len(events)} events, {threaded} fully-threaded trace(s)")
     print(log_path)

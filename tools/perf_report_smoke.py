@@ -1,0 +1,186 @@
+#!/usr/bin/env python
+"""CI smoke for the perf-observability plane (the ``perf-report`` job).
+
+Produces the artifacts the job uploads, into ``argv[1]`` (default: a
+fresh tempdir):
+
+- ``events.jsonl`` (+ rotated segments) — a chaos-run event log: a
+  seeded ``kill_task`` scheduler job, a small loop-path GBDT fit with
+  the profiler on, and live serving traffic;
+- ``metrics.json`` — the registry ``summary()`` snapshot;
+- ``slo.json`` / ``slo.md`` — the :class:`SLOReport` fold;
+- ``report.html`` — the history-server render, asserted to contain the
+  stage timeline, the task-attempt table, and the SLO table;
+- ``overhead.json`` — the bare-transform observability-overhead
+  measurement, guarded < 5% (the PR 3 baseline measured 2.9%).
+
+The event log path is printed on the last line so the CI step can feed
+it to tools/check_eventlog.py. Exits nonzero on any failed assertion.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import statistics
+import sys
+import tempfile
+import time
+import urllib.request
+
+import numpy as np
+
+#: bare-transform overhead bound: observability fully on (event-log sink +
+#: profiler) vs fully off must stay under 5% — with an absolute floor so a
+#: shared-runner scheduling hiccup on a sub-millisecond workload can't
+#: fail the job on noise alone.
+OVERHEAD_LIMIT = 0.05
+OVERHEAD_ABS_FLOOR_S = 0.010
+
+
+def _bare_transform_seconds(model, table, calls: int = 30) -> float:
+    """One sample: wall time of ``calls`` back-to-back transforms."""
+    model.transform(table)  # warm
+    t0 = time.perf_counter()
+    for _ in range(calls):
+        model.transform(table)
+    return time.perf_counter() - t0
+
+
+def main() -> int:
+    art = sys.argv[1] if len(sys.argv) > 1 else tempfile.mkdtemp(
+        prefix="mmlspark-tpu-perf-report-"
+    )
+    os.makedirs(art, exist_ok=True)
+    log_path = os.path.join(art, "events.jsonl")
+
+    from mmlspark_tpu.core.pipeline import Estimator, Model, Pipeline
+    from mmlspark_tpu.data.table import Table
+    from mmlspark_tpu.observability import (
+        SLOReport,
+        get_bus,
+        get_profiler,
+        get_registry,
+        render_report,
+        replay,
+        timeline,
+    )
+
+    class _CenterModel(Model):
+        mean = 0.0
+
+        def transform(self, t: Table) -> Table:
+            col = np.asarray(t.column("input"), dtype=np.float64)
+            return Table({"prediction": col - self.mean})
+
+    class _Center(Estimator):
+        def _fit(self, t: Table) -> _CenterModel:
+            m = _CenterModel()
+            m.mean = float(np.mean(np.asarray(t.column("input"))))
+            return m
+
+    train_tbl = Table({"input": np.linspace(0.0, 9.0, 10)})
+    big_tbl = Table({"input": np.linspace(0.0, 1.0, 200_000)})
+
+    # -- 1. bare-transform overhead guard: observability OFF vs fully ON ------
+    os.environ.pop("MMLSPARK_TPU_EVENT_LOG", None)
+    get_bus()  # re-sync: detaches any env sink
+    get_profiler().disable()
+    model = Pipeline(stages=[_Center()]).fit(train_tbl)
+    off = [_bare_transform_seconds(model, big_tbl) for _ in range(5)]
+
+    os.environ["MMLSPARK_TPU_EVENT_LOG"] = log_path
+    os.environ["MMLSPARK_TPU_EVENT_LOG_MAX_BYTES"] = str(256 * 1024)
+    get_bus()  # re-sync: attaches the sink (rotation armed)
+    prof = get_profiler().enable()
+    on = [_bare_transform_seconds(model, big_tbl) for _ in range(5)]
+
+    off_med, on_med = statistics.median(off), statistics.median(on)
+    overhead = (on_med - off_med) / off_med if off_med else 0.0
+    with open(os.path.join(art, "overhead.json"), "w") as fh:
+        json.dump({
+            "off_median_s": off_med, "on_median_s": on_med,
+            "overhead_frac": overhead, "limit_frac": OVERHEAD_LIMIT,
+            "off_runs_s": off, "on_runs_s": on,
+        }, fh, indent=2)
+    print(f"bare-transform overhead: {overhead:+.1%} "
+          f"(off={off_med * 1e3:.1f}ms on={on_med * 1e3:.1f}ms, limit "
+          f"{OVERHEAD_LIMIT:.0%})")
+    assert (
+        overhead < OVERHEAD_LIMIT
+        or (on_med - off_med) < OVERHEAD_ABS_FLOOR_S
+    ), f"observability overhead regressed: {overhead:.1%} (limit 5%)"
+
+    # -- 2. seeded chaos: one task killed, retried, recovered -----------------
+    from mmlspark_tpu import runtime
+
+    plan = runtime.FaultPlan(seed=0).kill_task(1)
+    pol = runtime.SchedulerPolicy(max_workers=2, backoff_base=0.01,
+                                  faults=plan)
+    out = runtime.run_partitioned(lambda x: x * 2, [1, 2, 3, 4], pol)
+    assert out == [2, 4, 6, 8], out
+    assert ("kill", 1, 0) in plan.fired, plan.fired
+
+    # -- 3. small loop-path GBDT fit with the profiler on ---------------------
+    from mmlspark_tpu.lightgbm.train import TrainOptions, train
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(500, 8)).astype(np.float32)
+    y = (X[:, 0] + 0.25 * X[:, 1] > 0).astype(np.float32)
+    train(X, y, TrainOptions(objective="binary", num_iterations=4,
+                             num_leaves=7),
+          iteration_hook=lambda it, tree: None)  # hook forces the loop path
+    fns = prof.snapshot()["functions"]
+    assert "gbdt.step" in fns and fns["gbdt.step"]["executions"] == 4, fns
+
+    # -- 4. serving traffic -> SLO fold ---------------------------------------
+    from mmlspark_tpu.serving import ServingServer
+
+    n_requests = 8
+    with ServingServer(model, max_latency_ms=1.0) as srv:
+        base = srv.info.url.rstrip("/")
+        for i in range(n_requests):
+            req = urllib.request.Request(
+                base, data=json.dumps({"input": float(i)}).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            body = json.loads(urllib.request.urlopen(req, timeout=10).read())
+            assert "prediction" in body, body
+
+    events = replay(log_path)
+    summary = timeline(events)
+    assert summary["tasks"]["failed"] >= 1, summary["tasks"]
+    assert summary["requests"]["count"] == n_requests, summary["requests"]
+    assert summary["profiler"].get("gbdt.step", {}).get("executions") == 4, (
+        summary["profiler"]
+    )
+
+    metrics = get_registry().summary()
+    with open(os.path.join(art, "metrics.json"), "w") as fh:
+        json.dump(metrics, fh, indent=2, default=float)
+    report = SLOReport.fold(get_registry(), events=events)
+    assert report.requests >= n_requests, report.to_dict()
+    with open(os.path.join(art, "slo.json"), "w") as fh:
+        fh.write(report.to_json())
+    with open(os.path.join(art, "slo.md"), "w") as fh:
+        fh.write(report.to_markdown() + "\n")
+
+    # -- 5. the history-server render -----------------------------------------
+    html_doc = render_report(events, metrics=metrics, title="perf-report smoke")
+    html_path = os.path.join(art, "report.html")
+    with open(html_path, "w") as fh:
+        fh.write(html_doc)
+    for needle in (
+        "Stage timeline", "Task attempts", "apply p50",
+        "Profiler roofline", "gbdt.step",
+    ):
+        assert needle in html_doc, f"history report missing {needle!r}"
+
+    print(f"perf-report smoke ok: {len(events)} events, "
+          f"{report.requests:.0f} requests, artifacts in {art}")
+    print(log_path)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
