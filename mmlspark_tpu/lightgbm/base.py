@@ -142,6 +142,29 @@ class LightGBMParams(
         "per-level to exact histograms, logged once per fit",
         default=False, converter=to_bool,
     )
+    featureBundling = Param(
+        "Exclusive Feature Bundling (native enable_bundle): greedily pack "
+        "(near-)mutually-exclusive features into shared bin columns at "
+        "binning time. Shrinks K = sum_f bins_f — the HBM re-stream that "
+        "bounds every histogram pass — and the column count, so sparse/"
+        "one-hot matrices fit the precomputed-U budget at row counts that "
+        "previously overflowed it. Splits, model text, SHAP, and "
+        "prediction stay in original feature space (emitted models are "
+        "indistinguishable from unbundled fits; with zero bundling "
+        "conflicts the tree structure is identical). Off by default — the "
+        "native engine defaults on, but bundled histogram g/h for a "
+        "member's default bin are recovered by subtraction, so float "
+        "leaf values can differ in the last ulp from an unbundled fit",
+        default=False, converter=to_bool,
+    )
+    maxConflictRate = Param(
+        "EFB conflict budget (native max_conflict_rate): fraction of "
+        "sampled rows where two bundled features may be simultaneously "
+        "non-default. 0.0 = only perfectly exclusive features bundle "
+        "(lossless); small values (e.g. 0.05) bundle harder at a bounded "
+        "accuracy cost on conflict rows",
+        default=0.0, converter=to_float, validator=in_range(0, 1),
+    )
     categoricalSlotIndexes = Param(
         "Feature indexes treated as categorical (value-identity bins + "
         "LightGBM sorted-set split search)",
@@ -350,6 +373,14 @@ class LightGBMBase(LightGBMParams, Estimator):
             categorical_features=sorted(cat_slots) or None,
             sample_cnt=self.getBinSampleCount(),
             max_bin_by_feature=self.getMaxBinByFeature() or None,
+            # EFB is a histogram-layout optimization; the voting reducer
+            # ships per-feature vote sets in original ids, so bundling is
+            # gated to the non-voting learners.
+            feature_bundling=(
+                self.getFeatureBundling()
+                and self.getParallelism() != "voting_parallel"
+            ),
+            max_conflict_rate=self.getMaxConflictRate(),
         )
         from mmlspark_tpu import runtime
 
@@ -609,11 +640,14 @@ def _ensemble_margin(boosters: List[Booster], bins: np.ndarray, mapper: BinMappe
     import jax
     import jax.numpy as jnp
 
-    from mmlspark_tpu.lightgbm.train import _route_binned
+    from mmlspark_tpu.lightgbm.train import _bundle_route_consts, _route_binned
 
+    spec = getattr(mapper, "bundles", None)
+    consts = _bundle_route_consts(spec) if spec is not None else None
     total = None
     for b in boosters:
-        # Route in bin space (bins built with the shared mapper).
+        # Route in bin space (bins built with the shared mapper; EFB-packed
+        # when the mapper carries a bundle plan — trees are in original ids).
         def margin_fn(bv):
             m = jnp.broadcast_to(
                 jnp.asarray(b.init_score)[None, :], (bv.shape[0], b.num_classes)
@@ -635,6 +669,7 @@ def _ensemble_margin(boosters: List[Booster], bins: np.ndarray, mapper: BinMappe
                         None if b.cat_masks is None
                         else jnp.asarray(b.cat_masks[t])
                     ),
+                    bundle_consts=consts,
                 )
                 m = m.at[:, t % b.num_classes].add(jnp.asarray(b.leaf_values[t])[leaf])
             return m

@@ -18,6 +18,12 @@ from typing import Optional, Tuple
 
 import numpy as np
 
+from mmlspark_tpu.lightgbm.bundling import (
+    BundleSpec,
+    fit_feature_bundles,
+    pack_bundles,
+)
+
 MISSING_BIN = 0
 
 
@@ -40,6 +46,11 @@ class BinMapper:
     max_bin: int
     # feature index -> sorted-by-frequency raw category values (bin i+1 <-> v[i])
     cat_values: Optional[dict] = None
+    # Exclusive Feature Bundling layout (mmlspark_tpu.lightgbm.bundling):
+    # when set, apply_bins emits PACKED (N, C) columns and the trainer
+    # expands histograms / converts routing back to original feature
+    # space. None = unbundled (every consumer behaves exactly as before).
+    bundles: Optional[BundleSpec] = None
 
     @property
     def num_features(self) -> int:
@@ -166,8 +177,21 @@ def cat_to_bins(col: np.ndarray, values: np.ndarray) -> np.ndarray:
 
 
 def apply_bins(X: np.ndarray, mapper: BinMapper) -> np.ndarray:
-    """Map raw features to uint8 bin indices (row-major (N, F) uint8).
-    Uses the host C++ library when built (bit-identical contract,
+    """Map raw features to uint8 bin indices — row-major (N, F) uint8, or
+    the PACKED (N, C) layout when the mapper carries a fitted
+    :class:`~mmlspark_tpu.lightgbm.bundling.BundleSpec` (so train, valid
+    sets, batch chaining, and procfit shards all bin consistently).
+    Row-pure either way (the partitioned path concatenates shards)."""
+    out = _apply_bins_raw(X, mapper)
+    spec = getattr(mapper, "bundles", None)  # pre-EFB pickles lack the field
+    if spec is not None:
+        out = pack_bundles(out, spec)
+    return out
+
+
+def _apply_bins_raw(X: np.ndarray, mapper: BinMapper) -> np.ndarray:
+    """Original-feature-space binning (pre-bundling). Uses the host C++
+    library when built (bit-identical contract,
     ``native/mmlspark_native.cpp``); numpy otherwise. Categorical columns
     are overlaid afterwards (value-identity bins, ``cat_to_bins``)."""
     from mmlspark_tpu.native import apply_bins_native
@@ -195,55 +219,112 @@ def apply_bins(X: np.ndarray, mapper: BinMapper) -> np.ndarray:
     return out
 
 
+def fit_bundles_inplace(
+    mapper: BinMapper,
+    raw_bins: np.ndarray,
+    max_conflict_rate: float = 0.0,
+    sample_cnt: int = 200_000,
+    seed: int = 0,
+) -> Optional[BundleSpec]:
+    """Fit Exclusive Feature Bundling over a row sample of the ALREADY
+    binned (original-space) matrix and attach the spec to the mapper.
+    Stays None when no bundle gains a second member — then every consumer
+    is bit-identical to an unbundled fit. Same sampling discipline as the
+    edge fit (``sample_cnt`` rows, seeded rng)."""
+    n = raw_bins.shape[0]
+    if n > sample_cnt:
+        rng = np.random.default_rng(seed)
+        sample = raw_bins[rng.choice(n, size=sample_cnt, replace=False)]
+    else:
+        sample = raw_bins
+    spec = fit_feature_bundles(
+        sample,
+        mapper.num_bins,
+        max_conflict_rate=max_conflict_rate,
+        categorical_slots=mapper.categorical_features,
+    )
+    mapper.bundles = spec
+    if spec is not None:
+        from mmlspark_tpu.observability.events import FeatureBundled, get_bus
+
+        bus = get_bus()
+        if bus.active:
+            bus.publish(FeatureBundled(
+                num_features=spec.num_features,
+                num_columns=spec.num_columns,
+                k_before=int(sum(int(x) for x in mapper.num_bins)),
+                k_after=spec.k_packed,
+                conflicts=spec.conflict_count,
+                sample_rows=spec.sample_rows,
+            ))
+    return spec
+
+
 def bin_dataset_to_device(
     X: np.ndarray,
     max_bin: int = 255,
     mapper: Optional[BinMapper] = None,
     categorical_features=None,
+    feature_bundling: bool = False,
+    max_conflict_rate: float = 0.0,
 ):
     """Bin on the host, then dispatch ONE asynchronous ``jax.device_put`` —
     the transfer flies while the caller sets up the rest of the fit
     (remote-attached chips pay ~0.3-0.45 s of fixed cost PER transfer, so
     chunked uploads measured strictly slower than one shot). Returns
-    (device_bins uint8 (N, F), mapper); feed the device array straight to
+    (device_bins uint8 (N, F) — or (N, C) packed under ``feature_bundling``
+    — and the mapper); feed the device array straight to
     :func:`~mmlspark_tpu.lightgbm.train.train` (it skips its own upload
     for device-resident bins)."""
     import jax
 
-    X = np.asarray(X, dtype=np.float64)
-    if mapper is None:
-        mapper = fit_bin_mapper(
-            X, max_bin=max_bin, categorical_features=categorical_features
-        )
-    return jax.device_put(np.ascontiguousarray(apply_bins(X, mapper))), mapper
+    bins, mapper = bin_dataset(
+        X, max_bin=max_bin, mapper=mapper,
+        categorical_features=categorical_features,
+        feature_bundling=feature_bundling,
+        max_conflict_rate=max_conflict_rate,
+    )
+    return jax.device_put(np.ascontiguousarray(bins)), mapper
 
 
 def bin_dataset(
     X, max_bin: int = 255, mapper: Optional[BinMapper] = None,
     categorical_features=None, sample_cnt: int = 200_000,
-    max_bin_by_feature=None,
+    max_bin_by_feature=None, feature_bundling: bool = False,
+    max_conflict_rate: float = 0.0,
 ) -> Tuple[np.ndarray, BinMapper]:
     from mmlspark_tpu.data.sparse import CSRMatrix
 
+    fresh = mapper is None
     if isinstance(X, CSRMatrix):
         if max_bin_by_feature:
             raise ValueError(
                 "maxBinByFeature is not supported on sparse (CSR) input"
             )
-        if mapper is None:
+        if fresh:
             mapper = fit_bin_mapper_csr(
                 X, max_bin=max_bin, sample_cnt=sample_cnt,
                 categorical_features=categorical_features,
             )
-        return apply_bins_csr(X, mapper), mapper
-    X = np.asarray(X, dtype=np.float64)
-    if mapper is None:
-        mapper = fit_bin_mapper(
-            X, max_bin=max_bin, sample_cnt=sample_cnt,
-            categorical_features=categorical_features,
-            max_bin_by_feature=max_bin_by_feature,
+        raw = _apply_bins_csr_raw(X, mapper)
+    else:
+        X = np.asarray(X, dtype=np.float64)
+        if fresh:
+            mapper = fit_bin_mapper(
+                X, max_bin=max_bin, sample_cnt=sample_cnt,
+                categorical_features=categorical_features,
+                max_bin_by_feature=max_bin_by_feature,
+            )
+        raw = _apply_bins_raw(X, mapper)
+    if fresh and feature_bundling:
+        fit_bundles_inplace(
+            mapper, raw, max_conflict_rate=max_conflict_rate,
+            sample_cnt=sample_cnt,
         )
-    return apply_bins(X, mapper), mapper
+    spec = getattr(mapper, "bundles", None)
+    if spec is not None:
+        return pack_bundles(raw, spec), mapper
+    return raw, mapper
 
 
 def bin_dataset_partitioned(
@@ -251,6 +332,7 @@ def bin_dataset_partitioned(
     categorical_features=None, sample_cnt: int = 200_000,
     max_bin_by_feature=None, policy=None, metrics=None,
     journal_root: Optional[str] = None, journal_key: Optional[str] = None,
+    feature_bundling: bool = False, max_conflict_rate: float = 0.0,
 ) -> Tuple[np.ndarray, BinMapper]:
     """:func:`bin_dataset` with the row-binning pass dispatched as
     partitioned tasks on the fault-tolerant scheduler
@@ -282,13 +364,30 @@ def bin_dataset_partitioned(
             X, max_bin=max_bin, mapper=mapper,
             categorical_features=categorical_features, sample_cnt=sample_cnt,
             max_bin_by_feature=max_bin_by_feature,
+            feature_bundling=feature_bundling,
+            max_conflict_rate=max_conflict_rate,
         )
     X = np.asarray(X, dtype=np.float64)
-    if mapper is None:
+    fresh = mapper is None
+    if fresh:
         mapper = fit_bin_mapper(
             X, max_bin=max_bin, sample_cnt=sample_cnt,
             categorical_features=categorical_features,
             max_bin_by_feature=max_bin_by_feature,
+        )
+    if fresh and feature_bundling:
+        # Bundle fit stays inline (like the mapper fit): bin only the
+        # sample rows in original space, attach the spec, and every
+        # partition task's apply_bins packs consistently (row-pure).
+        n_all = X.shape[0]
+        if n_all > sample_cnt:
+            rng = np.random.default_rng(0)
+            rows = X[rng.choice(n_all, size=sample_cnt, replace=False)]
+        else:
+            rows = X
+        fit_bundles_inplace(
+            mapper, _apply_bins_raw(rows, mapper),
+            max_conflict_rate=max_conflict_rate, sample_cnt=sample_cnt,
         )
     pol = policy or runtime.current_policy() or runtime.SchedulerPolicy()
     n = X.shape[0]
@@ -415,9 +514,18 @@ def fit_bin_mapper_csr(csr, max_bin: int = 255, sample_cnt: int = 200_000,
 
 
 def apply_bins_csr(csr, mapper: BinMapper) -> np.ndarray:
-    """CSR → dense row-major uint8 bins: initialize every cell to its
-    feature's zero-bin, then scatter the explicit entries column-by-column.
+    """CSR → dense row-major uint8 bins (packed when the mapper bundles).
     Bit-identical to ``apply_bins`` on the densified matrix."""
+    out = _apply_bins_csr_raw(csr, mapper)
+    spec = getattr(mapper, "bundles", None)
+    if spec is not None:
+        out = pack_bundles(out, spec)
+    return out
+
+
+def _apply_bins_csr_raw(csr, mapper: BinMapper) -> np.ndarray:
+    """Original-feature-space CSR binning: initialize every cell to its
+    feature's zero-bin, then scatter the explicit entries column-by-column."""
     n, f = csr.shape
     edges32 = mapper.edges.astype(np.float32)
     zero_bins = np.clip(

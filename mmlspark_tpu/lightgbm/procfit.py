@@ -229,7 +229,11 @@ def worker_fit(ctx) -> Dict[str, Any]:
         init_score[None, :], (y_l.shape[0], num_classes)
     ).astype(np.float32).copy()
     if k:
-        contrib = _make_tree_contrib(opts.routing_steps)
+        # bins are EFB-packed when the shared mapper carries a bundle plan;
+        # journaled trees are in original feature ids like any fit's
+        contrib = _make_tree_contrib(
+            opts.routing_steps, getattr(mapper, "bundles", None)
+        )
         bins_dev = np.asarray(bins_l, dtype=np.int32)
         for tr in trees:
             margins = margins + np.asarray(contrib(

@@ -472,7 +472,54 @@ def _split_search(
     )
 
 
-def _hist_fn(opts: TrainOptions, mesh=None, u_spec=None, hist_reduce=None):
+def _bundle_route_consts(bundle):
+    """Device views of the per-original-feature routing arrays (col, lo,
+    span, skip, dflt) — host lru-cached numpy underneath, so traces close
+    over stable constants."""
+    from mmlspark_tpu.lightgbm.bundling import route_maps
+
+    return tuple(jnp.asarray(a) for a in route_maps(bundle))
+
+
+def _orig_bins(packed_cols, feats, consts):
+    """Packed column values → ORIGINAL-feature bin ids at a routing site.
+
+    ``packed_cols`` holds bin values already gathered from each feature's
+    packed column (any shape broadcastable with ``feats``); ``feats`` are
+    original feature ids. q = xb - lo recovers the member-local offset,
+    the +1 skip jump crosses the member's elided default bin, and any
+    out-of-span value means some OTHER member of the bundle was
+    non-default — i.e. this feature sat at its default bin."""
+    _, lo, span, skip, dflt = consts
+    xb = packed_cols.astype(jnp.int32)
+    q = xb - lo[feats]
+    inb = (q >= 0) & (q < span[feats])
+    return jnp.where(inb, q + (q >= skip[feats]).astype(jnp.int32), dflt[feats])
+
+
+def _expand_bundled(h, totals, bundle, num_bins):
+    """Bundle-space histogram (k, C, B_b, 3) → original space (k, F, B, 3).
+
+    Runs ONCE per pass, after the optional cross-process reduce (so the
+    allreduce payload stays in the smaller packed space). Each original
+    feature's non-default bins gather straight out of its packed column;
+    the default bin is recovered by subtraction from the per-node totals
+    (LightGBM's most_freq_bin trick) — counts stay exact, grad/hess exact
+    up to f32 association order."""
+    from mmlspark_tpu.lightgbm.bundling import expand_maps
+
+    cidx, gmask, dmask = expand_maps(bundle, num_bins)
+    k = h.shape[0]
+    flat = h.reshape(k, -1, 3)  # (k, C*B_b, 3)
+    dense = jnp.take(flat, jnp.asarray(cidx.reshape(-1)), axis=1)
+    dense = dense.reshape(k, bundle.num_features, num_bins, 3)
+    dense = dense * jnp.asarray(gmask)[None, :, :, None]
+    resid = totals[:, None, :] - dense.sum(axis=2)
+    return dense + jnp.asarray(dmask)[None, :, :, None] * resid[:, :, None, :]
+
+
+def _hist_fn(opts: TrainOptions, mesh=None, u_spec=None, hist_reduce=None,
+             bundle=None):
     """Histogram builder honoring the tree_learner choice. Returns a
     callable producing (hist (k,F,B,3), totals (k,3)); ``feature_mask``
     (featureFraction) steers voting so reduced histograms are spent only
@@ -523,14 +570,24 @@ def _hist_fn(opts: TrainOptions, mesh=None, u_spec=None, hist_reduce=None):
     def full(bins, grad, hess, count, node, num_nodes, num_bins,
              feature_mask=None, u=None, stats=None):
         if u is not None and u_spec is not None and 3 * num_nodes <= 128:
-            from mmlspark_tpu.ops.u_histogram import build_histograms_u
+            if u_spec.chunk_rows:
+                from mmlspark_tpu.ops.u_histogram import (
+                    build_histograms_u_chunked,
+                )
 
-            h = build_histograms_u(
-                u, grad, hess, count, node, num_nodes, u_spec, stats=stats,
-            )
+                h = build_histograms_u_chunked(
+                    u, grad, hess, count, node, num_nodes, u_spec, stats=stats,
+                )
+            else:
+                from mmlspark_tpu.ops.u_histogram import build_histograms_u
+
+                h = build_histograms_u(
+                    u, grad, hess, count, node, num_nodes, u_spec, stats=stats,
+                )
         else:
             h = build_histograms(
-                bins, grad, hess, count, node, num_nodes, num_bins,
+                bins, grad, hess, count, node, num_nodes,
+                bundle.num_bins if bundle is not None else num_bins,
                 method=method, chunk_rows=(mesh is None),
             )
         if hist_reduce is not None:
@@ -541,7 +598,10 @@ def _hist_fn(opts: TrainOptions, mesh=None, u_spec=None, hist_reduce=None):
                 hist_reduce, jax.ShapeDtypeStruct(h.shape, h.dtype), h,
                 vmap_method="expand_dims",
             )
-        return h, h[:, 0, :, :].sum(axis=1)  # feature 0 covers all rows
+        totals = h[:, 0, :, :].sum(axis=1)  # feature/column 0 covers all rows
+        if bundle is not None:
+            h = _expand_bundled(h, totals, bundle, num_bins)
+        return h, totals
 
     return full
 
@@ -565,11 +625,13 @@ def _build_tree_depthwise(
     lr=None,
     u=None,
     qkey=None,
+    bundle=None,
 ) -> TreeArrays:
-    n, f = bins.shape
+    n = bins.shape[0]
     b = num_bins
     depth = opts.depth
     stats = _tree_stats(grad, hess, count, qkey) if u is not None else None
+    rconsts = _bundle_route_consts(bundle) if bundle is not None else None
 
     node = jnp.zeros(n, dtype=jnp.int32)  # heap position
     alive = jnp.ones(1, dtype=bool)
@@ -609,10 +671,16 @@ def _build_tree_depthwise(
             iscat_lv.append(can_split & s.is_cat)
             catmask_lv.append(s.cat_mask & can_split[:, None])
 
-        # Route rows down one level.
+        # Route rows down one level. Split features/bins live in ORIGINAL
+        # space (histograms are expanded before the search); under bundling
+        # the row's value gathers from the feature's packed column and
+        # decodes back to an original bin before the compare.
         row_f = feat_lv[-1][local]
         row_b = bin_lv[-1][local]
-        x_bin = jnp.take_along_axis(bins, row_f[:, None], axis=1)[:, 0]
+        row_c = rconsts[0][row_f] if rconsts is not None else row_f
+        x_bin = jnp.take_along_axis(bins, row_c[:, None], axis=1)[:, 0]
+        if rconsts is not None:
+            x_bin = _orig_bins(x_bin, row_f, rconsts)
         go_right = x_bin > row_b
         if has_cat:
             ic = iscat_lv[-1][local]
@@ -690,6 +758,7 @@ def _build_tree_leafwise(
     u=None,
     u_spec=None,
     qkey=None,
+    bundle=None,
 ) -> TreeArrays:
     """Best-first growth, ``leaf_batch`` frontier leaves per histogram pass.
 
@@ -705,7 +774,12 @@ def _build_tree_leafwise(
     2j+1 and 2j+2, so the layout is deterministic and static-shaped
     (M = 2*num_leaves - 1) and ``k = 1`` reproduces the sequential layout
     bit-for-bit."""
-    n, f = bins.shape
+    # Under bundling ``bins`` is (N, C) packed columns while the histogram
+    # cache / subtraction / search all live in ORIGINAL feature space —
+    # f here sizes those, NOT the packed width.
+    n = bins.shape[0]
+    f = bundle.num_features if bundle is not None else bins.shape[1]
+    rconsts = _bundle_route_consts(bundle) if bundle is not None else None
     b = num_bins
     num_leaves = opts.num_leaves
     m = 2 * num_leaves - 1
@@ -757,10 +831,22 @@ def _build_tree_leafwise(
     # XLA does not hoist the gather out of the loop body; left inside it
     # re-sliced ~90 MB per pass and cost ~1 s per mixed fit, measured r5).
     u_cat = fr_dev = lrow_dev = None
-    if has_cat and u is not None and u_spec is not None:
-        from mmlspark_tpu.ops.u_histogram import cat_row_maps
+    if (
+        has_cat and u is not None and u_spec is not None
+        and not u_spec.chunk_rows  # chunked u is a bins stack, not a one-hot
+    ):
+        if bundle is not None:
+            # categoricals are identity columns under bundling: only the
+            # column lookup changes; the matmul still matches ORIGINAL ids
+            from mmlspark_tpu.lightgbm.bundling import cat_row_maps_bundled
 
-        rows_np, fr_np, lr_np = cat_row_maps(u_spec, opts.categorical_slots)
+            rows_np, fr_np, lr_np = cat_row_maps_bundled(
+                u_spec, bundle, opts.categorical_slots
+            )
+        else:
+            from mmlspark_tpu.ops.u_histogram import cat_row_maps
+
+            rows_np, fr_np, lr_np = cat_row_maps(u_spec, opts.categorical_slots)
         u_cat = u[jnp.asarray(rows_np)]
         fr_dev = jnp.asarray(fr_np)
         lrow_dev = jnp.asarray(lr_np)
@@ -859,8 +945,14 @@ def _build_tree_leafwise(
             in_set = membership_matmul(u_cat, fr_dev, lrow_dev, sf, scm, n)
         # One (N, k) gather for all k split columns — k separate lane-axis
         # dynamic slices each paid their own relayout (measured ~2 ms/tree
-        # at k=16); jnp.take batches them into a single op.
-        cols = jnp.take(bins, sf, axis=1)  # (N, k)
+        # at k=16); jnp.take batches them into a single op. Under bundling
+        # the gather targets the packed columns and decodes to original
+        # bins for the whole (N, k) block at once.
+        if rconsts is not None:
+            cols = jnp.take(bins, rconsts[0][sf], axis=1)  # (N, k) packed
+            cols = _orig_bins(cols, sf, rconsts)
+        else:
+            cols = jnp.take(bins, sf, axis=1)  # (N, k)
         for jj in range(k):
             colj = cols[:, jj]
             in_j = (node == top_l[jj]) & can[jj]
@@ -1002,17 +1094,23 @@ def _build_tree_leafwise(
 
 def _route_binned(
     bins: jax.Array, feat, binthr, left, right, is_leaf, steps: int,
-    cat_node=None, cat_mask=None,
+    cat_node=None, cat_mask=None, bundle_consts=None,
 ) -> jax.Array:
     """Route binned rows through one pointer tree; returns final leaf slot.
     ``cat_mask`` (M, B) bool: at categorical nodes (``cat_node``) a row goes
-    LEFT iff its bin is in the node's set ((M, 1) placeholder = no cats)."""
+    LEFT iff its bin is in the node's set ((M, 1) placeholder = no cats).
+    ``bundle_consts`` (from :func:`_bundle_route_consts`): ``bins`` is EFB-
+    packed — gather each node's packed column and decode to the original
+    bin before the compare; tree arrays are always in original space."""
     n = bins.shape[0]
     node = jnp.zeros(n, dtype=jnp.int32)
     for _ in range(steps):
         fcur = feat[node]
         bcur = binthr[node]
-        x_bin = jnp.take_along_axis(bins, fcur[:, None], axis=1)[:, 0]
+        fcol = bundle_consts[0][fcur] if bundle_consts is not None else fcur
+        x_bin = jnp.take_along_axis(bins, fcol[:, None], axis=1)[:, 0]
+        if bundle_consts is not None:
+            x_bin = _orig_bins(x_bin, fcur, bundle_consts)
         go_left = x_bin <= bcur
         if cat_mask is not None and cat_mask.shape[-1] > 1:
             bwidth = cat_mask.shape[-1]
@@ -1033,12 +1131,12 @@ def _tree_stats(grad, hess, count, qkey=None):
 
 def _make_step(
     opts: TrainOptions, objective: Objective, num_bins: int, mesh=None,
-    n_real: Optional[int] = None, u_spec=None, hist_reduce=None,
+    n_real: Optional[int] = None, u_spec=None, hist_reduce=None, bundle=None,
 ):
     build = (
         _build_tree_leafwise if opts.growth == "leafwise" else _build_tree_depthwise
     )
-    histf = _hist_fn(opts, mesh, u_spec, hist_reduce=hist_reduce)
+    histf = _hist_fn(opts, mesh, u_spec, hist_reduce=hist_reduce, bundle=bundle)
     obj_kwargs = {
         "num_classes": opts.num_class,
         "alpha": opts.alpha,
@@ -1078,7 +1176,7 @@ def _make_step(
             return build(
                 bins, g, h, count, edges, feature_mask,
                 num_bins=num_bins, opts=opts, histf=histf, lr=lr, u=u,
-                qkey=qk, **kw,
+                qkey=qk, bundle=bundle, **kw,
             )
 
         if opts.use_quantized_grad and u is not None:
@@ -1283,15 +1381,18 @@ def _mask_schedule(opts: "TrainOptions", rng, n, pad, num_bag, num_feat, f,
         yield bag, changed, fm
 
 
-def _make_tree_contrib(steps: int):
+def _make_tree_contrib(steps: int, bundle=None):
     """(N, C) margin contribution of ONE tree-round on a binned matrix —
-    used by dart mode to subtract dropped trees."""
+    used by dart mode to subtract dropped trees. ``bundle``: the matrix is
+    EFB-packed; routing decodes per-node original bins on the fly."""
+    consts = _bundle_route_consts(bundle) if bundle is not None else None
 
     @jax.jit
     def contrib(bins_v, feat, bthr, lc, rc, il, vals, catn, catm):
         def per_class(f_, b_, l_, r_, i_, v_, cn_, cm_):
             leaf = _route_binned(
-                bins_v, f_, b_, l_, r_, i_, steps, cat_node=cn_, cat_mask=cm_
+                bins_v, f_, b_, l_, r_, i_, steps, cat_node=cn_, cat_mask=cm_,
+                bundle_consts=consts,
             )
             return v_[leaf]
 
@@ -1300,8 +1401,8 @@ def _make_tree_contrib(steps: int):
     return contrib
 
 
-def _make_valid_update(steps: int):
-    contrib = _make_tree_contrib(steps)
+def _make_valid_update(steps: int, bundle=None):
+    contrib = _make_tree_contrib(steps, bundle)
 
     def update(bins_v, margins_v, tree):
         return margins_v + contrib(
@@ -1406,6 +1507,26 @@ def train(
     num_classes = objective.num_outputs_fn(opts.num_class)
     n, f = bins.shape
     num_bins = opts.max_bin + 1  # + missing bin
+    # EFB: when the mapper carries a bundle plan, ``bins`` is the PACKED
+    # (N, C) matrix. Histograms build in packed space and expand to the
+    # original (k, F, B, 3) before the split search, so everything from the
+    # search down (tree arrays, model text, SHAP) stays in original ids;
+    # f_feat sizes the original-feature surfaces (feature_fraction masks).
+    bundle = getattr(mapper, "bundles", None) if mapper is not None else None
+    if bundle is not None:
+        if f != bundle.num_columns:
+            raise ValueError(
+                f"bundled mapper expects packed bins with {bundle.num_columns} "
+                f"columns, got {f} — bin through apply_bins/bin_dataset with "
+                "this mapper"
+            )
+        if opts.tree_learner == "voting_parallel":
+            raise ValueError(
+                "featureBundling is not supported with tree_learner="
+                "'voting_parallel' (voting's top-K feature exchange needs "
+                "per-feature histograms on the wire)"
+            )
+    f_feat = bundle.num_features if bundle is not None else f
     # The mapper is the single source of truth for categorical features
     # (LightGBMBase.scala:148-156 likewise resolves slots before training).
     if mapper is not None and mapper.cat_values:
@@ -1464,7 +1585,7 @@ def train(
         sh_rows = data_sharding(mesh)
         sh_rep = replicated(mesh)
         model_size = int(mesh.shape.get(AXIS_MODEL, 1))
-        if model_size > 1 and f % model_size == 0:
+        if model_size > 1 and f % model_size == 0 and bundle is None:
             # feature parallel: bins vertically partitioned over the model
             # axis (LightGBM's feature_parallel layout); XLA partitions the
             # histogram build/split search and inserts the best-split
@@ -1546,10 +1667,22 @@ def train(
             )
         )
     ):
-        from mmlspark_tpu.ops.u_histogram import make_u_spec, u_bytes
+        from mmlspark_tpu.ops.u_histogram import (
+            chunked_u_spec,
+            make_u_spec,
+            num_u_chunks,
+            u_bytes,
+        )
 
-        per_feature = None if mapper is None else [int(x) for x in mapper.num_bins]
-        cand = make_u_spec(num_bins, f, per_feature)
+        if bundle is not None:
+            # U laid out over the PACKED columns — K = Σ bundle widths is
+            # the whole point: fewer one-hot rows to re-stream per pass.
+            cand = make_u_spec(
+                bundle.num_bins, f, [int(wd) for wd in bundle.widths]
+            )
+        else:
+            per_feature = None if mapper is None else [int(x) for x in mapper.num_bins]
+            cand = make_u_spec(num_bins, f, per_feature)
         try:
             budget = int(_os.environ.get("MMLSPARK_TPU_U_BUDGET", str(8 << 30)))
         except ValueError:
@@ -1561,18 +1694,36 @@ def train(
                 _os.environ["MMLSPARK_TPU_U_BUDGET"],
             )
             budget = 8 << 30
-        if u_bytes(n + pad, cand) <= budget:
-            u_spec = cand
-        elif opts.histogram_method == "u":
-            # an explicitly forced U path must not silently degrade
+        if u_bytes(n + pad, cand) > budget:
+            # Over budget: stream the pass in row chunks instead of
+            # abandoning the MXU path wholesale (the pre-chunking behavior
+            # was an all-or-nothing cliff: one row past the budget and the
+            # whole fit fell back to the compare-built kernels).
+            cand = chunked_u_spec(n + pad, cand, budget)
+        u_spec = cand
+        if u_spec.chunk_rows:
+            chunks = num_u_chunks(n + pad, u_spec)
             from mmlspark_tpu.core.profiling import get_logger
 
-            get_logger("mmlspark_tpu.lightgbm").warning(
-                "histogram_method='u' requested but U needs %.1f GB > budget "
-                "%.1f GB (MMLSPARK_TPU_U_BUDGET); falling back to the "
-                "compare-built histogram path",
-                u_bytes(n + pad, cand) / 1e9, budget / 1e9,
+            get_logger("mmlspark_tpu.lightgbm").info(
+                "U one-hot (%.1f GB) exceeds MMLSPARK_TPU_U_BUDGET (%.1f GB);"
+                " streaming each histogram pass in %d row chunks of %d",
+                u_bytes(n + pad, dataclasses.replace(u_spec, chunk_rows=0))
+                / 1e9,
+                budget / 1e9, chunks, u_spec.chunk_rows,
             )
+            from mmlspark_tpu.observability.events import (
+                HistogramChunked,
+                get_bus,
+            )
+
+            bus = get_bus()
+            if bus.active:
+                bus.publish(HistogramChunked(
+                    rows=n + pad, k_packed=u_spec.k_pad,
+                    chunk_rows=u_spec.chunk_rows, num_chunks=chunks,
+                    budget_bytes=budget,
+                ))
 
     if opts.use_quantized_grad:
         reason = None
@@ -1623,7 +1774,7 @@ def train(
             opts.depth,
         )
 
-    okey = (_opts_key(opts), num_bins, mesh, u_spec, objective.cache_token)
+    okey = (_opts_key(opts), num_bins, mesh, u_spec, bundle, objective.cache_token)
     if opts.boosting_type == "goss":
         okey = okey + (n,)  # GOSS bakes the unpadded row count into the program
     _prof = get_profiler()
@@ -1637,25 +1788,35 @@ def train(
             hist_reduce = _prof.wrap_host(hist_reduce, "gbdt.hist_allreduce")
         step_raw = _make_step(
             opts, objective, num_bins, mesh, n_real=n, u_spec=u_spec,
-            hist_reduce=hist_reduce,
+            hist_reduce=hist_reduce, bundle=bundle,
         )
         step = jax.jit(step_raw, donate_argnums=(3,))
     else:
         step_raw = _cached_program(
             ("step_raw", okey),
-            lambda: _make_step(opts, objective, num_bins, mesh, n_real=n, u_spec=u_spec),
+            lambda: _make_step(
+                opts, objective, num_bins, mesh, n_real=n, u_spec=u_spec,
+                bundle=bundle,
+            ),
         )
         step = _cached_program(
             ("step_jit", okey), lambda: jax.jit(step_raw, donate_argnums=(3,))
         )
     u_builder = None
     if u_spec is not None:
-        from mmlspark_tpu.ops.u_histogram import build_u
+        if u_spec.chunk_rows:
+            # chunked pass consumes a (num_chunks, F, chunk) bins stack
+            # laid out once per fit, not the resident one-hot
+            from mmlspark_tpu.ops.u_histogram import prepare_chunked_bins
 
-        u_builder = partial(build_u, spec=u_spec)
+            u_builder = partial(prepare_chunked_bins, spec=u_spec)
+        else:
+            from mmlspark_tpu.ops.u_histogram import build_u
+
+            u_builder = partial(build_u, spec=u_spec)
     valid_update = _cached_program(
-        ("valid_update", opts.routing_steps),
-        lambda: _make_valid_update(opts.routing_steps),
+        ("valid_update", opts.routing_steps, bundle),
+        lambda: _make_valid_update(opts.routing_steps, bundle),
     )
 
     valid_sets = list(valid_sets or [])
@@ -1683,7 +1844,7 @@ def train(
 
     rng = np.random.default_rng(opts.seed)
     num_bag = max(1, int(round(n * opts.bagging_fraction)))
-    num_feat = max(1, int(round(f * opts.feature_fraction)))
+    num_feat = max(1, int(round(f_feat * opts.feature_fraction)))
 
     from mmlspark_tpu.lightgbm.callbacks import (
         CallbackEnv,
@@ -1722,7 +1883,7 @@ def train(
         if pad == 0
         else jnp.ones(n + pad, jnp.float32).at[n:].set(0.0)
     )
-    fm_ones_dev = put_rep(np.ones(f, dtype=np.float32))
+    fm_ones_dev = put_rep(np.ones(f_feat, dtype=np.float32))
 
     # Fast path: no per-iteration host decisions (no valid-set metrics, no
     # mesh special-casing) — run every boosting iteration in ONE device
@@ -1731,7 +1892,7 @@ def train(
     # feature sampling, rng stream order) are identical.
     stacked_trees = None
     schedule = _mask_schedule(
-        opts, rng, n, pad, num_bag, num_feat, f, presence, y=y_np
+        opts, rng, n, pad, num_bag, num_feat, f_feat, presence, y=y_np
     )
     bag_resampling = _bagging_active(opts)
     # The scan path materializes an (iterations, N) uint8 bagging-mask array
@@ -1756,7 +1917,7 @@ def train(
         bag_list, fm_list = [], []
         for bag_np, _, fm_np in schedule:
             bag_list.append(bag_np)
-            fm_list.append(fm_np if fm_np is not None else np.ones(f, np.float32))
+            fm_list.append(fm_np if fm_np is not None else np.ones(f_feat, np.float32))
         if bag_resampling:
             # uint8 on the wire (masks are 0/1; 4x less than f32 — transfers
             # are the fixed cost on remote-attached chips); cast per scan step
@@ -1845,8 +2006,8 @@ def train(
             )
             u_dev = u_jit(bins_dev)
         tree_contrib = _cached_program(
-            ("tree_contrib", opts.routing_steps),
-            lambda: _make_tree_contrib(opts.routing_steps),
+            ("tree_contrib", opts.routing_steps, bundle),
+            lambda: _make_tree_contrib(opts.routing_steps, bundle),
         )
 
         def contrib_of(tr, bins_v):

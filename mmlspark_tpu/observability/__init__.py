@@ -44,7 +44,9 @@ from mmlspark_tpu.observability.events import (
     Event,
     EventBus,
     EventLogSink,
+    FeatureBundled,
     GroupReformed,
+    HistogramChunked,
     ModelCommitted,
     ModelSwapped,
     ProcessLost,
@@ -110,10 +112,12 @@ __all__ = [
     "EventBus",
     "EventLogSink",
     "FIT_BUCKETS",
+    "FeatureBundled",
     "FunctionProfile",
     "Gauge",
     "GroupReformed",
     "Histogram",
+    "HistogramChunked",
     "MetricsRegistry",
     "ModelCommitted",
     "ModelSwapped",

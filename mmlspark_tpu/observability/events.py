@@ -308,6 +308,41 @@ class ProfileExecuted(Event):
     seconds: float
 
 
+# -- gbdt histogram engine ---------------------------------------------------
+
+
+@_event
+class HistogramChunked(Event):
+    """A GBDT fit's precomputed-U one-hot exceeded ``MMLSPARK_TPU_U_BUDGET``
+    and the histogram pass was row-chunked instead of abandoning the MXU
+    path (``lightgbm/train.py``): each pass streams ``num_chunks`` chunks
+    of ``chunk_rows`` rows, rebuilding the chunk's one-hot in-trace and
+    accumulating partial histograms."""
+
+    rows: int
+    k_packed: int
+    chunk_rows: int
+    num_chunks: int
+    budget_bytes: int
+
+
+@_event
+class FeatureBundled(Event):
+    """Exclusive Feature Bundling fitted at binning time
+    (``lightgbm/bundling.py``): ``k_before``/``k_after`` are Σ per-feature
+    bin widths before/after packing — the HBM re-stream every histogram
+    pass pays — and ``conflicts`` counts sampled rows where two bundled
+    members were simultaneously non-default (bounded by
+    ``max_conflict_rate`` x sample)."""
+
+    num_features: int
+    num_columns: int
+    k_before: int
+    k_after: int
+    conflicts: int
+    sample_rows: int
+
+
 # -- resilience --------------------------------------------------------------
 
 

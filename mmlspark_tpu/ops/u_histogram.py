@@ -96,6 +96,15 @@ class USpec:
     k: int  # sum of widths
     k_pad: int  # k rounded up to the sublane block
     num_bins: int  # dense histogram width B the caller expects
+    # 0 = fit-resident U (build_u once, stream it every pass). > 0 = the
+    # ROW-CHUNKED pass: no full U is ever materialized — each histogram
+    # pass scans ``chunk_rows``-row chunks of the (pre-laid-out) bins,
+    # builds that chunk's one-hot in-trace, contracts it against the
+    # chunk's stat panel, and accumulates the packed partial histograms
+    # (build_histograms_u_chunked). This is how the MXU path survives past
+    # the ~1M-row residency cliff: HBM holds one bins copy + O(chunk)
+    # transients instead of the full K_pad x N_pad int8 U.
+    chunk_rows: int = 0
 
     @property
     def num_features(self) -> int:
@@ -122,6 +131,46 @@ def u_bytes(n_rows: int, spec: USpec) -> int:
     """Resident HBM cost of the int8 U for ``n_rows`` (pre-padding)."""
     n_pad = ((n_rows + _N_ALIGN - 1) // _N_ALIGN) * _N_ALIGN
     return n_pad * spec.k_pad
+
+
+def chunked_u_spec(n_rows: int, spec: USpec, budget: int) -> USpec:
+    """Derive the row-chunked variant of ``spec`` sized to ``budget``
+    (MMLSPARK_TPU_U_BUDGET): the per-chunk one-hot transient
+    (chunk_rows x k_pad int8) is capped at HALF the budget — the scan
+    keeps the current chunk plus the double-buffered next one in flight —
+    and chunk_rows stays a multiple of the row-alignment block."""
+    per_row = max(1, spec.k_pad)
+    target = max(budget // 2, per_row * _N_ALIGN)
+    chunk = max(_N_ALIGN, (target // per_row) // _N_ALIGN * _N_ALIGN)
+    n_pad = ((n_rows + _N_ALIGN - 1) // _N_ALIGN) * _N_ALIGN
+    chunk = min(chunk, n_pad)
+    return dataclasses.replace(spec, chunk_rows=int(chunk))
+
+
+def num_u_chunks(n_rows: int, spec: USpec) -> int:
+    """Chunk count of one histogram pass for a chunked spec."""
+    if not spec.chunk_rows:
+        return 1
+    return -(-n_rows // spec.chunk_rows)
+
+
+def prepare_chunked_bins(bins: jax.Array, spec: USpec) -> jax.Array:
+    """One-time per-fit layout for the chunked pass: (N, F) bins →
+    (num_chunks, F, chunk_rows) uint8, feature-major within each chunk so
+    the in-trace one-hot build gathers rows exactly like :func:`build_u`.
+    Pad rows keep bin value 0 — a VALID one-hot column — and are silenced
+    by the pass itself (their node key is padded to -1, so their panel
+    columns are zero and they contribute nothing)."""
+    n, f = bins.shape
+    chunk = spec.chunk_rows
+    if not chunk:
+        raise ValueError("prepare_chunked_bins needs a chunked spec")
+    m = -(-n // chunk)
+    pad = m * chunk - n
+    x = bins.astype(jnp.uint8)
+    if pad:
+        x = jnp.pad(x, ((0, pad), (0, 0)))
+    return x.reshape(m, chunk, f).transpose(0, 2, 1)
 
 
 @functools.lru_cache(maxsize=64)
@@ -418,20 +467,7 @@ def build_histograms_u(
         packed = _fused_panel_dot(u, aux, k, quant=scales is not None)
         packed = packed[:, : 3 * k]
     else:
-        # (3k, N) stat-major transposed panel: row s*k+j carries stat s for
-        # rows whose node key is j, 0 elsewhere. node broadcasts across
-        # SUBLANES (cheap); no lane-dim relayout anywhere.
-        key = jnp.tile(jnp.arange(k, dtype=jnp.int32), 3)[:, None]  # (3k, 1)
-        mask_t = key == node.astype(jnp.int32)[None, :]  # (3k, N)
-        zero = jnp.int8(0) if scales is not None else jnp.bfloat16(0)
-        vals_t = jnp.repeat(stats, k, axis=0)  # (3k, N) bf16 | int8
-        panel_t = jnp.where(mask_t, vals_t, zero)
-        if n_pad != n:
-            panel_t = jnp.pad(panel_t, ((0, 0), (0, n_pad - n)))
-        # Materialize: without the barrier XLA re-fuses the panel build into
-        # the dot's rhs load and recomputes it per K-tile (~2x slower).
-        panel_t = lax.optimization_barrier(panel_t)
-
+        panel_t = _stat_panel_t(stats, node, k, n_pad)
         if scales is not None:
             packed = lax.dot_general(
                 u, panel_t,
@@ -443,16 +479,125 @@ def build_histograms_u(
                 (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32,
             )  # (K_pad, 3k)
 
+    return _expand_packed(packed, scales, spec, k)
+
+
+def _stat_panel_t(
+    stats: jax.Array,  # (3, N) bf16 | int8
+    node: jax.Array,  # (N,)
+    k: int,
+    n_pad: int,
+) -> jax.Array:
+    """(3k, N_pad) stat-major transposed panel: row s*k+j carries stat s
+    for rows whose node key is j, 0 elsewhere. node broadcasts across
+    SUBLANES (cheap); no lane-dim relayout anywhere. Materialized behind
+    an optimization barrier: without it XLA re-fuses the panel build into
+    the dot's rhs load and recomputes it per K-tile (~2x slower)."""
+    n = node.shape[0]
+    key = jnp.tile(jnp.arange(k, dtype=jnp.int32), 3)[:, None]  # (3k, 1)
+    mask_t = key == node.astype(jnp.int32)[None, :]  # (3k, N)
+    zero = jnp.int8(0) if stats.dtype == jnp.int8 else jnp.bfloat16(0)
+    vals_t = jnp.repeat(stats, k, axis=0)  # (3k, N) bf16 | int8
+    panel_t = jnp.where(mask_t, vals_t, zero)
+    if n_pad != n:
+        panel_t = jnp.pad(panel_t, ((0, 0), (0, n_pad - n)))
+    return lax.optimization_barrier(panel_t)
+
+
+def _expand_packed(packed: jax.Array, scales, spec: USpec, k: int) -> jax.Array:
+    """Shared pass tail: dequantize (quant path — row s*k+j carries stat
+    s, so the (3, k) reshape broadcasts each stat's scale over its k node
+    columns) and expand the packed (K_pad, 3k) result to the dense
+    (k, F, B, 3) histogram via the static gather maps."""
     if scales is not None:
-        # shared dequant: row s*k+j carries stat s, so the (3, k) reshape
-        # broadcasts each stat's scale over its k node columns
         packed = (
             packed.reshape(-1, 3, k).astype(jnp.float32)
             * scales[None, :, None]
         ).reshape(-1, 3 * k)
-
     f, b = spec.num_features, spec.num_bins
     idx, mask = _dense_maps_cached(spec)
     dense = packed[idx.reshape(-1)].reshape(f, b, 3 * k)
     dense = dense * mask[:, :, None]
     return dense.reshape(f, b, 3, k).transpose(3, 0, 1, 2)
+
+
+def build_histograms_u_chunked(
+    bins_chunks: jax.Array,  # (m, F, chunk) uint8 from prepare_chunked_bins
+    grad: jax.Array,  # (N,) — ignored when stats is given
+    hess: jax.Array,
+    count: jax.Array,
+    node: jax.Array,  # (N,) int32; out-of-range => row contributes nothing
+    num_nodes: int,
+    spec: USpec,  # chunked (spec.chunk_rows > 0)
+    *,
+    stats=None,  # (3, N) bf16 from stat_rows(), or (stats_i8, scales) quant
+) -> jax.Array:
+    """Row-chunked variant of :func:`build_histograms_u` — same contract,
+    same precision model, but NO fit-resident U: a ``lax.scan`` walks the
+    pre-laid-out bins chunks, rebuilds each chunk's one-hot in-trace (the
+    same 128-row K-block gather loop as :func:`build_u`), contracts it
+    against the chunk's stat panel, and accumulates the packed (K_pad, 3k)
+    partial histograms — int32 (exact) on the quantized path, f32
+    otherwise (partial-sum association differs from the resident pass only
+    within f32 rounding, the precision the compare-built kernels already
+    carry). The scan's sequential chunks let XLA double-buffer the next
+    chunk's bins stream behind the current contraction, so past the
+    residency cliff the pass stays MXU-bound instead of falling back to
+    the compare-built slow path.
+
+    Pad rows (the m*chunk - N tail) carry bin 0 — a valid one-hot column —
+    but their node key is padded to -1, so their panel columns are zero
+    and they contribute nothing, exactly like build_u's -1 pad rows."""
+    scales = None
+    if isinstance(stats, tuple):
+        stats, scales = stats
+    if 3 * num_nodes > _LANE:
+        raise ValueError(f"panel width 3*{num_nodes} exceeds one lane group")
+    k = num_nodes
+    m, _, chunk = bins_chunks.shape
+    n = node.shape[0]
+    if stats is None:
+        stats = stat_rows(grad, hess, count)
+    quant = scales is not None
+
+    total = m * chunk
+    node_p = node.astype(jnp.int32)
+    if total != n:
+        node_p = jnp.pad(node_p, (0, total - n), constant_values=-1)
+        stats = jnp.pad(stats, ((0, 0), (0, total - n)))
+    node_c = node_p.reshape(m, chunk)
+    stats_c = stats.reshape(3, m, chunk).transpose(1, 0, 2)  # (m, 3, chunk)
+
+    feat_of_col, local_of_col = _col_maps_cached(spec)
+    fo = feat_of_col.reshape(-1, _LANE)
+    lo = local_of_col.reshape(-1, _LANE)
+
+    def chunk_step(acc, xs):
+        ids_t, nd, st = xs  # (F, chunk) u8, (chunk,) i32, (3, chunk)
+        ids32 = ids_t.astype(jnp.int32)
+
+        def block(_, fl):
+            fb, lb = fl
+            rows = jnp.take(ids32, fb, axis=0)  # (128, chunk)
+            return None, (rows == lb[:, None]).astype(jnp.int8)
+
+        _, u_c = lax.scan(block, None, (fo, lo))
+        u_c = u_c.reshape(spec.k_pad, chunk)
+        panel_t = _stat_panel_t(st, nd, k, chunk)
+        if quant:
+            part = lax.dot_general(
+                u_c, panel_t,
+                (((1,), (1,)), ((), ())), preferred_element_type=jnp.int32,
+            )
+        else:
+            part = lax.dot_general(
+                u_c.astype(jnp.bfloat16), panel_t,
+                (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32,
+            )
+        return acc + part, None
+
+    acc0 = jnp.zeros(
+        (spec.k_pad, 3 * k), jnp.int32 if quant else jnp.float32
+    )
+    packed, _ = lax.scan(chunk_step, acc0, (bins_chunks, node_c, stats_c))
+    return _expand_packed(packed, scales, spec, k)

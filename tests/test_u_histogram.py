@@ -320,6 +320,117 @@ class TestQuantizedGrad:
         assert auc(y, p, np.ones(n)) > 0.9
 
 
+class TestChunkedU:
+    """Row-chunked U pass: past the one-hot residency cliff the histogram
+    pass streams row chunks through the same MXU contraction instead of
+    falling back to the compare-built path (the old all-or-nothing budget
+    cliff). Selection is pure host logic, so the >1M-row regression guard
+    runs devicelessly in CI."""
+
+    def test_over_budget_1m_shape_selects_chunked_mxu_path(self):
+        # CI guard: the headline >1M-row shape (28 features x 256 bins)
+        # must stream chunks on the MXU path, never fall off it
+        from mmlspark_tpu.ops.u_histogram import chunked_u_spec, num_u_chunks
+
+        spec = make_u_spec(256, 28)
+        budget = 8 << 30  # the MMLSPARK_TPU_U_BUDGET default
+        rows = 1_500_000
+        assert u_bytes(rows, spec) > budget  # resident U would blow HBM
+        c = chunked_u_spec(rows, spec, budget)
+        assert c.chunk_rows > 0, "over-budget shape must chunk, not fall back"
+        assert c.chunk_rows % 512 == 0  # row-alignment block
+        assert c.widths == spec.widths and c.k_pad == spec.k_pad
+        # double-buffered scan: current + next chunk one-hots fit the budget
+        assert 2 * c.chunk_rows * c.k_pad <= budget
+        assert num_u_chunks(rows, c) * c.chunk_rows >= rows
+        # under-budget shapes keep the resident layout
+        assert u_bytes(400_000, spec) <= budget
+
+    def test_tiny_budget_floors_at_one_aligned_chunk(self):
+        from mmlspark_tpu.ops.u_histogram import chunked_u_spec, num_u_chunks
+
+        spec = make_u_spec(32, 7, per_feature=[32, 5, 17, 32, 2, 9, 31])
+        c = chunked_u_spec(3000, spec, budget=1)
+        assert c.chunk_rows == 512  # floor: one alignment block
+        assert num_u_chunks(3000, c) == 6
+
+    @pytest.mark.parametrize("quant", [False, True])
+    def test_chunked_matches_resident(self, quant):
+        import jax
+
+        from mmlspark_tpu.ops.u_histogram import (
+            build_histograms_u_chunked,
+            chunked_u_spec,
+            prepare_chunked_bins,
+            stat_rows_quant,
+        )
+
+        widths, f, b, bins, g, h, c, node = _mixed_case()
+        k = 5
+        spec = make_u_spec(b, f, per_feature=widths)
+        u = build_u(jnp.asarray(bins), spec)
+        if quant:
+            stats = stat_rows_quant(
+                jnp.asarray(g), jnp.asarray(h), jnp.asarray(c),
+                jax.random.PRNGKey(5),
+            )
+        else:
+            stats = None
+        ref = np.asarray(build_histograms_u(
+            u, jnp.asarray(g), jnp.asarray(h), jnp.asarray(c),
+            jnp.asarray(node), k, spec, stats=stats,
+        ))
+        cspec = chunked_u_spec(len(bins), spec, budget=1)  # 512-row chunks
+        chunks = prepare_chunked_bins(jnp.asarray(bins), cspec)
+        assert chunks.shape == (6, f, 512)
+        out = np.asarray(build_histograms_u_chunked(
+            chunks, jnp.asarray(g), jnp.asarray(h), jnp.asarray(c),
+            jnp.asarray(node), k, cspec, stats=stats,
+        ))
+        np.testing.assert_array_equal(out[..., 2], ref[..., 2])  # counts
+        if quant:
+            # integer accumulation: chunked partial sums are bit-exact
+            np.testing.assert_array_equal(out, ref)
+        else:
+            # f32 accumulation: association differs only at rounding level
+            np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-4)
+
+    def test_train_over_budget_streams_chunks_and_publishes_event(
+        self, monkeypatch
+    ):
+        from mmlspark_tpu.observability import HistogramChunked, get_bus
+
+        rng = np.random.default_rng(29)
+        n = 3000
+        X = rng.normal(size=(n, 8))
+        y = ((X[:, 0] * 1.5 + X[:, 1] * X[:, 2]) > 0).astype(np.float64)
+        bins, mp = bin_dataset(X, max_bin=63)
+        opts = TrainOptions(objective="binary", num_iterations=6,
+                            num_leaves=15, max_bin=63, histogram_method="u")
+        r_resident = train(bins, y, opts, mapper=mp)
+
+        seen = []
+        bus = get_bus()
+        bus.add_listener(seen.append)
+        try:
+            monkeypatch.setenv("MMLSPARK_TPU_U_BUDGET", "200000")
+            r_chunked = train(bins, y, opts, mapper=mp)
+        finally:
+            bus.remove_listener(seen.append)
+        ev = [e for e in seen if isinstance(e, HistogramChunked)]
+        assert ev, "over-budget fit must publish HistogramChunked"
+        assert ev[0].num_chunks > 1 and ev[0].chunk_rows % 512 == 0
+        assert ev[0].budget_bytes == 200_000
+        # same trees as the resident pass (f32 association tolerance)
+        np.testing.assert_allclose(
+            r_chunked.booster.leaf_values, r_resident.booster.leaf_values,
+            rtol=1e-4, atol=1e-5,
+        )
+        a = auc(y, r_chunked.booster.raw_margin(X)[:, 0], np.ones(n))
+        ar = auc(y, r_resident.booster.raw_margin(X)[:, 0], np.ones(n))
+        assert abs(a - ar) < 0.002, (a, ar)
+
+
 class TestFusedPanelDot:
     """The opt-in Pallas fusion (MMLSPARK_TPU_U_FUSED) must match the
     two-op XLA formulation bit-for-bit on the quant path and to bf16
