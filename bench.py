@@ -546,6 +546,17 @@ def main():
         "gbdt_real_auc_tpu_bundled": round(rb_auc, 5),
         "gbdt_real_bundling_dauc": round(abs(r_auc - rb_auc), 6),
     }
+    # When the digits fallback is active because a covtype download was
+    # tried and failed (network-less container), carry the recorded
+    # attempt so the provenance is "attempted, unreachable" rather than
+    # silently synthetic-adjacent.
+    attempt_path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "tests", "fixtures", "covtype_fetch_attempt.json",
+    )
+    if r_src != "covtype_sample" and os.path.exists(attempt_path):
+        with open(attempt_path) as f:
+            real["gbdt_real_covtype_fetch_attempt"] = json.load(f)
     try:
         rc_secs, rc_margins, _rclf = _fit_cpu(rXtr, rytr, rXte)
         real["gbdt_real_cpu_fit_secs"] = round(rc_secs, 3)

@@ -45,6 +45,7 @@ from mmlspark_tpu.observability.events import (
     EventBus,
     EventLogSink,
     FeatureBundled,
+    FleetScaled,
     GroupReformed,
     HistogramChunked,
     ModelCommitted,
@@ -53,6 +54,7 @@ from mmlspark_tpu.observability.events import (
     ProcessStarted,
     ProfileCompiled,
     ProfileExecuted,
+    RequestRouted,
     RequestServed,
     RequestShed,
     StageCompleted,
@@ -113,6 +115,7 @@ __all__ = [
     "EventLogSink",
     "FIT_BUCKETS",
     "FeatureBundled",
+    "FleetScaled",
     "FunctionProfile",
     "Gauge",
     "GroupReformed",
@@ -125,6 +128,7 @@ __all__ = [
     "ProcessStarted",
     "ProfileCompiled",
     "ProfileExecuted",
+    "RequestRouted",
     "RequestServed",
     "RequestShed",
     "SLOReport",

@@ -233,6 +233,36 @@ class ModelCommitted(Event):
     detail: str = ""
 
 
+# -- serving fleet -----------------------------------------------------------
+
+
+@_event
+class FleetScaled(Event):
+    """The autoscaler changed the fleet size: ``direction`` is "up" or
+    "down", ``replicas`` the fleet size AFTER the action, ``replica`` the
+    spawned/retired index, ``reason`` the signal that drove the decision
+    (e.g. ``"inflight 9.5 > 8.0"``)."""
+
+    direction: str
+    replicas: int
+    replica: int = -1
+    reason: str = ""
+
+
+@_event
+class RequestRouted(Event):
+    """The front-end router answered one request: ``replica`` is the
+    endpoint that produced the final answer, ``hops`` the number of
+    replica attempts it took (1 = first try; >1 means failovers the
+    client never saw)."""
+
+    rid: str
+    replica: str
+    hops: int
+    status: int
+    latency: float
+
+
 # -- streaming ---------------------------------------------------------------
 
 
@@ -590,6 +620,10 @@ def timeline(events: Iterable[Event]) -> Dict[str, Any]:
     streaming = {"epochs": 0, "rows": 0, "source_units": 0}
     stream_epochs: Dict[str, List[int]] = {}
     swaps: List[Dict[str, Any]] = []
+    fleet: List[Dict[str, Any]] = []
+    routing = {"count": 0, "hops": 0, "failovers": 0}
+    routed_statuses: Dict[int, int] = {}
+    routed_by_replica: Dict[str, int] = {}
     #: per-function compile/execute fold from Profile* events
     profiler: Dict[str, Dict[str, Any]] = {}
     for ev in events:
@@ -651,6 +685,19 @@ def timeline(events: Iterable[Event]) -> Dict[str, Any]:
         elif isinstance(ev, ModelSwapped):
             swaps.append({"name": ev.name, "version": ev.version,
                           "server": ev.server})
+        elif isinstance(ev, FleetScaled):
+            fleet.append({"direction": ev.direction, "replicas": ev.replicas,
+                          "replica": ev.replica, "reason": ev.reason,
+                          "t": ev.t})
+        elif isinstance(ev, RequestRouted):
+            routing["count"] += 1
+            routing["hops"] += ev.hops
+            if ev.hops > 1:
+                routing["failovers"] += 1
+            routed_statuses[ev.status] = routed_statuses.get(ev.status, 0) + 1
+            routed_by_replica[ev.replica] = (
+                routed_by_replica.get(ev.replica, 0) + 1
+            )
         elif isinstance(ev, RequestShed):
             shed += 1
         elif isinstance(ev, BreakerTripped):
@@ -686,6 +733,10 @@ def timeline(events: Iterable[Event]) -> Dict[str, Any]:
         "models": models,
         "streaming": dict(streaming, queries=stream_epochs),
         "swaps": swaps,
+        "fleet": fleet,
+        "routing": dict(
+            routing, statuses=routed_statuses, by_replica=routed_by_replica,
+        ),
         "breaker_trips": breaker_trips,
         "quarantines": quarantines,
         "paroles": paroles,
@@ -757,6 +808,24 @@ def format_timeline(summary: Dict[str, Any]) -> str:
     b, r = summary["batches"], summary["requests"]
     lines.append(f"== serving == batches={b['count']} rows={b['rows']} "
                  f"requests={r['count']} shed={r.get('shed', 0)}")
+    routing = summary.get("routing") or {}
+    if routing.get("count"):
+        avg_hops = routing["hops"] / routing["count"]
+        lines.append(
+            f"== routing == requests={routing['count']} "
+            f"failovers={routing['failovers']} avg_hops={avg_hops:.2f}"
+            + (" (" + ", ".join(
+                f"{name} x{n}"
+                for name, n in sorted((routing.get("by_replica") or {}).items())
+            ) + ")" if routing.get("by_replica") else "")
+        )
+    fleet = summary.get("fleet") or []
+    if fleet:
+        lines.append("== fleet == " + ", ".join(
+            f"{f['direction']}->{f['replicas']}"
+            + (f" ({f['reason']})" if f.get("reason") else "")
+            for f in fleet
+        ))
     trips = summary.get("breaker_trips") or {}
     if trips:
         lines.append("== breakers == " + ", ".join(

@@ -220,6 +220,29 @@ def render_report(
     else:
         sections.append('<p class="muted">no serving traffic in this log</p>')
 
+    fleet = summary.get("fleet") or []
+    routing = summary.get("routing") or {}
+    if fleet or routing.get("count"):
+        sections.append("<h2>Fleet</h2>")
+        if routing.get("count"):
+            avg = routing["hops"] / routing["count"]
+            sections.append(
+                f"<p>routed={routing['count']} "
+                f"failovers={routing['failovers']} avg_hops={avg:.2f}</p>"
+            )
+            by_replica = routing.get("by_replica") or {}
+            if by_replica:
+                sections.append(_table(
+                    ["replica", "requests"],
+                    [[_esc(k), v] for k, v in sorted(by_replica.items())],
+                ))
+        if fleet:
+            sections.append(_table(
+                ["direction", "fleet size", "replica", "reason"],
+                [[_esc(f["direction"]), f["replicas"], f.get("replica", -1),
+                  _esc(f.get("reason", ""))] for f in fleet],
+            ))
+
     breakers = summary["breaker_trips"]
     swaps = summary["swaps"]
     if breakers or swaps:

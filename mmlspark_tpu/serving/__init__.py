@@ -1,6 +1,8 @@
 """Model serving (reference Spark Serving, SURVEY.md §2.16)."""
 
+from mmlspark_tpu.serving.fleet import FleetController
 from mmlspark_tpu.serving.replicas import ReplicaSupervisor
+from mmlspark_tpu.serving.router import FleetRouter
 from mmlspark_tpu.serving.server import (
     DistributedServingServer,
     RegistrationService,
@@ -12,6 +14,8 @@ from mmlspark_tpu.serving.server import (
 
 __all__ = [
     "DistributedServingServer",
+    "FleetController",
+    "FleetRouter",
     "RegistrationService",
     "ReplicaSupervisor",
     "ServiceInfo",

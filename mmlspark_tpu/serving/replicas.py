@@ -27,7 +27,10 @@ import json
 import os
 import subprocess
 import sys
+import threading
 import time
+import urllib.error
+import urllib.request
 from pathlib import Path
 from typing import Any, Dict, List, Optional
 
@@ -61,15 +64,53 @@ def demo_model_factory(spec: Dict[str, Any]):
     return model
 
 
+def _registry_post(base: str, path: str, payload: Dict[str, Any]) -> None:
+    """One POST to the registration service (raises on HTTP error)."""
+    req = urllib.request.Request(
+        base.rstrip("/") + path,
+        data=json.dumps(payload).encode(),
+        method="POST",
+        headers={"Content-Type": "application/json"},
+    )
+    urllib.request.urlopen(req, timeout=5).read()
+
+
+def _registry_reporter(server, registry_url: str, interval_s: float,
+                       stop_evt: threading.Event) -> None:
+    """Replica-side lease loop: register once, then heartbeat the live
+    load metadata (``heartbeat_stats``) every ``interval_s``. A 404 means
+    the lease expired (registry restart / TTL lapse while this process was
+    stalled) — re-register from scratch. A down registry never stops the
+    replica serving; the loop just retries next tick."""
+    registered = False
+    while not stop_evt.is_set():
+        stats = server.heartbeat_stats()
+        try:
+            if not registered:
+                _registry_post(registry_url, "/register", stats)
+                registered = True
+            else:
+                _registry_post(registry_url, "/heartbeat", stats)
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                registered = False
+        except Exception:  # noqa: BLE001 - registry down; keep serving
+            pass
+        stop_evt.wait(interval_s)
+
+
 def _replica_main(workdir: str, index: int) -> int:
     """One replica process: load the model via the factory entry, serve on
-    the assigned port, heartbeat until the supervisor's stop file."""
+    the assigned port, heartbeat until the supervisor's stop file (global
+    ``stop`` or the per-replica ``stop-<index>`` the retire path writes)."""
     from mmlspark_tpu.serving.server import ServingServer
 
     wd = Path(workdir)
     spec = json.loads((wd / f"replica-{index}.json").read_text())
     hb = _Heartbeat(wd / f"hb-{index}", interval=spec.get("hb_interval_s", 0.5))
     hb.start()
+    reg_stop = threading.Event()
+    registry_url = spec.get("registry_url")
     try:
         model = _resolve_entry(spec["factory"])(spec)
         server = ServingServer(
@@ -80,11 +121,42 @@ def _replica_main(workdir: str, index: int) -> int:
             **spec.get("server_options", {}),
         )
         with server:
+            swap = spec.get("hot_swap")
+            if swap:
+                # the replica watches ModelStore CURRENT itself, so a
+                # mid-campaign commit swaps every replica with no restart
+                server.enable_hot_swap(
+                    _resolve_entry(swap["loader"]),
+                    root=swap.get("root"),
+                    name=swap.get("name", "model"),
+                    poll_s=float(swap.get("poll_s", 0.25)),
+                )
+            if registry_url:
+                threading.Thread(
+                    target=_registry_reporter,
+                    args=(server, registry_url,
+                          float(spec.get("registry_heartbeat_s", 0.5)),
+                          reg_stop),
+                    daemon=True, name=f"replica-registry-{index}",
+                ).start()
             _write_json(wd / f"ready-{index}.json",
                         {"url": server.info.url, "pid": os.getpid(),
                          "port": server.info.port})
-            while not (wd / "stop").exists():
+            while not (wd / "stop").exists() \
+                    and not (wd / f"stop-{index}").exists():
                 time.sleep(0.1)
+            if registry_url:
+                # graceful exit: release the lease now instead of letting
+                # it ride out the TTL (the retire path also deregisters
+                # supervisor-side; a second deregister is a harmless 404)
+                reg_stop.set()
+                try:
+                    _registry_post(
+                        registry_url, "/deregister",
+                        {"name": server.info.name},
+                    )
+                except Exception:  # noqa: BLE001 - registry already gone
+                    pass
         return 0
     except Exception as e:  # noqa: BLE001 - report, then die visibly
         import traceback
@@ -94,6 +166,7 @@ def _replica_main(workdir: str, index: int) -> int:
                      "traceback": traceback.format_exc()})
         return 1
     finally:
+        reg_stop.set()
         hb.stop()
 
 
@@ -122,6 +195,9 @@ class ReplicaSupervisor:
         heartbeat_timeout_s: float = 10.0,
         ready_timeout_s: float = 30.0,
         health=None,
+        registry_url: Optional[str] = None,
+        registry_heartbeat_s: float = 0.5,
+        hot_swap: Optional[Dict[str, Any]] = None,
     ):
         from mmlspark_tpu.observability.registry import get_registry
         from mmlspark_tpu.runtime.health import HealthTracker
@@ -148,10 +224,20 @@ class ReplicaSupervisor:
         self.health = health or HealthTracker(
             threshold=2.0, window_s=600.0, parole_s=600.0
         )
+        #: replicas POST /register + /heartbeat (with load metadata) here;
+        #: retire_replica POSTs /deregister — the fleet control plane
+        self.registry_url = registry_url
+        self.registry_heartbeat_s = float(registry_heartbeat_s)
+        #: optional ModelStore hot-swap spec passed through to every
+        #: replica: {"loader": "module:fn", "root": ..., "name": ...}
+        self.hot_swap = dict(hot_swap) if hot_swap else None
         self.exit_statuses: List[ExitStatus] = []
         self._procs: Dict[int, subprocess.Popen] = {}
         self._generations: Dict[int, int] = {}
         self._ports: Dict[int, int] = {}
+        #: indices retired by the autoscaler: never respawned, never reused
+        self._retired: set = set()
+        self._next_index = int(num_replicas)
         reg = get_registry()
         self._metrics = {
             "started": reg.counter(
@@ -176,15 +262,22 @@ class ReplicaSupervisor:
             exclude=set(self._ports.values()),
         )
         self._ports[index] = port
-        for stale in (f"ready-{index}.json", f"failed-{index}.json"):
+        for stale in (f"ready-{index}.json", f"failed-{index}.json",
+                      f"stop-{index}"):
             try:
                 (self.workdir / stale).unlink()
             except OSError:
                 pass
-        _write_json(self.workdir / f"replica-{index}.json", {
+        spec: Dict[str, Any] = {
             "factory": self.factory, "host": self.host, "port": port,
             "name": self.name, "server_options": self.server_options,
-        })
+        }
+        if self.registry_url:
+            spec["registry_url"] = self.registry_url
+            spec["registry_heartbeat_s"] = self.registry_heartbeat_s
+        if self.hot_swap:
+            spec["hot_swap"] = self.hot_swap
+        _write_json(self.workdir / f"replica-{index}.json", spec)
         log_fh = open(self.workdir / f"log-{index}-{gen}.txt", "wb")
         try:
             proc = subprocess.Popen(
@@ -258,6 +351,8 @@ class ReplicaSupervisor:
 
         losses: List[ExitStatus] = []
         for index, proc in list(self._procs.items()):
+            if index in self._retired:
+                continue  # retire_replica owns this slot's teardown
             rc = proc.poll()
             if rc is None and not self._hb_stale(index):
                 continue
@@ -294,6 +389,75 @@ class ReplicaSupervisor:
         while time.monotonic() < deadline:
             self.poll()
             time.sleep(interval_s)
+
+    # -- fleet scaling (driven by FleetController) ---------------------------
+
+    @property
+    def live_count(self) -> int:
+        return len(self._procs)
+
+    def add_replica(self, ready_timeout_s: Optional[float] = None) -> int:
+        """Scale up by one: spawn a replica on a fresh index and block
+        until its ready file appears (or it dies trying). Returns the new
+        index. Retired indices are never reused, so the registry name
+        ``<name>-<index>`` stays unambiguous across the fleet's life."""
+        index = self._next_index
+        self._next_index += 1
+        self._spawn(index)
+        deadline = time.monotonic() + (ready_timeout_s or self.ready_timeout_s)
+        ready = self.workdir / f"ready-{index}.json"
+        while time.monotonic() < deadline:
+            if ready.exists():
+                self._metrics["up"].set(len(self._procs))
+                return index
+            proc = self._procs.get(index)
+            if proc is not None and proc.poll() is not None:
+                failed = self.workdir / f"failed-{index}.json"
+                detail = failed.read_text() if failed.exists() else ""
+                raise RuntimeError(
+                    f"replica {index} died during scale-up "
+                    f"(rc={proc.returncode}): {detail[:500]}"
+                )
+            time.sleep(0.05)
+        raise TimeoutError(f"replica {index} not ready during scale-up")
+
+    def retire_replica(self, index: int, grace_s: float = 5.0) -> ExitStatus:
+        """Scale down by one: deregister ``<name>-<index>`` from the
+        registration service FIRST (no router sends it another request),
+        then signal the per-replica stop file and wait for a graceful
+        exit. The index is marked retired so :meth:`poll` never respawns
+        it — an intentional retire is not a loss."""
+        if index not in self._procs:
+            raise KeyError(f"replica {index} is not running")
+        self._retired.add(index)
+        if self.registry_url:
+            try:
+                _registry_post(
+                    self.registry_url, "/deregister",
+                    {"name": f"{self.name}-{index}"},
+                )
+            except Exception:  # noqa: BLE001 - registry down; retire anyway
+                logger.warning("deregister of replica %d failed", index,
+                               exc_info=True)
+        _write_json(self.workdir / f"stop-{index}", {"at": time.time()})
+        proc = self._procs.pop(index)
+        deadline = time.monotonic() + grace_s
+        while proc.poll() is None and time.monotonic() < deadline:
+            time.sleep(0.05)
+        if proc.poll() is None:
+            proc.terminate()
+            try:
+                proc.wait(timeout=2.0)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait()
+        rc = proc.returncode
+        status = ExitStatus(index, proc.pid, rc, "retired",
+                            self._generations[index])
+        self.exit_statuses.append(status)
+        self._metrics["up"].set(len(self._procs))
+        logger.info("replica %d retired (rc=%s)", index, rc)
+        return status
 
     # -- teardown ------------------------------------------------------------
 

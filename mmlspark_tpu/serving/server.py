@@ -96,16 +96,34 @@ class ServiceInfo:
     ``model_version`` is lease metadata: the ModelStore version this
     replica currently serves (None = untracked). Hot swaps and warm
     restarts refresh it, so ``GET /services`` shows which version each
-    replica answers with."""
+    replica answers with.
+
+    ``inflight``/``shed_total``/``p99_ms`` are *load* metadata, refreshed
+    by heartbeats: the signals the fleet router (least-loaded balancing)
+    and autoscaler (scale-up/down decisions) steer by without any private
+    handle into the replica process — ``/services`` is the whole
+    control-plane contract (docs/serving_fleet.md)."""
 
     name: str
     host: str
     port: int
     model_version: Optional[int] = None
+    #: admitted-and-unanswered requests at last heartbeat (None = unreported)
+    inflight: Optional[int] = None
+    #: cumulative 429 sheds at last heartbeat
+    shed_total: Optional[int] = None
+    #: queue-wait p99 in milliseconds at last heartbeat
+    p99_ms: Optional[float] = None
 
     @property
     def url(self) -> str:
         return f"http://{self.host}:{self.port}/"
+
+
+#: ServiceInfo fields omitted from the ``/services`` wire format while
+#: unreported (None) — a lease that never heartbeat load metadata keeps
+#: the pre-fleet wire shape.
+_LOAD_FIELDS = frozenset({"inflight", "shed_total", "p99_ms"})
 
 
 class _BatchLoop:
@@ -653,6 +671,25 @@ class ServingServer(_ListenerMixin):
     def model(self):
         return self.loop.model
 
+    def heartbeat_stats(self) -> Dict[str, Any]:
+        """The register/heartbeat payload this replica reports about
+        itself: identity plus the live load metadata
+        (``inflight``/``shed_total``/``p99_ms``) the fleet router and
+        autoscaler steer by. Everything here is self-observed — the
+        control plane never needs a handle into the replica process."""
+        admission = self.loop.admission
+        return {
+            "name": self.info.name,
+            "host": self.info.host,
+            "port": self.info.port,
+            "model_version": self.model_version,
+            "inflight": admission.inflight if admission is not None else 0,
+            "shed_total": (
+                int(admission._shed.value) if admission is not None else 0
+            ),
+            "p99_ms": self.loop._reg_queue_wait.percentile(0.99) * 1e3,
+        }
+
     # -- hot swap (live model replacement, zero downtime) --------------------
 
     def enable_hot_swap(
@@ -763,6 +800,19 @@ class ServingServer(_ListenerMixin):
         self.stop()
 
 
+def _parse_load_metadata(info: Dict[str, Any]) -> Dict[str, Any]:
+    """The optional load fields of a register/heartbeat payload, validated.
+    Raises ``TypeError``/``ValueError`` on garbage (the caller answers 400)."""
+    out: Dict[str, Any] = {}
+    if info.get("inflight") is not None:
+        out["inflight"] = int(info["inflight"])
+    if info.get("shed_total") is not None:
+        out["shed_total"] = int(info["shed_total"])
+    if info.get("p99_ms") is not None:
+        out["p99_ms"] = float(info["p99_ms"])
+    return out
+
+
 class RegistrationService:
     """Driver-side endpoint registry (``DriverServiceUtils:113-173``):
     workers POST their ServiceInfo to ``/register``; clients GET
@@ -794,7 +844,7 @@ class RegistrationService:
 
         class Handler(BaseHTTPRequestHandler):
             def do_POST(self):  # noqa: N802
-                if self.path not in ("/register", "/heartbeat"):
+                if self.path not in ("/register", "/heartbeat", "/deregister"):
                     self.send_response(404)
                     self.end_headers()
                     return
@@ -807,11 +857,20 @@ class RegistrationService:
                     self.send_response(400)
                     self.end_headers()
                     return
+                if self.path == "/deregister":
+                    # explicit retire: the lease is released NOW, not at
+                    # TTL expiry — routers drop the replica on next poll
+                    self.send_response(
+                        200 if registry.deregister(name) else 404
+                    )
+                    self.end_headers()
+                    return
                 try:
                     raw_version = info.get("model_version")
                     model_version = (
                         int(raw_version) if raw_version is not None else None
                     )
+                    load = _parse_load_metadata(info)
                 except (TypeError, ValueError):
                     self.send_response(400)
                     self.end_headers()
@@ -819,7 +878,7 @@ class RegistrationService:
                 if self.path == "/heartbeat":
                     # lease refresh only: an unknown (expired/never-seen)
                     # name gets 404 so the replica knows to re-register
-                    if not registry.heartbeat(name, model_version):
+                    if not registry.heartbeat(name, model_version, **load):
                         self.send_response(404)
                         self.end_headers()
                         return
@@ -829,7 +888,7 @@ class RegistrationService:
                 try:
                     svc = ServiceInfo(
                         name, info["host"], int(info["port"]),
-                        model_version=model_version,
+                        model_version=model_version, **load,
                     )
                 except (KeyError, TypeError, ValueError) as e:
                     logger.debug("rejected malformed /register payload: %s", e)
@@ -843,9 +902,13 @@ class RegistrationService:
             def do_GET(self):  # noqa: N802
                 ctype = "application/json"
                 if self.path == "/services":
-                    body = json.dumps(
-                        [vars(s) for s in registry.services]
-                    ).encode()
+                    # load metadata is optional per lease: a replica that
+                    # never heartbeat it gets the pre-fleet wire shape
+                    body = json.dumps([
+                        {k: v for k, v in vars(s).items()
+                         if v is not None or k not in _LOAD_FIELDS}
+                        for s in registry.services
+                    ]).encode()
                 elif self.path == "/metrics":
                     body = get_registry().exposition().encode("utf-8")
                     ctype = "text/plain; version=0.0.4; charset=utf-8"
@@ -900,20 +963,44 @@ class RegistrationService:
             self._services[svc.name] = svc
             self._last_seen[svc.name] = self._clock()
 
-    def heartbeat(self, name: str, model_version: Optional[int] = None) -> bool:
+    def heartbeat(
+        self,
+        name: str,
+        model_version: Optional[int] = None,
+        inflight: Optional[int] = None,
+        shed_total: Optional[int] = None,
+        p99_ms: Optional[float] = None,
+    ) -> bool:
         """Refresh ``name``'s lease; False when the service is unknown
         (expired or never registered) — the replica must re-register.
         ``model_version`` updates the lease metadata so ``/services``
         tracks which model version the replica currently serves (a hot
-        swap shows up on the next heartbeat without re-registration)."""
+        swap shows up on the next heartbeat without re-registration);
+        ``inflight``/``shed_total``/``p99_ms`` refresh the load metadata
+        the fleet router and autoscaler read off ``/services``."""
         with self._lock:
             self._prune_expired()
             if name not in self._services:
                 return False
             self._last_seen[name] = self._clock()
+            svc = self._services[name]
             if model_version is not None:
-                self._services[name].model_version = int(model_version)
+                svc.model_version = int(model_version)
+            if inflight is not None:
+                svc.inflight = int(inflight)
+            if shed_total is not None:
+                svc.shed_total = int(shed_total)
+            if p99_ms is not None:
+                svc.p99_ms = float(p99_ms)
             return True
+
+    def deregister(self, name: str) -> bool:
+        """Drop ``name`` immediately (the autoscaler's retire path): the
+        next ``/services`` read no longer lists it, so no router sends it
+        another request. False when the name was not registered."""
+        with self._lock:
+            self._last_seen.pop(name, None)
+            return self._services.pop(name, None) is not None
 
     def start(self) -> "RegistrationService":
         threading.Thread(target=self._httpd.serve_forever, daemon=True).start()
@@ -1026,9 +1113,16 @@ class DistributedServingServer:
     def _heartbeat_once(self) -> None:
         """Refresh every listener's lease; a rejected heartbeat (lease
         already expired) falls back to a full re-registration."""
+        # all listeners share ONE loop/admission gate, so each lease
+        # reports the same (global) load metadata — the router divides
+        # traffic by replica, not by listener
+        admission = self.loop.admission
+        inflight = admission.inflight if admission is not None else None
         if self._registry is not None:
             for info in self.service_info:
-                if not self._registry.heartbeat(info.name, info.model_version):
+                if not self._registry.heartbeat(
+                    info.name, info.model_version, inflight=inflight
+                ):
                     self._registry.register(info)
         if self._registry_url:
             import urllib.request
@@ -1040,6 +1134,7 @@ class DistributedServingServer:
                     data=json.dumps({
                         "name": info.name,
                         "model_version": info.model_version,
+                        "inflight": inflight,
                     }).encode(),
                     method="POST",
                     headers={"Content-Type": "application/json"},

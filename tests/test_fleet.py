@@ -1,0 +1,606 @@
+"""Serving-fleet tests: router, autoscaler, and campaign payloads — real
+HTTP servers and an in-process registry, matching the test_serving.py
+posture (no subprocess replicas; the fleet-chaos CI job covers those)."""
+
+import json
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.data.table import Table
+from mmlspark_tpu.observability.events import (
+    FleetScaled,
+    RequestRouted,
+    get_bus,
+    timeline,
+)
+from mmlspark_tpu.observability.registry import MetricsRegistry
+from mmlspark_tpu.resilience.budget import RetryBudget
+from mmlspark_tpu.resilience.policy import RetryPolicy
+from mmlspark_tpu.runtime.faults import FaultPlan, inject_faults
+from mmlspark_tpu.runtime.journal import ModelStore
+from mmlspark_tpu.serving import (
+    FleetController,
+    FleetRouter,
+    RegistrationService,
+    ServiceInfo,
+    ServingServer,
+)
+from mmlspark_tpu.serving.fleet import (
+    sar_demo_factory,
+    store_model_factory,
+    store_model_loader,
+)
+
+
+def _const_model(value):
+    """table->table callable answering ``value`` for every row — replicas
+    with distinct values make routing decisions observable from replies."""
+
+    def model(table):
+        n = len(np.atleast_1d(np.asarray(table.column("input"))))
+        return Table({"prediction": np.full(n, float(value))})
+
+    return model
+
+
+def _post(url, payload, timeout=10, headers=None):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(), method="POST",
+        headers={"Content-Type": "application/json", **(headers or {})},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        body = e.read()
+        return e.code, (json.loads(body) if body else None)
+
+
+class _Fleet:
+    """Two in-process replicas (answers 1.0 and 2.0) registered in an
+    in-process registry, with isolated metrics registries so per-replica
+    request counts are assertable."""
+
+    def __init__(self):
+        self.registry = RegistrationService().start()
+        self.regs = {}
+        self.servers = {}
+        for name, value in (("replica-0", 1.0), ("replica-1", 2.0)):
+            reg = MetricsRegistry()
+            srv = ServingServer(
+                _const_model(value), name=name, max_latency_ms=0.5,
+                registry=reg,
+            ).start()
+            self.regs[name] = reg
+            self.servers[name] = srv
+            self.registry.register(srv.info)
+
+    def requests_served(self, name):
+        return self.regs[name].counter("serving_requests_total").value
+
+    def close(self):
+        for srv in self.servers.values():
+            srv.stop()
+        self.registry.stop()
+
+
+@pytest.fixture()
+def fleet():
+    f = _Fleet()
+    yield f
+    f.close()
+
+
+def _router(fleet, **kwargs):
+    kwargs.setdefault("registry", fleet.registry)
+    kwargs.setdefault("discovery_interval_s", 60.0)  # tests refresh by hand
+    return FleetRouter(**kwargs)
+
+
+class TestRouterRouting:
+    def test_routes_and_answers(self, fleet):
+        with _router(fleet) as router:
+            status, out = _post(router.url, {"input": 3.0})
+            assert status == 200
+            assert out["prediction"] in (1.0, 2.0)
+
+    def test_least_loaded_prefers_idle_replica(self, fleet):
+        # replica-0 heartbeats heavy load; every pick must go to replica-1
+        fleet.registry.heartbeat("replica-0", inflight=50)
+        fleet.registry.heartbeat("replica-1", inflight=0)
+        with _router(fleet) as router:
+            answers = {_post(router.url, {"input": 1.0})[1]["prediction"]
+                       for _ in range(8)}
+            assert answers == {2.0}
+
+    def test_consistent_hash_is_sticky_and_spreads(self, fleet):
+        with _router(fleet, policy="consistent_hash") as router:
+            for key in ("alpha", "beta", "gamma", "delta"):
+                answers = {
+                    _post(router.url, {"input": 1.0},
+                          headers={"X-Routing-Key": key})[1]["prediction"]
+                    for _ in range(5)
+                }
+                assert len(answers) == 1, f"key {key} moved between replicas"
+            spread = {
+                _post(router.url, {"input": 1.0},
+                      headers={"X-Routing-Key": f"key-{i}"})[1]["prediction"]
+                for i in range(32)
+            }
+            assert spread == {1.0, 2.0}
+
+    def test_deregistered_replica_never_receives_a_request(self, fleet):
+        with _router(fleet) as router:
+            fleet.registry.deregister("replica-1")
+            router.refresh()
+            before = fleet.requests_served("replica-1")
+            for _ in range(20):
+                status, out = _post(router.url, {"input": 1.0})
+                assert status == 200
+                assert out["prediction"] == 1.0  # only replica-0 answers
+            assert fleet.requests_served("replica-1") == before
+
+    def test_dead_replica_costs_one_hop_not_an_error(self, fleet):
+        # a ghost lease for an endpoint nobody listens on (the window
+        # between a replica dying and its lease expiring)
+        fleet.registry.register(ServiceInfo("replica-9", "127.0.0.1", 9))
+        fleet.registry.heartbeat("replica-9", inflight=0)
+        fleet.registry.heartbeat("replica-0", inflight=10)
+        fleet.registry.heartbeat("replica-1", inflight=10)
+        with _router(fleet) as router:
+            failovers0 = router._m_failovers.value
+            for _ in range(5):
+                status, out = _post(router.url, {"input": 1.0})
+                assert status == 200
+                assert out["prediction"] in (1.0, 2.0)
+            assert router._m_failovers.value > failovers0
+
+    def test_dead_replica_fails_over_even_with_drained_retry_budget(
+        self, fleet
+    ):
+        # the budget rations retries of attempts a replica actually
+        # processed; a connection fast-fail to a dead port did no work
+        # anywhere, so failover must happen even with zero retry tokens —
+        # otherwise a SIGKILL'd replica's stale lease turns into
+        # user-visible 502s until the TTL prunes it
+        fleet.registry.register(ServiceInfo("replica-9", "127.0.0.1", 9))
+        fleet.registry.heartbeat("replica-9", inflight=0)
+        fleet.registry.heartbeat("replica-0", inflight=10)
+        fleet.registry.heartbeat("replica-1", inflight=10)
+        policy = RetryPolicy(
+            max_attempts=3, budget=RetryBudget(ratio=0.0, min_tokens=0.0)
+        )
+        with _router(fleet, retry_policy=policy) as router:
+            for _ in range(5):
+                status, out = _post(router.url, {"input": 1.0})
+                assert status == 200
+                assert out["prediction"] in (1.0, 2.0)
+
+    def test_no_replicas_is_503(self):
+        with RegistrationService() as registry:
+            with FleetRouter(registry=registry,
+                             discovery_interval_s=60.0) as router:
+                status, out = _post(router.url, {"input": 1.0})
+                assert status == 503
+                assert "no live replicas" in out["error"]
+
+
+class _CaptureReplica:
+    """A bare HTTP endpoint that records request headers and answers a
+    fixed prediction — for asserting what the router forwards."""
+
+    def __init__(self):
+        seen = self.seen = []
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_POST(self):  # noqa: N802
+                self.rfile.read(int(self.headers.get("Content-Length", 0)))
+                seen.append(dict(self.headers.items()))
+                body = b'{"prediction": 7.0}'
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):
+                pass
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.httpd.daemon_threads = True
+        import threading
+
+        threading.Thread(target=self.httpd.serve_forever, daemon=True).start()
+        self.info = ServiceInfo(
+            "capture", "127.0.0.1", self.httpd.server_address[1]
+        )
+
+    def close(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+class TestRouterDeadlines:
+    def test_deadline_header_shrinks_across_the_hop(self):
+        capture = _CaptureReplica()
+        try:
+            with RegistrationService() as registry:
+                registry.register(capture.info)
+                with FleetRouter(registry=registry,
+                                 discovery_interval_s=60.0) as router:
+                    status, _ = _post(router.url, {"input": 1.0},
+                                      headers={"X-Deadline-Ms": "800"})
+                    assert status == 200
+            forwarded = float(capture.seen[0]["X-Deadline-Ms"])
+            assert 0 < forwarded <= 800
+        finally:
+            capture.close()
+
+    def test_request_never_exceeds_deadline_under_storm(self, fleet):
+        # every hop answers an injected 503; retries must stay inside the
+        # client's 250 ms budget (waits are clipped to the deadline)
+        plan = FaultPlan(seed=3).http_storm(count=100, status=503)
+        with _router(fleet) as router:
+            with inject_faults(plan):
+                t0 = time.monotonic()
+                status, _ = _post(router.url, {"input": 1.0},
+                                  headers={"X-Deadline-Ms": "250"})
+                elapsed = time.monotonic() - t0
+            assert status in (503, 504)
+            assert elapsed < 0.25 + 0.25, f"blew the deadline: {elapsed:.3f}s"
+
+    def test_retry_budget_bounds_failover(self, fleet):
+        # an empty budget means one hop per request, storm or not
+        policy = RetryPolicy(
+            max_attempts=4, base=0.001, cap=0.002, seed=0,
+            budget=RetryBudget(ratio=0.0, min_tokens=0.0),
+        )
+        plan = FaultPlan(seed=3).http_storm(count=50, status=503)
+        with _router(fleet, retry_policy=policy) as router:
+            hops0 = router._m_hops.value
+            with inject_faults(plan):
+                for _ in range(5):
+                    status, _ = _post(router.url, {"input": 1.0})
+                    assert status == 503  # passed through, not retried
+            assert router._m_hops.value - hops0 == 5
+
+    def test_retry_lands_on_a_different_replica(self, fleet):
+        # storm only replica-0's port; least-loaded prefers it (idle),
+        # the failover must answer from replica-1
+        fleet.registry.heartbeat("replica-0", inflight=0)
+        fleet.registry.heartbeat("replica-1", inflight=10)
+        port = fleet.servers["replica-0"].info.port
+        with _router(fleet) as router:
+            plan = FaultPlan(seed=3).http_storm(
+                count=1, status=503, url_part=f":{port}/"
+            )
+            with inject_faults(plan):
+                status, out = _post(router.url, {"input": 1.0})
+            assert status == 200
+            assert out["prediction"] == 2.0
+            assert plan.fired, "the storm never hit replica-0"
+
+    def test_tripped_breaker_takes_replica_out_of_rotation(self, fleet):
+        from mmlspark_tpu.resilience.breaker import BreakerRegistry
+
+        fleet.registry.heartbeat("replica-0", inflight=0)
+        fleet.registry.heartbeat("replica-1", inflight=10)
+        port = fleet.servers["replica-0"].info.port
+        breakers = BreakerRegistry(
+            failure_threshold=2, window_s=10.0, reset_timeout_s=30.0
+        )
+        with _router(fleet, breakers=breakers) as router:
+            plan = FaultPlan(seed=3).http_storm(
+                count=2, status=503, url_part=f":{port}/"
+            )
+            with inject_faults(plan):
+                for _ in range(2):
+                    status, _ = _post(router.url, {"input": 1.0})
+                    assert status == 200  # failover absorbed each 503
+            skips0 = router._m_skipped.value
+            for _ in range(4):
+                status, out = _post(router.url, {"input": 1.0})
+                assert status == 200
+                assert out["prediction"] == 2.0  # straight to replica-1
+            assert router._m_skipped.value > skips0
+
+
+class _FakeClock:
+    def __init__(self, t=100.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+class _StubSupervisor:
+    """The process plane reduced to bookkeeping — decide()/step() logic
+    is testable with zero subprocesses."""
+
+    def __init__(self, live=2, name="replica"):
+        self.name = name
+        self._procs = {i: object() for i in range(live)}
+        self._next_index = live
+        self.added = []
+        self.retired = []
+        self.polls = 0
+
+    @property
+    def live_count(self):
+        return len(self._procs)
+
+    def poll(self):
+        self.polls += 1
+        return []
+
+    def add_replica(self, ready_timeout_s=None):
+        index = self._next_index
+        self._next_index += 1
+        self._procs[index] = object()
+        self.added.append(index)
+        return index
+
+    def retire_replica(self, index, grace_s=5.0):
+        del self._procs[index]
+        self.retired.append(index)
+
+
+class _FakeRegistry:
+    """Just the two surfaces FleetController touches in-process."""
+
+    def __init__(self, services=()):
+        self.services = list(services)
+        self.deregistered = []
+
+    def deregister(self, name):
+        self.deregistered.append(name)
+        return True
+
+
+def _svc(i, inflight=0, shed=0, p99=1.0, name="replica"):
+    return ServiceInfo(f"{name}-{i}", "127.0.0.1", 10000 + i,
+                       inflight=inflight, shed_total=shed, p99_ms=p99)
+
+
+class TestFleetControllerDecide:
+    def _controller(self, sup, services, **kwargs):
+        clock = kwargs.pop("clock", _FakeClock())
+        kwargs.setdefault("min_replicas", 1)
+        kwargs.setdefault("max_replicas", 4)
+        kwargs.setdefault("scale_up_inflight", 4.0)
+        kwargs.setdefault("scale_down_inflight", 1.0)
+        kwargs.setdefault("cooldown_s", 3.0)
+        kwargs.setdefault("down_sustain_s", 2.0)
+        ctl = FleetController(sup, registry=_FakeRegistry(services),
+                              clock=clock, **kwargs)
+        return ctl, clock
+
+    def test_scales_up_on_inflight(self):
+        sup = _StubSupervisor(live=2)
+        ctl, _ = self._controller(sup, [])
+        decision = ctl.decide([_svc(0, inflight=6), _svc(1, inflight=8)])
+        assert decision is not None and decision[0] == "up"
+
+    def test_scales_up_on_shed_rate(self):
+        sup = _StubSupervisor(live=2)
+        ctl, clock = self._controller(sup, [])
+        assert ctl.decide([_svc(0, shed=0), _svc(1, shed=0)]) is None
+        clock.t += 1.0
+        decision = ctl.decide([_svc(0, shed=10), _svc(1, shed=0)])
+        assert decision is not None and decision[0] == "up"
+        assert "shed" in decision[1]
+
+    def test_no_scale_up_at_max(self):
+        sup = _StubSupervisor(live=2)
+        ctl, _ = self._controller(sup, [], max_replicas=2)
+        assert ctl.decide([_svc(0, inflight=9), _svc(1, inflight=9)]) is None
+
+    def test_scale_down_needs_sustained_idle(self):
+        sup = _StubSupervisor(live=3)
+        ctl, clock = self._controller(sup, [])
+        idle = [_svc(i, inflight=0) for i in range(3)]
+        assert ctl.decide(idle) is None  # first quiet sample: not yet
+        clock.t += 1.0
+        assert ctl.decide(idle) is None  # still inside down_sustain_s
+        clock.t += 1.5
+        decision = ctl.decide(idle)
+        assert decision is not None and decision[0] == "down"
+
+    def test_busy_sample_resets_the_idle_window(self):
+        sup = _StubSupervisor(live=3)
+        ctl, clock = self._controller(sup, [])
+        idle = [_svc(i, inflight=0) for i in range(3)]
+        assert ctl.decide(idle) is None
+        clock.t += 1.5
+        assert ctl.decide([_svc(i, inflight=9) for i in range(3)]) != \
+            (None, None)  # busy (scales up); idle window must reset
+        clock.t += 1.0
+        assert ctl.decide(idle) is None  # idle restarts from zero
+
+    def test_never_retires_below_min(self):
+        sup = _StubSupervisor(live=2)
+        ctl, clock = self._controller(sup, [], min_replicas=2)
+        idle = [_svc(0, inflight=0), _svc(1, inflight=0)]
+        ctl.decide(idle)
+        clock.t += 10.0
+        assert ctl.decide(idle) is None
+
+    def test_below_min_scales_up_even_when_idle(self):
+        sup = _StubSupervisor(live=1)
+        ctl, _ = self._controller(sup, [], min_replicas=2)
+        decision = ctl.decide([_svc(0, inflight=0)])
+        assert decision is not None and decision[0] == "up"
+        assert "below min" in decision[1]
+
+
+class TestFleetControllerStep:
+    def test_step_scales_up_publishes_and_cools_down(self):
+        sup = _StubSupervisor(live=2)
+        clock = _FakeClock()
+        busy = [_svc(0, inflight=8), _svc(1, inflight=8)]
+        registry = _FakeRegistry(busy)
+        ctl = FleetController(
+            sup, registry=registry, min_replicas=2, max_replicas=4,
+            scale_up_inflight=4.0, cooldown_s=3.0, clock=clock,
+        )
+        seen = []
+        bus = get_bus()
+        bus.add_listener(seen.append)
+        try:
+            assert ctl.step() == ("up", "inflight 8.0 >= 4")
+            assert sup.added == [2]
+            assert sup.polls == 1
+            # cooldown: the same pressure produces no second action
+            clock.t += 1.0
+            assert ctl.step() is None
+            clock.t += 5.0
+            assert ctl.step() == ("up", "inflight 8.0 >= 4")
+        finally:
+            bus.remove_listener(seen.append)
+        scaled = [e for e in seen if isinstance(e, FleetScaled)]
+        assert [e.direction for e in scaled] == ["up", "up"]
+        assert scaled[0].replicas == 3
+
+    def test_step_retires_least_loaded_and_deregisters(self):
+        sup = _StubSupervisor(live=3)
+        clock = _FakeClock()
+        idle = [_svc(0, inflight=3), _svc(1, inflight=0), _svc(2, inflight=1)]
+        registry = _FakeRegistry(idle)
+        ctl = FleetController(
+            sup, registry=registry, min_replicas=1, max_replicas=4,
+            scale_down_inflight=2.0, down_sustain_s=1.0, cooldown_s=0.5,
+            clock=clock,
+        )
+        assert ctl.step() is None  # idle window opens
+        clock.t += 1.5
+        assert ctl.step() == ("down", "idle 1.5s (inflight 1.3)")
+        assert sup.retired == [1]  # the idlest replica went first
+        assert registry.deregistered == ["replica-1"]
+
+
+class TestRegistryLoadMetadata:
+    def test_http_register_heartbeat_deregister_carry_load(self):
+        with RegistrationService() as registry:
+            base = registry.info.url.rstrip("/")
+
+            def post(path, payload):
+                req = urllib.request.Request(
+                    base + path, data=json.dumps(payload).encode(),
+                    method="POST",
+                    headers={"Content-Type": "application/json"},
+                )
+                with urllib.request.urlopen(req, timeout=5) as r:
+                    return r.status
+
+            assert post("/register", {
+                "name": "r0", "host": "127.0.0.1", "port": 12345,
+                "inflight": 3, "shed_total": 1, "p99_ms": 2.5,
+            }) == 200
+            svc = registry.services[0]
+            assert (svc.inflight, svc.shed_total, svc.p99_ms) == (3, 1, 2.5)
+
+            assert post("/heartbeat", {
+                "name": "r0", "inflight": 7, "shed_total": 4, "p99_ms": 9.0,
+            }) == 200
+            svc = registry.services[0]
+            assert (svc.inflight, svc.shed_total, svc.p99_ms) == (7, 4, 9.0)
+
+            with urllib.request.urlopen(base + "/services", timeout=5) as r:
+                listed = json.loads(r.read())
+            assert listed[0]["inflight"] == 7
+
+            assert post("/deregister", {"name": "r0"}) == 200
+            assert registry.services == []
+            with pytest.raises(urllib.error.HTTPError) as err:
+                post("/deregister", {"name": "r0"})
+            assert err.value.code == 404
+
+    def test_serving_server_reports_load_stats(self):
+        with ServingServer(_const_model(1.0),
+                           registry=MetricsRegistry()) as srv:
+            _post(srv.info.url, {"input": 1.0})
+            stats = srv.heartbeat_stats()
+            assert stats["name"] == srv.info.name
+            assert stats["inflight"] == 0  # idle again after the reply
+            assert stats["shed_total"] == 0
+            assert stats["p99_ms"] >= 0.0
+
+
+class TestCampaignPayloads:
+    def test_store_model_loader_parses_versions(self):
+        model = store_model_loader('{"scale": 3.0, "bias": 1.0}')
+        out = model(Table({"input": np.array([2.0, 4.0])}))
+        assert list(out.column("prediction")) == [7.0, 13.0]
+
+    def test_store_model_factory_serves_latest_commit(self, tmp_path):
+        store = ModelStore(str(tmp_path / "models"))
+        store.commit(json.dumps({"scale": 2.0}), name="model")
+        store.commit(json.dumps({"scale": 5.0, "bias": 1.0}), name="model")
+        model = store_model_factory(
+            {"hot_swap": {"root": str(tmp_path), "name": "model"}}
+        )
+        out = model(Table({"input": np.array([2.0])}))
+        assert out.column("prediction")[0] == 11.0
+
+    def test_sar_topk_served_end_to_end(self):
+        model = sar_demo_factory({"sar": {
+            "n_users": 16, "n_items": 8, "events": 300,
+            "num_items": 3, "seed": 1,
+        }})
+        with ServingServer(model, max_latency_ms=1.0,
+                           registry=MetricsRegistry()) as srv:
+            status, out = _post(srv.info.url, {"input": 2})
+            assert status == 200
+            recs = out["prediction"]
+            assert len(recs) == 3
+            assert all(0 <= i < 8 for i in recs)
+            assert len(set(recs)) == 3  # distinct top-k items
+            # cold start: unknown users get an answer, not an error
+            status, out = _post(srv.info.url, {"input": 999})
+            assert status == 200
+            assert out["prediction"] == [-1, -1, -1]
+
+
+class TestFleetObservability:
+    def test_timeline_folds_routing_and_fleet(self):
+        events = [
+            RequestRouted(rid="r1", replica="replica-0", hops=1,
+                          status=200, latency=0.01),
+            RequestRouted(rid="r2", replica="replica-1", hops=2,
+                          status=200, latency=0.02),
+            FleetScaled(direction="up", replicas=3, replica=2,
+                        reason="inflight"),
+        ]
+        tl = timeline(events)
+        assert tl["routing"]["count"] == 2
+        assert tl["routing"]["hops"] == 3
+        assert tl["routing"]["failovers"] == 1
+        assert tl["routing"]["by_replica"] == {
+            "replica-0": 1, "replica-1": 1,
+        }
+        (entry,) = tl["fleet"]
+        assert entry["direction"] == "up"
+        assert entry["replicas"] == 3
+        assert entry["replica"] == 2
+        assert entry["reason"] == "inflight"
+
+    def test_router_publishes_request_routed(self, fleet):
+        seen = []
+        bus = get_bus()
+        bus.add_listener(seen.append)
+        try:
+            with _router(fleet) as router:
+                status, _ = _post(router.url, {"input": 1.0})
+                assert status == 200
+        finally:
+            bus.remove_listener(seen.append)
+        routed = [e for e in seen if isinstance(e, RequestRouted)]
+        assert len(routed) == 1
+        assert routed[0].status == 200
+        assert routed[0].hops == 1
+        assert routed[0].replica in ("replica-0", "replica-1")

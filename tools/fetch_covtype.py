@@ -12,8 +12,11 @@ pine, ~49% of rows — vs rest), and writes
 to sklearn's bundled digits.
 
 Network-gated: the download needs outbound HTTPS. In a network-less
-container the script exits 2 with a message instead of a stack trace —
-run it once on a connected host and commit/copy the npz.
+container the script exits 2 with a message instead of a stack trace,
+and records the failed attempt in ``covtype_fetch_attempt.json`` next
+to the fixture target so ``bench.py`` can label the digits fallback
+with *why* it is a fallback — run it once on a connected host and
+commit/copy the npz.
 
 Usage::
 
@@ -21,8 +24,10 @@ Usage::
 """
 
 import argparse
+import json
 import os
 import sys
+from datetime import datetime, timezone
 
 
 def main() -> int:
@@ -53,6 +58,23 @@ def main() -> int:
             f"run it on a connected host): {e}",
             file=sys.stderr,
         )
+        attempt = os.path.join(
+            os.path.dirname(os.path.abspath(args.out)),
+            "covtype_fetch_attempt.json",
+        )
+        os.makedirs(os.path.dirname(attempt), exist_ok=True)
+        with open(attempt, "w") as f:
+            json.dump(
+                {
+                    "attempted_at": datetime.now(timezone.utc).isoformat(),
+                    "error": f"{type(e).__name__}: {e}",
+                    "rows_requested": args.rows,
+                    "seed": args.seed,
+                },
+                f,
+                indent=2,
+            )
+        print(f"attempt recorded at {attempt}", file=sys.stderr)
         return 2
 
     X = np.asarray(data.data, dtype=np.float32)
@@ -62,6 +84,9 @@ def main() -> int:
     out = os.path.abspath(args.out)
     os.makedirs(os.path.dirname(out), exist_ok=True)
     np.savez_compressed(out, X=X[idx], y=y[idx])
+    stale = os.path.join(os.path.dirname(out), "covtype_fetch_attempt.json")
+    if os.path.exists(stale):
+        os.remove(stale)
     print(
         f"wrote {out}: X={X[idx].shape} y positive rate "
         f"{float(y[idx].mean()):.3f}"

@@ -1,0 +1,462 @@
+#!/usr/bin/env python
+"""Chaos-under-load campaign against the serving fleet (CI: fleet-chaos).
+
+Stands up the WHOLE serving fleet with real processes — RegistrationService,
+N supervised replica processes (self-registering, heartbeating live load
+metadata), the deadline-aware FleetRouter in front, and the FleetController
+autoscaler — then runs a scripted campaign of closed-loop clients through
+the router while the chaos escalates:
+
+  warmup   light load; every reply checked against the committed model;
+  ramp     enough closed-loop clients to saturate the starting fleet —
+           heartbeat inflight/shed climbs, the autoscaler scales up;
+  kill     a replica process is SIGKILL'd mid-load: the router eats the
+           dead hops (failover, breaker), the supervisor respawns it, the
+           registry lease expires it out of rotation — clients never see
+           a non-shed 5xx;
+  storm    a seeded ``http_storm`` fault plan (``MMLSPARK_TPU_FAULT_SEED``)
+           injects synthetic 503s at the router->replica edge until the
+           victim's breaker trips; MID-STORM a new model version is
+           committed to the shared ModelStore and every replica hot-swaps
+           live — observed from the client side as the predictions flip;
+  drain    load drops to zero and the autoscaler retires capacity back
+           down to the floor, deregistering each victim first.
+
+Everything lands in ``--out``: the shared event log (router + controller
++ every replica append to it), ``slo.json``/``slo.md`` (the
+:class:`SLOReport` fold plus per-phase client stats and the campaign
+verdict), and ``report.html`` (the history-server render, Fleet section
+included). Exit 0 iff every campaign check passed.
+
+Usage:
+  python tools/loadgen.py --out /tmp/fleet-campaign --short
+  python tools/loadgen.py --payload sar --policy consistent_hash
+"""
+
+import argparse
+import json
+import os
+import signal
+import sys
+import tempfile
+import threading
+import time
+import urllib.error
+import urllib.request
+
+# runnable both installed (CI) and straight from a checkout
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+AFFINE_V1 = {"scale": 2.0, "bias": 0.0, "work_ms": 3.0}
+AFFINE_V2 = {"scale": 3.0, "bias": 1.0, "work_ms": 3.0}
+
+
+def _quantile(sorted_vals, q):
+    if not sorted_vals:
+        return 0.0
+    pos = q * (len(sorted_vals) - 1)
+    lo = int(pos)
+    hi = min(lo + 1, len(sorted_vals) - 1)
+    return sorted_vals[lo] + (sorted_vals[hi] - sorted_vals[lo]) * (pos - lo)
+
+
+class LoadClients:
+    """Closed-loop client pool: each worker POSTs to the router, waits for
+    the reply, and immediately sends the next request. Concurrency is the
+    load knob; every outcome is recorded under the current phase label."""
+
+    def __init__(self, url, deadline_ms=1500.0, payload="affine"):
+        self.url = url
+        self.deadline_ms = float(deadline_ms)
+        self.payload = payload
+        self.phase = "idle"
+        self.records = []  # (phase, status, latency_s, input, output)
+        self._lock = threading.Lock()
+        self._workers = []  # (thread, stop_event)
+
+    def _one(self, x):
+        body = json.dumps({"input": x}).encode()
+        req = urllib.request.Request(
+            self.url, data=body, method="POST",
+            headers={
+                "Content-Type": "application/json",
+                "X-Deadline-Ms": str(int(self.deadline_ms)),
+            },
+        )
+        t0 = time.monotonic()
+        try:
+            with urllib.request.urlopen(req, timeout=5.0) as resp:
+                data = json.loads(resp.read())
+                status = resp.status
+        except urllib.error.HTTPError as e:
+            status, data = e.code, None
+            e.read()
+        except Exception:
+            status, data = -1, None  # transport failure to the ROUTER itself
+        latency = time.monotonic() - t0
+        out = data.get("prediction") if isinstance(data, dict) else None
+        with self._lock:
+            self.records.append((self.phase, status, latency, x, out))
+        return status, out
+
+    def _worker(self, stop, worker_id):
+        i = 0
+        while not stop.is_set():
+            x = float((worker_id * 7 + i) % 10) if self.payload == "affine" \
+                else (worker_id * 7 + i) % 64
+            self._one(x)
+            i += 1
+
+    def set_concurrency(self, n):
+        while len(self._workers) > n:
+            _, stop = self._workers.pop()
+            stop.set()
+        while len(self._workers) < n:
+            stop = threading.Event()
+            t = threading.Thread(
+                target=self._worker, args=(stop, len(self._workers)),
+                daemon=True, name=f"loadgen-{len(self._workers)}",
+            )
+            self._workers.append((t, stop))
+            t.start()
+
+    def stop(self):
+        for _, stop in self._workers:
+            stop.set()
+        for t, _ in self._workers:
+            t.join(timeout=10.0)
+        self._workers.clear()
+
+    def phase_stats(self):
+        with self._lock:
+            records = list(self.records)
+        out = {}
+        for phase, status, latency, _, _ in records:
+            s = out.setdefault(phase, {
+                "requests": 0, "ok": 0, "shed": 0, "errors_5xx": 0,
+                "transport": 0, "latencies": [],
+            })
+            s["requests"] += 1
+            if status == 200:
+                s["ok"] += 1
+                s["latencies"].append(latency)
+            elif status == 429:
+                s["shed"] += 1
+            elif status >= 500:
+                s["errors_5xx"] += 1
+            elif status == -1:
+                s["transport"] += 1
+        for s in out.values():
+            lat = sorted(s.pop("latencies"))
+            s["p50_ms"] = round(_quantile(lat, 0.50) * 1e3, 2)
+            s["p95_ms"] = round(_quantile(lat, 0.95) * 1e3, 2)
+            s["p99_ms"] = round(_quantile(lat, 0.99) * 1e3, 2)
+        return out
+
+
+def run_campaign(args):
+    from mmlspark_tpu import observability as obs
+    from mmlspark_tpu.observability.registry import get_registry
+    from mmlspark_tpu.observability.slo import SLOReport, SLOTargets
+    from mmlspark_tpu.runtime.faults import FaultPlan, inject_faults
+    from mmlspark_tpu.runtime.journal import ModelStore
+    from mmlspark_tpu.serving.fleet import FleetController
+    from mmlspark_tpu.serving.replicas import ReplicaSupervisor
+    from mmlspark_tpu.serving.router import FleetRouter
+    from mmlspark_tpu.serving.server import RegistrationService
+
+    seed = int(os.environ.get("MMLSPARK_TPU_FAULT_SEED", str(args.seed)))
+    short = args.short
+    min_replicas, max_replicas = 2, (3 if short else 4)
+    ramp_clients = 12 if short else 20
+    dur = (lambda s, f: s if short else f)
+
+    workdir = tempfile.mkdtemp(prefix="mmlspark-tpu-fleet-")
+    store = ModelStore(os.path.join(workdir, "models"))
+    if args.payload == "affine":
+        store.commit(json.dumps(AFFINE_V1), name="model")
+        factory = "mmlspark_tpu.serving.fleet:store_model_factory"
+        hot_swap = {
+            "loader": "mmlspark_tpu.serving.fleet:store_model_loader",
+            "root": workdir, "name": "model", "poll_s": 0.2,
+        }
+    else:
+        factory = "mmlspark_tpu.serving.fleet:sar_demo_factory"
+        hot_swap = None
+
+    registry = RegistrationService(ttl_s=2.0).start()
+    sup = ReplicaSupervisor(
+        factory,
+        num_replicas=min_replicas,
+        workdir=os.path.join(workdir, "replicas"),
+        seed=seed,
+        heartbeat_timeout_s=5.0,
+        registry_url=registry.info.url,
+        registry_heartbeat_s=0.2,
+        hot_swap=hot_swap,
+        server_options={
+            "max_batch_size": 8, "max_latency_ms": 1.0,
+            "max_pending": 32, "shed_retry_after_s": 0.05,
+        },
+    )
+    sup.start()
+    deadline = time.monotonic() + 30.0
+    while len(registry.services) < min_replicas:
+        if time.monotonic() > deadline:
+            raise TimeoutError("replicas never registered")
+        time.sleep(0.1)
+
+    router = FleetRouter(
+        registry_url=registry.info.url, policy=args.policy,
+        discovery_interval_s=0.1, hop_timeout_s=2.0,
+    ).start()
+    controller = FleetController(
+        sup, registry_url=registry.info.url,
+        min_replicas=min_replicas, max_replicas=max_replicas,
+        scale_up_inflight=1.5, scale_down_inflight=0.5,
+        scale_up_shed_rate=1.0, cooldown_s=1.0,
+        down_sustain_s=1.5, interval_s=0.2,
+    ).start()
+
+    clients = LoadClients(router.url, payload=args.payload)
+    kill_windows = []
+    checks = {}
+    max_live = sup.live_count
+    try:
+        # -- warmup: light load, correctness spot-checks ---------------------
+        clients.phase = "warmup"
+        status, out = clients._one(4.0 if args.payload == "affine" else 4)
+        assert status == 200, f"warmup request failed: {status}"
+        if args.payload == "affine":
+            want = AFFINE_V1["scale"] * 4.0 + AFFINE_V1["bias"]
+            assert out == want, f"expected {want}, got {out}"
+        else:
+            assert isinstance(out, list) and len(out) == 5, out
+        clients.set_concurrency(2)
+        time.sleep(dur(2.0, 3.0))
+        print(f"warmup: fleet={sup.live_count} first reply {out}")
+
+        # -- ramp: saturate the floor fleet, watch the autoscaler ------------
+        clients.phase = "ramp"
+        clients.set_concurrency(ramp_clients)
+        ramp_deadline = time.monotonic() + dur(8.0, 12.0)
+        while time.monotonic() < ramp_deadline:
+            max_live = max(max_live, sup.live_count)
+            if max_live > min_replicas and time.monotonic() > \
+                    ramp_deadline - dur(2.0, 3.0):
+                break  # scaled; keep a little sustained post-scale load
+            time.sleep(0.1)
+        checks["scaled_up"] = max_live > min_replicas
+        print(f"ramp: {ramp_clients} clients, fleet peaked at {max_live}")
+
+        # -- kill: SIGKILL a replica under load ------------------------------
+        clients.phase = "kill"
+        victim = max(sup._procs)
+        pid = sup._procs[victim].pid
+        kill_start = time.monotonic()
+        os.kill(pid, signal.SIGKILL)
+        t0 = time.monotonic()
+        while not any(s.reason == "signal:9" for s in sup.exit_statuses):
+            if time.monotonic() - t0 > 30.0:
+                raise TimeoutError("supervisor never booked the kill")
+            time.sleep(0.1)  # controller.step() runs poll() for us
+        time.sleep(dur(2.0, 4.0))  # lease expiry + respawn under load
+        kill_windows.append((kill_start, time.monotonic()))
+        checks["kill_respawned"] = any(
+            s.reason == "signal:9" for s in sup.exit_statuses
+        )
+        print(f"kill: replica {victim} (pid {pid}) SIGKILL'd, "
+              f"fleet now {sup.live_count}")
+
+        # -- storm: injected 503s trip a breaker; hot swap mid-storm ---------
+        clients.phase = "storm"
+        target = registry.services[0]
+        plan = FaultPlan(seed=seed).http_storm(
+            count=12, status=503, url_part=f":{target.port}/",
+        )
+        swap_seen = False
+        with inject_faults(plan):
+            time.sleep(dur(1.0, 2.0))
+            if args.payload == "affine":
+                store.commit(json.dumps(AFFINE_V2), name="model")
+                want = AFFINE_V2["scale"] * 4.0 + AFFINE_V2["bias"]
+                swap_deadline = time.monotonic() + 15.0
+                while time.monotonic() < swap_deadline:
+                    s, out = clients._one(4.0)
+                    if s == 200 and out == want:
+                        swap_seen = True
+                        break
+                    time.sleep(0.1)
+            else:
+                time.sleep(dur(1.0, 2.0))
+        breaker_trips = sum(
+            1 for e in obs.replay(event_log_path())
+            if type(e).__name__ == "BreakerTripped"
+        )
+        checks["storm_fired"] = bool(plan.fired)
+        checks["hot_swap_observed"] = (
+            swap_seen if args.payload == "affine" else None
+        )
+        print(f"storm: {len(plan.fired)} faults fired, "
+              f"{breaker_trips} breaker trips, hot swap seen: {swap_seen}")
+        # post-swap warm window, still labeled "storm" (excluded from the
+        # steady fold): the swapped model's jitted apply recompiles per
+        # batch shape, and the closed-loop load re-warms those shapes here
+        # so the drain tail measures steady state, not cold compiles
+        time.sleep(dur(2.0, 3.0))
+
+        # -- drain: load off, autoscaler retires back to the floor -----------
+        clients.phase = "drain"
+        clients.set_concurrency(0)
+        drain_deadline = time.monotonic() + dur(20.0, 30.0)
+        while sup.live_count > min_replicas:
+            if time.monotonic() > drain_deadline:
+                break
+            time.sleep(0.2)
+        checks["scaled_down"] = sup.live_count == min_replicas
+        print(f"drain: fleet back to {sup.live_count}")
+    finally:
+        clients.stop()
+        controller.stop()
+        router.stop()
+        sup.stop()
+        registry.stop()
+
+    # -- fold ----------------------------------------------------------------
+    events = obs.replay(event_log_path())
+    targets = SLOTargets()
+    report = SLOReport.fold(None, events=events, targets=targets)
+    phases = clients.phase_stats()
+    non_shed_5xx = sum(s["errors_5xx"] for s in phases.values())
+    transport = sum(s["transport"] for s in phases.values())
+    steady = sorted(
+        lat for phase, status, lat, _, _ in clients.records
+        if status == 200 and phase not in ("kill", "storm")
+    )
+    steady_p99_ms = _quantile(steady, 0.99) * 1e3
+    # the affine payload is judged against the docs/serving_latency.md
+    # tail target; SAR's jitted top-k recompiles per distinct micro-batch
+    # shape, so its cold-shape tails get a looser (still bounded) budget
+    p99_target_ms = args.p99_target or (
+        targets.p99_ms if args.payload == "affine" else 250.0
+    )
+    fleet_events = [e for e in events if type(e).__name__ == "FleetScaled"]
+    routed = [e for e in events if type(e).__name__ == "RequestRouted"]
+
+    checks["zero_non_shed_5xx"] = non_shed_5xx == 0 and transport == 0
+    checks["steady_p99_within_target"] = steady_p99_ms <= p99_target_ms
+    checks["fleet_events_logged"] = len(fleet_events) >= 2
+    checks["routing_events_logged"] = len(routed) > 0
+    checks["slo_ok"] = report.ok()
+    ok = all(v for v in checks.values() if v is not None)
+
+    campaign = {
+        "seed": seed,
+        "payload": args.payload,
+        "policy": args.policy,
+        "min_replicas": min_replicas,
+        "max_replicas": max_replicas,
+        "max_live": max_live,
+        "steady_p99_ms": round(steady_p99_ms, 2),
+        "p99_target_ms": p99_target_ms,
+        "non_shed_5xx": non_shed_5xx,
+        "router_transport_failures": transport,
+        "fleet_scaled": [
+            {"direction": e.direction, "replicas": e.replicas,
+             "reason": e.reason} for e in fleet_events
+        ],
+        "requests_routed": len(routed),
+        "kill_windows_s": [round(b - a, 2) for a, b in kill_windows],
+        "phases": phases,
+        "checks": checks,
+        "ok": ok,
+    }
+
+    os.makedirs(args.out, exist_ok=True)
+    with open(os.path.join(args.out, "slo.json"), "w") as fh:
+        json.dump({"slo": report.to_dict(), "campaign": campaign}, fh,
+                  indent=2, sort_keys=True)
+    md = [
+        f"Chaos-under-load campaign: payload={args.payload} "
+        f"policy={args.policy} seed={seed} "
+        f"fleet {min_replicas}..{max_replicas} (peak {max_live}).",
+        "",
+        report.to_markdown(),
+        "",
+        "| phase | requests | ok | shed | 5xx | p50 | p99 |",
+        "|---|---|---|---|---|---|---|",
+    ]
+    for phase in ("warmup", "ramp", "kill", "storm", "drain"):
+        s = phases.get(phase)
+        if s is None:
+            continue
+        md.append(
+            f"| {phase} | {s['requests']} | {s['ok']} | {s['shed']} "
+            f"| {s['errors_5xx']} | {s['p50_ms']:.2f} ms "
+            f"| {s['p99_ms']:.2f} ms |"
+        )
+    md += [
+        "",
+        "| check | result |",
+        "|---|---|",
+    ]
+    md += [
+        f"| {name} | {'pass' if v else 'FAIL'} |"
+        for name, v in checks.items() if v is not None
+    ]
+    with open(os.path.join(args.out, "slo.md"), "w") as fh:
+        fh.write("\n".join(md) + "\n")
+    from mmlspark_tpu.observability.history import render_report
+
+    with open(os.path.join(args.out, "report.html"), "w") as fh:
+        fh.write(render_report(
+            events, metrics=get_registry().summary(),
+            title="serving fleet chaos campaign",
+        ))
+
+    print("\n".join(md))
+    print(f"\ncampaign {'OK' if ok else 'FAILED'}; "
+          f"artifacts in {args.out}")
+    return 0 if ok else 1
+
+
+def event_log_path():
+    return os.environ["MMLSPARK_TPU_EVENT_LOG"]
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="tools/loadgen.py",
+        description="Chaos-under-load campaign against the serving fleet.",
+    )
+    parser.add_argument("--out", default="fleet-campaign",
+                        help="artifact directory (slo.json, slo.md, "
+                             "report.html, events.jsonl)")
+    parser.add_argument("--seed", type=int, default=11,
+                        help="fault seed (MMLSPARK_TPU_FAULT_SEED wins)")
+    parser.add_argument("--payload", choices=("affine", "sar"),
+                        default="affine",
+                        help="campaign model: hot-swappable affine, or "
+                             "SAR top-k recommendation")
+    parser.add_argument("--policy", choices=("least_loaded",
+                                             "consistent_hash"),
+                        default="least_loaded")
+    parser.add_argument("--p99-target", type=float, default=None,
+                        help="steady-state p99 budget in ms (default: the "
+                             "SLO target for affine, 250 for sar)")
+    parser.add_argument("--short", action="store_true",
+                        help="CI-sized campaign (~30 s)")
+    args = parser.parse_args(argv)
+    os.makedirs(args.out, exist_ok=True)
+    # shared across the router, the controller, and every replica process;
+    # truncate so a re-run into the same --out folds only its own campaign
+    log = os.path.abspath(os.path.join(args.out, "events.jsonl"))
+    open(log, "w").close()
+    os.environ["MMLSPARK_TPU_EVENT_LOG"] = log
+    return run_campaign(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
