@@ -268,13 +268,22 @@ def worker_fit(ctx) -> Dict[str, Any]:
         trees.append(tree_np)
         state["it"] = it + 1
 
+    # child of the ambient gang.worker span, so the fit shows up in the
+    # driver's trace (the epoch spec carried the TraceContext over)
+    from mmlspark_tpu.observability.tracing import get_tracer
+
     try:
-        train(
-            bins_l, y_l, opts, w=w_l, init_margins=margins, mapper=mapper,
-            feature_names=payload.get("feature_names"),
-            hist_reduce=hist_reduce if ctx.world > 1 else None,
-            iteration_hook=hook, start_iteration=k,
-        )
+        with get_tracer().span(
+            "procfit.train", member=ctx.member, rank=ctx.rank,
+            world=ctx.world, start_iteration=k,
+        ):
+            train(
+                bins_l, y_l, opts, w=w_l, init_margins=margins,
+                mapper=mapper,
+                feature_names=payload.get("feature_names"),
+                hist_reduce=hist_reduce if ctx.world > 1 else None,
+                iteration_hook=hook, start_iteration=k,
+            )
     except GroupRevokedError:
         raise
     except Exception as e:
@@ -295,6 +304,22 @@ def worker_fit(ctx) -> Dict[str, Any]:
             "allreduce_seconds": wire["seconds"],
         },
     }
+    from mmlspark_tpu.observability.profiler import get_profiler
+
+    worker_prof = get_profiler()
+    if worker_prof.active:
+        # full per-function table: the worker's registry/profiler dies
+        # with the process, so ship it home in the result for the
+        # driver-side fold (history roofline then covers gang workers)
+        result["profile"]["functions"] = {
+            name: {
+                "executions": int(p.get("executions", 0)),
+                "device_seconds": float(p.get("device_seconds", 0.0)),
+                "compiles": int(p.get("compiles", 0)),
+                "compile_seconds": float(p.get("compile_seconds", 0.0)),
+            }
+            for name, p in worker_prof.snapshot()["functions"].items()
+        }
     if ctx.rank == 0:
         booster = _pack_booster(
             trees, None, opts, num_classes, init_score, mapper,
@@ -438,6 +463,17 @@ def fit_process_group(
                     f"procfit.allreduce[m{member}]",
                     executions=int(p["allreduce_calls"]),
                     device_seconds=float(p.get("allreduce_seconds", 0.0)),
+                )
+            # the worker's own profile table, qualified per member — the
+            # federation hop that puts gang-worker kernels on the
+            # driver's roofline (history report + incident bundles)
+            for name, fp in sorted((p.get("functions") or {}).items()):
+                prof.merge(
+                    f"{name}[m{member}]",
+                    executions=int(fp.get("executions", 0)),
+                    device_seconds=float(fp.get("device_seconds", 0.0)),
+                    compiles=int(fp.get("compiles", 0)),
+                    compile_seconds=float(fp.get("compile_seconds", 0.0)),
                 )
 
     model_text = Path(model_path).read_text()

@@ -48,6 +48,7 @@ from mmlspark_tpu.observability.events import (
     FleetScaled,
     GroupReformed,
     HistogramChunked,
+    IncidentRecorded,
     ModelCommitted,
     ModelSwapped,
     ProcessLost,
@@ -57,6 +58,7 @@ from mmlspark_tpu.observability.events import (
     RequestRouted,
     RequestServed,
     RequestShed,
+    SpanRecorded,
     StageCompleted,
     StageStarted,
     StreamEpochCommitted,
@@ -69,12 +71,26 @@ from mmlspark_tpu.observability.events import (
     TaskSpeculated,
     WorkerParoled,
     WorkerQuarantined,
+    collect,
     format_timeline,
     from_record,
     get_bus,
     log_segments,
+    merge,
+    process_label,
+    process_log_path,
     replay,
     timeline,
+    write_merged,
+)
+from mmlspark_tpu.observability.federation import (
+    MetricsFederator,
+    parse_exposition,
+)
+from mmlspark_tpu.observability.incidents import (
+    FlightRecorder,
+    get_recorder,
+    maybe_record,
 )
 from mmlspark_tpu.observability.profiler import (
     DeviceProfiler,
@@ -91,8 +107,15 @@ from mmlspark_tpu.observability.registry import (
     MetricsRegistry,
     get_registry,
 )
-from mmlspark_tpu.observability.slo import SLOReport, SLOTargets
-from mmlspark_tpu.observability.tracing import Span, Tracer, get_tracer
+from mmlspark_tpu.observability.slo import SLOReport, SLOTargets, fleet_summary
+from mmlspark_tpu.observability.tracing import (
+    PARENT_HEADER,
+    TRACE_HEADER,
+    Span,
+    TraceContext,
+    Tracer,
+    get_tracer,
+)
 
 
 def __getattr__(name):
@@ -116,14 +139,18 @@ __all__ = [
     "FIT_BUCKETS",
     "FeatureBundled",
     "FleetScaled",
+    "FlightRecorder",
     "FunctionProfile",
     "Gauge",
     "GroupReformed",
     "Histogram",
     "HistogramChunked",
+    "IncidentRecorded",
+    "MetricsFederator",
     "MetricsRegistry",
     "ModelCommitted",
     "ModelSwapped",
+    "PARENT_HEADER",
     "ProcessLost",
     "ProcessStarted",
     "ProfileCompiled",
@@ -134,28 +161,40 @@ __all__ = [
     "SLOReport",
     "SLOTargets",
     "Span",
+    "SpanRecorded",
     "StageCompleted",
     "StageStarted",
     "StreamEpochCommitted",
     "StreamEpochStarted",
     "StreamSourceAdvanced",
+    "TRACE_HEADER",
     "TaskDispatched",
     "TaskFailed",
     "TaskRecovered",
     "TaskRetried",
     "TaskSpeculated",
+    "TraceContext",
     "Tracer",
     "WorkerParoled",
     "WorkerQuarantined",
+    "collect",
     "device_peaks",
+    "fleet_summary",
     "format_timeline",
     "from_record",
     "get_bus",
     "get_profiler",
+    "get_recorder",
     "get_registry",
     "get_tracer",
     "log_segments",
+    "maybe_record",
+    "merge",
+    "parse_exposition",
+    "process_label",
+    "process_log_path",
     "render_report",
     "replay",
     "timeline",
+    "write_merged",
 ]

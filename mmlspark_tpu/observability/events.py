@@ -254,13 +254,16 @@ class RequestRouted(Event):
     """The front-end router answered one request: ``replica`` is the
     endpoint that produced the final answer, ``hops`` the number of
     replica attempts it took (1 = first try; >1 means failovers the
-    client never saw)."""
+    client never saw). ``trace_id`` is the id the router returned in
+    ``X-Trace-Id`` — a user-quoted incident id joins directly against
+    the event log."""
 
     rid: str
     replica: str
     hops: int
     status: int
     latency: float
+    trace_id: str = ""
 
 
 # -- streaming ---------------------------------------------------------------
@@ -373,6 +376,48 @@ class FeatureBundled(Event):
     sample_rows: int
 
 
+# -- tracing -----------------------------------------------------------------
+
+
+@_event
+class SpanRecorded(Event):
+    """One finished tracer span, mirrored onto the bus so the event log
+    carries the span stream (the history server's cross-process trace
+    waterfall is rebuilt from these). ``parent_id`` is either a bare
+    span id (same process) or ``<process>:<span_id>`` for a parent that
+    lives across a wire hop; ``wall_start`` is ``time.time()`` at span
+    start, the only clock comparable across processes."""
+
+    name: str
+    trace_id: str
+    span_id: str
+    parent_id: str = ""
+    start: float = 0.0
+    duration: float = 0.0
+    wall_start: float = 0.0
+    status: str = "ok"
+    tags: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+
+# -- incidents ---------------------------------------------------------------
+
+
+@_event
+class IncidentRecorded(Event):
+    """The flight recorder dumped an incident bundle: ``trigger`` names
+    the tripwire (``breaker_tripped`` / ``gang_failed`` / ``slo_budget``
+    / ``worker_quarantined``), ``path`` the bundle directory, ``events``
+    how many ring-buffer events it captured, ``trace_id`` the offending
+    trace when one was known."""
+
+    incident_id: str
+    trigger: str
+    path: str
+    events: int = 0
+    trace_id: str = ""
+    detail: str = ""
+
+
 # -- resilience --------------------------------------------------------------
 
 
@@ -439,6 +484,30 @@ class EventBus:
                 logger.debug("event listener %r failed: %s", listener, e)
 
 
+#: process label pattern for per-process log suffixing; dots are excluded
+#: so rotation suffixes (``.<seq>``) stay unambiguous
+_PROCESS_SEP = "@"
+
+
+def process_label() -> str:
+    """This process's label in the federated event log: the value of
+    ``MMLSPARK_TPU_EVENT_LOG_PROCESS`` (set by the spawner — replica
+    supervisor, process group), or ``"driver"`` for the root process."""
+    import os
+
+    return os.environ.get("MMLSPARK_TPU_EVENT_LOG_PROCESS") or "driver"
+
+
+def process_log_path(path: str, process: str) -> str:
+    """The per-process event-log path for ``process`` under the shared
+    base ``path``: ``<path>@<process>``. The base path itself belongs to
+    the driver. Labels must not contain ``.``/``@``/path separators —
+    rotation appends ``.<seq>`` and :func:`collect` parses it back off."""
+    if any(c in process for c in (".", _PROCESS_SEP, "/", "\\")):
+        raise ValueError(f"invalid process label {process!r}")
+    return f"{path}{_PROCESS_SEP}{process}"
+
+
 class EventLogSink:
     """JSON-lines event log: one ``{"event": <type>, ...}`` object per
     line, appended and flushed per event so a crash loses at most the
@@ -451,9 +520,18 @@ class EventLogSink:
     and a fresh live file opens — a streaming/serving chaos run can no
     longer grow one file without limit. :func:`replay` reads the rotated
     segments oldest-first, then the live file, so the fold is unchanged.
+
+    Every record is stamped with ``process`` (this process's federation
+    label) and ``wt`` (``time.time()`` — the only clock comparable
+    across processes); :func:`merge` orders the fleet stream by it.
     """
 
-    def __init__(self, path: str, max_bytes: Optional[int] = None):
+    def __init__(
+        self,
+        path: str,
+        max_bytes: Optional[int] = None,
+        process: Optional[str] = None,
+    ):
         import os
 
         if max_bytes is None:
@@ -462,6 +540,7 @@ class EventLogSink:
             ) or None
         self.path = path
         self.max_bytes = max_bytes
+        self.process = process if process is not None else process_label()
         self._lock = threading.Lock()
         existing = [seq for seq, _ in _numbered_segments(path)]
         self._seq = max(existing) + 1 if existing else 1
@@ -469,7 +548,10 @@ class EventLogSink:
         self._size = self._fh.tell()
 
     def __call__(self, event: Event) -> None:
-        line = json.dumps(event.to_record()) + "\n"
+        rec = event.to_record()
+        rec.setdefault("process", self.process)
+        rec.setdefault("wt", time.time())
+        line = json.dumps(rec) + "\n"
         with self._lock:
             if self._fh is None:
                 return
@@ -515,7 +597,11 @@ _ENV_LOCK = threading.Lock()
 def get_bus() -> EventBus:
     """The process-global bus. Each call re-syncs the env-driven sink:
     setting ``MMLSPARK_TPU_EVENT_LOG=/path`` before a component grabs the
-    bus attaches the JSON-lines sink; unsetting it detaches."""
+    bus attaches the JSON-lines sink; unsetting it detaches. A child
+    process additionally carrying ``MMLSPARK_TPU_EVENT_LOG_PROCESS=<label>``
+    (set by its spawner) writes to the per-process sibling
+    ``/path@<label>`` instead — two processes inheriting the same base
+    path no longer clobber each other's live file and rotation sequence."""
     _sync_env_sink()
     return _BUS
 
@@ -525,17 +611,29 @@ def _sync_env_sink() -> None:
     import os
 
     path = os.environ.get("MMLSPARK_TPU_EVENT_LOG")
+    label = os.environ.get("MMLSPARK_TPU_EVENT_LOG_PROCESS") or "driver"
+    if path and label != "driver":
+        try:
+            effective: Optional[str] = process_log_path(path, label)
+        except ValueError:
+            logger.warning(
+                "MMLSPARK_TPU_EVENT_LOG_PROCESS=%s invalid; logging as driver",
+                label,
+            )
+            effective, label = path, "driver"
+    else:
+        effective = path
     current = _ENV_SINK.path if _ENV_SINK is not None else None
-    if path == current:
+    if effective == current:
         return
     with _ENV_LOCK:
         if _ENV_SINK is not None:
             _BUS.remove_listener(_ENV_SINK)
             _ENV_SINK.close()
             _ENV_SINK = None
-        if path:
+        if effective:
             try:
-                _ENV_SINK = EventLogSink(path)
+                _ENV_SINK = EventLogSink(effective, process=label)
             except OSError as e:
                 logger.warning("MMLSPARK_TPU_EVENT_LOG=%s unusable: %s", path, e)
                 return
@@ -581,18 +679,111 @@ def log_segments(path: str) -> List[str]:
     return out
 
 
+def _stamp(ev: Event, rec: Dict[str, Any], process: str = "") -> Event:
+    """Carry the sink-level federation stamps (``process``, ``wt``)
+    through to the typed event as plain attributes — they are not
+    dataclass fields, so single-process records and equality semantics
+    are untouched."""
+    ev.process = rec.get("process") or process  # type: ignore[attr-defined]
+    ev.wt = float(rec.get("wt") or 0.0)  # type: ignore[attr-defined]
+    return ev
+
+
 def replay(path: str) -> List[Event]:
     """Read an event log back into typed events (skips blank lines).
     Rotated segments (``<path>.1``, ``<path>.2``, ...) are read in
-    order before the live file, so a size-bounded log replays whole."""
+    order before the live file, so a size-bounded log replays whole.
+    Records carrying federation stamps (``process``/``wt``) surface them
+    as event attributes, so replaying a merged fleet log keeps the
+    process tags."""
     out: List[Event] = []
     for segment in log_segments(path):
         with open(segment, "r", encoding="utf-8") as fh:
             for line in fh:
                 line = line.strip()
                 if line:
-                    out.append(from_record(json.loads(line)))
+                    rec = json.loads(line)
+                    out.append(_stamp(from_record(rec), rec))
     return out
+
+
+# -- fleet federation --------------------------------------------------------
+
+
+def collect(path: str) -> Dict[str, List[str]]:
+    """Discover every process's segments of a federated event log rooted
+    at ``path``: the driver's own (possibly rotated) log plus every
+    per-process sibling ``<path>@<label>`` written by child processes.
+    Returns ``{label: [segment, ...]}`` in write order per process."""
+    import glob
+    import os
+
+    out: Dict[str, List[str]] = {}
+    if os.path.exists(path) or _numbered_segments(path):
+        out["driver"] = log_segments(path)
+    labels = set()
+    for p in glob.glob(glob.escape(path) + _PROCESS_SEP + "*"):
+        suffix = p[len(path) + 1:]
+        # strip a rotation suffix (".<digits>") back off the live name
+        stem, dot, tail = suffix.rpartition(".")
+        if dot and tail.isdigit():
+            suffix = stem
+        if suffix:
+            labels.add(suffix)
+    for label in sorted(labels):
+        out[label] = log_segments(process_log_path(path, label))
+    return out
+
+
+def _merged_records(path: str) -> List[Dict[str, Any]]:
+    """Every process's records folded into one timestamp-ordered stream.
+    Order is deterministic for a fixed set of files: sorted by the
+    wall-clock stamp, ties broken by (process label, in-process order) —
+    re-merging the same segments is byte-identical."""
+    keyed: List[tuple] = []
+    for process, segments in collect(path).items():
+        idx = 0
+        for segment in segments:
+            with open(segment, "r", encoding="utf-8") as fh:
+                for line in fh:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    rec = json.loads(line)
+                    rec.setdefault("process", process)
+                    keyed.append(
+                        (float(rec.get("wt") or 0.0), process, idx, rec)
+                    )
+                    idx += 1
+    keyed.sort(key=lambda item: item[:3])
+    return [rec for _, _, _, rec in keyed]
+
+
+def merge(path: str) -> List[Event]:
+    """The federated replay: fold every process's segments (see
+    :func:`collect`) into one timestamp-ordered, process-tagged event
+    stream. Each event carries ``.process`` and ``.wt`` attributes;
+    :func:`timeline`, :class:`~mmlspark_tpu.observability.slo.SLOReport`
+    and the history server consume the stream unchanged."""
+    return [
+        _stamp(from_record(rec), rec, process=rec.get("process", ""))
+        for rec in _merged_records(path)
+    ]
+
+
+def write_merged(path: str, out_path: str) -> int:
+    """Materialize the merged fleet stream as one JSON-lines file (the
+    artifact CI validates and the history server renders); returns the
+    record count. The write is atomic (tmp + ``os.replace``)."""
+    import os
+
+    records = _merged_records(path)
+    tmp = f"{out_path}.tmp-{os.getpid()}"
+    with open(tmp, "w", encoding="utf-8") as fh:
+        for rec in records:
+            fh.write(json.dumps(rec) + "\n")
+    os.replace(tmp, out_path)
+    return len(records)
 
 
 def timeline(events: Iterable[Event]) -> Dict[str, Any]:
@@ -626,7 +817,13 @@ def timeline(events: Iterable[Event]) -> Dict[str, Any]:
     routed_by_replica: Dict[str, int] = {}
     #: per-function compile/execute fold from Profile* events
     profiler: Dict[str, Dict[str, Any]] = {}
+    incidents: List[Dict[str, Any]] = []
+    #: events per federation process label ("" = untagged single-process log)
+    by_process: Dict[str, int] = {}
     for ev in events:
+        proc = getattr(ev, "process", "")
+        if proc:
+            by_process[proc] = by_process.get(proc, 0) + 1
         if isinstance(ev, StageStarted):
             stages.setdefault(
                 (ev.job_id, ev.stage_id, ev.phase),
@@ -702,6 +899,11 @@ def timeline(events: Iterable[Event]) -> Dict[str, Any]:
             shed += 1
         elif isinstance(ev, BreakerTripped):
             breaker_trips[ev.breaker] = breaker_trips.get(ev.breaker, 0) + 1
+        elif isinstance(ev, IncidentRecorded):
+            incidents.append({
+                "incident_id": ev.incident_id, "trigger": ev.trigger,
+                "path": ev.path, "trace_id": ev.trace_id,
+            })
         elif isinstance(ev, (ProfileCompiled, ProfileExecuted)):
             rec = profiler.setdefault(ev.name, {
                 "compiles": 0, "compile_seconds": 0.0,
@@ -742,6 +944,8 @@ def timeline(events: Iterable[Event]) -> Dict[str, Any]:
         "paroles": paroles,
         "processes": dict(processes, loss_reasons=loss_reasons),
         "profiler": profiler,
+        "incidents": incidents,
+        "by_process": by_process,
     }
 
 
@@ -830,6 +1034,16 @@ def format_timeline(summary: Dict[str, Any]) -> str:
     if trips:
         lines.append("== breakers == " + ", ".join(
             f"{name} tripped x{n}" for name, n in sorted(trips.items())
+        ))
+    incidents = summary.get("incidents") or []
+    if incidents:
+        lines.append("== incidents == " + ", ".join(
+            f"{i['trigger']} ({i['incident_id']})" for i in incidents
+        ))
+    by_process = summary.get("by_process") or {}
+    if by_process:
+        lines.append("== fleet log == " + ", ".join(
+            f"{proc} x{n}" for proc, n in sorted(by_process.items())
         ))
     if "latency_p50" in r:
         lines.append(

@@ -1,0 +1,324 @@
+"""Fleet metrics federation — one labeled registry over every replica.
+
+The serving fleet's replicas are separate processes, each exposing its
+own process-global :class:`~mmlspark_tpu.observability.registry.MetricsRegistry`
+at ``GET /metrics``. Until now the control plane steered on heartbeat
+metadata (the three load fields replicas self-report into ``/services``);
+this module gives it the real thing:
+
+- :func:`parse_exposition` reads the Prometheus text format (version
+  0.0.4) back into typed samples — the exact inverse of
+  :meth:`MetricsRegistry.exposition`;
+- :class:`MetricsFederator` discovers live replicas via the registry's
+  ``GET /services``, scrapes each one's ``/metrics``, and folds the
+  samples into ONE registry where every series carries a
+  ``replica="<name>"`` label — the Spark "metrics from every executor in
+  the driver UI" view. Histograms are reconstructed bucket-for-bucket,
+  so fleet-wide ``p99`` interpolation works on the federated registry
+  exactly as it does on a local one;
+- :meth:`MetricsFederator.fleet_signals` derives the autoscaler's
+  steering signals (inflight, cumulative sheds, queue-wait p99) per
+  replica from the scrape, replacing heartbeat lag with live truth;
+- :meth:`MetricsFederator.snapshot` is the JSON-able fleet state the
+  incident flight recorder bundles.
+
+A scrape failure (replica died between ``/services`` and ``/metrics``)
+is recorded in ``last_errors`` and skipped — federation must never take
+down the control loop that consumes it.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import re
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from mmlspark_tpu.core.profiling import get_logger
+from mmlspark_tpu.observability.registry import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+)
+
+logger = get_logger("mmlspark_tpu.observability")
+
+#: one ``name="value"`` pair inside an exposition label set
+_LABEL_RE = re.compile(r'([A-Za-z_][A-Za-z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+Sample = Tuple[str, Dict[str, str], float]
+
+
+def _unescape(value: str) -> str:
+    return value.replace('\\"', '"').replace("\\\\", "\\")
+
+
+def _parse_value(text: str) -> float:
+    if text == "+Inf":
+        return math.inf
+    if text == "-Inf":
+        return -math.inf
+    return float(text)
+
+
+def parse_exposition(text: str) -> Tuple[Dict[str, str], List[Sample]]:
+    """Prometheus text format -> (``{name: kind}``, ``[(name, labels,
+    value), ...]``) — the inverse of :meth:`MetricsRegistry.exposition`.
+    Unparseable lines are skipped (scrapes must be best-effort)."""
+    kinds: Dict[str, str] = {}
+    samples: List[Sample] = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("#"):
+            parts = line.split(None, 3)
+            if len(parts) >= 4 and parts[1] == "TYPE":
+                kinds[parts[2]] = parts[3].strip()
+            continue
+        try:
+            if "{" in line:
+                name, rest = line.split("{", 1)
+                labels_str, sep, value_str = rest.rpartition("}")
+                if not sep:
+                    continue
+                labels = {
+                    k: _unescape(v) for k, v in _LABEL_RE.findall(labels_str)
+                }
+            else:
+                name, sep, value_str = line.rpartition(" ")
+                if not sep:
+                    continue
+                labels = {}
+            samples.append((name.strip(), labels, _parse_value(value_str)))
+        except ValueError:
+            continue
+    return kinds, samples
+
+
+def _base_name(name: str, kinds: Dict[str, str]) -> Tuple[str, str]:
+    """(metric base name, series role) for one sample name: histograms
+    expose ``_bucket``/``_sum``/``_count`` series under their base."""
+    for suffix in ("_bucket", "_sum", "_count"):
+        if name.endswith(suffix):
+            base = name[: -len(suffix)]
+            if kinds.get(base) == "histogram":
+                return base, suffix[1:]
+    return name, "value"
+
+
+def _bucket_percentile(
+    bounds: List[float], cumulative: List[float], q: float
+) -> float:
+    """The same bucket-interpolated quantile :meth:`Histogram.percentile`
+    computes, over scraped cumulative bucket counts (finite bounds only;
+    the +Inf overflow is ``cumulative[-1]``)."""
+    total = cumulative[-1] if cumulative else 0.0
+    if total <= 0:
+        return 0.0
+    rank = q * total
+    prev = 0.0
+    for i, bound in enumerate(bounds):
+        cum = cumulative[i]
+        in_bucket = cum - prev
+        if cum >= rank and in_bucket > 0:
+            lo = bounds[i - 1] if i > 0 else 0.0
+            frac = (rank - prev) / in_bucket
+            return lo + (bound - lo) * min(max(frac, 0.0), 1.0)
+        prev = cum
+    return bounds[-1] if bounds else 0.0
+
+
+def _default_fetch(url: str, timeout_s: float) -> str:
+    from urllib.request import urlopen
+
+    with urlopen(url, timeout=timeout_s) as resp:
+        return resp.read().decode("utf-8")
+
+
+class MetricsFederator:
+    """Scrape every live replica's ``/metrics`` into one labeled registry.
+
+    ``fetch(url, timeout_s) -> str`` is injectable for tests; the default
+    is a plain ``urllib`` GET. ``scrape()`` returns a **fresh**
+    federated :class:`MetricsRegistry` each call — federation is a
+    snapshot, not an accumulator, so a retired replica's series vanish
+    with it."""
+
+    def __init__(
+        self,
+        registry_url: str,
+        timeout_s: float = 2.0,
+        fetch: Optional[Callable[[str, float], str]] = None,
+    ):
+        self.registry_url = registry_url.rstrip("/")
+        self.timeout_s = float(timeout_s)
+        self._fetch = fetch or _default_fetch
+        self._lock = threading.Lock()
+        #: replica name -> error string from the last scrape round
+        self.last_errors: Dict[str, str] = {}
+        #: replica name -> (kinds, samples) from the last scrape round
+        self._last: Dict[str, Tuple[Dict[str, str], List[Sample]]] = {}
+        self.last_scrape_at: Optional[float] = None
+
+    # -- discovery -----------------------------------------------------------
+
+    def services(self) -> List[Dict[str, Any]]:
+        """The registry's ``GET /services`` list (empty on error)."""
+        try:
+            body = self._fetch(self.registry_url + "/services", self.timeout_s)
+            services = json.loads(body).get("services", [])
+            return [s for s in services if s.get("host") and s.get("port")]
+        except Exception as e:  # noqa: BLE001 - control plane may be mid-restart
+            logger.debug("federator: /services unreadable: %s", e)
+            return []
+
+    # -- scrape --------------------------------------------------------------
+
+    def poll(
+        self, services: Optional[List[Dict[str, Any]]] = None
+    ) -> Dict[str, Tuple[Dict[str, str], List[Sample]]]:
+        """One scrape round: fetch + parse every replica's ``/metrics``.
+        Returns ``{replica: (kinds, samples)}``; failures land in
+        ``last_errors`` and the replica is skipped."""
+        if services is None:
+            services = self.services()
+        scraped: Dict[str, Tuple[Dict[str, str], List[Sample]]] = {}
+        errors: Dict[str, str] = {}
+        for svc in services:
+            name = str(svc.get("name") or f"{svc['host']}:{svc['port']}")
+            url = f"http://{svc['host']}:{svc['port']}/metrics"
+            try:
+                scraped[name] = parse_exposition(
+                    self._fetch(url, self.timeout_s)
+                )
+            except Exception as e:  # noqa: BLE001 - replica may have just died
+                errors[name] = str(e)
+        with self._lock:
+            self._last = scraped
+            self.last_errors = errors
+            self.last_scrape_at = time.time()
+        return scraped
+
+    def scrape(
+        self, services: Optional[List[Dict[str, Any]]] = None
+    ) -> MetricsRegistry:
+        """Poll the fleet and fold every sample into one fresh registry
+        with a ``replica`` label per series — ``registry.summary()`` /
+        ``exposition()`` /  histogram ``percentile()`` then answer
+        fleet-wide questions directly."""
+        scraped = self.poll(services)
+        reg = MetricsRegistry()
+        for replica, (kinds, samples) in sorted(scraped.items()):
+            self._fold(reg, replica, kinds, samples)
+        return reg
+
+    def _fold(
+        self,
+        reg: MetricsRegistry,
+        replica: str,
+        kinds: Dict[str, str],
+        samples: List[Sample],
+    ) -> None:
+        # histograms first: gather each series' bucket/sum/count parts
+        hists: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], Dict[str, Any]] = {}
+        for name, labels, value in samples:
+            base, role = _base_name(name, kinds)
+            if role == "value":
+                kind = kinds.get(base, "")
+                if kind == "counter" or (not kind and base.endswith("_total")):
+                    reg.counter(base).labels(replica=replica, **labels).inc(value)
+                else:
+                    reg.gauge(base).labels(replica=replica, **labels).set(value)
+                continue
+            key_labels = {k: v for k, v in labels.items() if k != "le"}
+            rec = hists.setdefault(
+                (base, tuple(sorted(key_labels.items()))),
+                {"buckets": {}, "sum": 0.0, "count": 0, "labels": key_labels},
+            )
+            if role == "bucket":
+                rec["buckets"][_parse_value(labels.get("le", "+Inf"))] = value
+            elif role == "sum":
+                rec["sum"] = value
+            else:
+                rec["count"] = int(value)
+        for (base, _), rec in sorted(hists.items()):
+            bounds = sorted(b for b in rec["buckets"] if b != math.inf)
+            parent = reg.histogram(base, buckets=bounds or None)
+            child = parent.labels(replica=replica, **rec["labels"])
+            # load the scraped cumulative counts back into per-bucket
+            # occupancy (the +Inf overflow is count minus the last bound)
+            with child._lock:
+                prev = 0.0
+                counts = []
+                for b in child.buckets:
+                    cum = rec["buckets"].get(b, prev)
+                    counts.append(int(cum - prev))
+                    prev = cum
+                counts.append(max(int(rec["count"] - prev), 0))
+                child._counts = counts
+                child._sum = float(rec["sum"])
+                child._count = int(rec["count"])
+
+    # -- derived views -------------------------------------------------------
+
+    def fleet_signals(
+        self,
+        services: Optional[List[Dict[str, Any]]] = None,
+        scraped: Optional[
+            Dict[str, Tuple[Dict[str, str], List[Sample]]]
+        ] = None,
+    ) -> Dict[str, Dict[str, float]]:
+        """Per-replica autoscaler signals from a live scrape:
+        ``{replica: {inflight, shed_total, p99_ms}}`` — what the
+        heartbeat load metadata approximates, read at the source."""
+        if scraped is None:
+            scraped = self.poll(services)
+        out: Dict[str, Dict[str, float]] = {}
+        for replica, (kinds, samples) in scraped.items():
+            inflight = shed = 0.0
+            bounds: List[float] = []
+            cumulative: List[float] = []
+            inf_cum = 0.0
+            for name, labels, value in samples:
+                if name == "serving_inflight" and not labels:
+                    inflight = value
+                elif name == "serving_shed_total" and not labels:
+                    shed = value
+                elif name == "serving_queue_wait_seconds_bucket":
+                    le = _parse_value(labels.get("le", "+Inf"))
+                    if le == math.inf:
+                        inf_cum = value
+                    else:
+                        bounds.append(le)
+                        cumulative.append(value)
+            pairs = sorted(zip(bounds, cumulative))
+            bounds = [b for b, _ in pairs]
+            cumulative = [c for _, c in pairs] + [inf_cum]
+            out[replica] = {
+                "inflight": inflight,
+                "shed_total": shed,
+                "p99_ms": _bucket_percentile(bounds, cumulative, 0.99) * 1e3,
+            }
+        return out
+
+    def snapshot(
+        self, services: Optional[List[Dict[str, Any]]] = None
+    ) -> Dict[str, Any]:
+        """JSON-able fleet state: the federated registry summary, the
+        per-replica signals, and any scrape errors — what the incident
+        flight recorder bundles as ``metrics.json``."""
+        if services is None:
+            services = self.services()
+        registry = self.scrape(services)
+        with self._lock:
+            scraped = dict(self._last)
+        return {
+            "services": services,
+            "metrics": registry.summary(),
+            "signals": self.fleet_signals(services, scraped=scraped),
+            "errors": dict(self.last_errors),
+        }

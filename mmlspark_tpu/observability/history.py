@@ -25,7 +25,14 @@ import json
 import sys
 from typing import Any, Dict, Iterable, List, Optional
 
-from mmlspark_tpu.observability.events import Event, replay, timeline
+from mmlspark_tpu.observability.events import (
+    Event,
+    SpanRecorded,
+    collect,
+    merge,
+    replay,
+    timeline,
+)
 from mmlspark_tpu.observability.profiler import FunctionProfile, device_peaks
 from mmlspark_tpu.observability.slo import SLOReport
 
@@ -48,6 +55,10 @@ th { background: #edf2f7; }
 .bar-track { flex: 1; background: #edf2f7; height: 14px; position: relative; }
 .bar { position: absolute; height: 100%; background: #4299e1; min-width: 2px; }
 .bar.failed { background: #e53e3e; }
+.bar.p0 { background: #4299e1; } .bar.p1 { background: #48bb78; }
+.bar.p2 { background: #ed8936; } .bar.p3 { background: #9f7aea; }
+.bar.p4 { background: #38b2ac; } .bar.p5 { background: #d69e2e; }
+.lane-label { width: 22em; font-weight: 600; }
 .ok { color: #2f855a; font-weight: 600; }
 .missed { color: #c53030; font-weight: 600; }
 .muted { color: #718096; }
@@ -157,6 +168,154 @@ def _roofline_table(profiler: Dict[str, Dict[str, Any]]) -> str:
     )
 
 
+def _span_key(process: str, span_id: str) -> str:
+    return f"{process}:{span_id}"
+
+
+def _parent_key(process: str, parent_id: str) -> str:
+    """Wire-crossing parents arrive already qualified (``proc:span``);
+    bare parent ids are same-process by construction."""
+    return parent_id if ":" in parent_id else f"{process}:{parent_id}"
+
+
+def _gather_traces(events: Iterable[Event]) -> Dict[str, List[Dict[str, Any]]]:
+    """trace_id -> span dicts, each carrying the process stamp the merged
+    fleet log attached (empty for a single-process log)."""
+    traces: Dict[str, List[Dict[str, Any]]] = {}
+    for ev in events:
+        if not isinstance(ev, SpanRecorded):
+            continue
+        process = str(getattr(ev, "process", "") or "")
+        traces.setdefault(ev.trace_id, []).append({
+            "name": ev.name,
+            "process": process,
+            "key": _span_key(process, ev.span_id),
+            "parent": _parent_key(process, ev.parent_id)
+            if ev.parent_id else "",
+            "start": float(ev.wall_start),
+            "duration": float(ev.duration),
+            "status": ev.status,
+        })
+    return traces
+
+
+def _walk_trace(spans: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """Depth-first tree order with a ``depth`` per span — roots are spans
+    whose (qualified) parent never appears in this trace."""
+    by_key = {s["key"]: s for s in spans}
+    children: Dict[str, List[Dict[str, Any]]] = {}
+    roots: List[Dict[str, Any]] = []
+    for s in spans:
+        parent = s["parent"]
+        if parent and parent in by_key:
+            children.setdefault(parent, []).append(s)
+        else:
+            roots.append(s)
+    ordered: List[Dict[str, Any]] = []
+
+    def visit(span: Dict[str, Any], depth: int) -> None:
+        ordered.append(dict(span, depth=depth))
+        for child in sorted(
+            children.get(span["key"], []), key=lambda c: c["start"]
+        ):
+            visit(child, depth + 1)
+
+    for root in sorted(roots, key=lambda s: s["start"]):
+        visit(root, 0)
+    return ordered
+
+
+def _trace_waterfall(
+    events: Iterable[Event], max_traces: int = 5
+) -> str:
+    """The cross-process trace view: for each of the most interesting
+    traces (most processes involved, then most spans), a gantt where every
+    span is offset on the shared wall clock and colored by process —
+    router hop, replica queue/batch/apply, and gang workers on one axis —
+    followed by one collapsed lane per process."""
+    traces = _gather_traces(events)
+    if not traces:
+        return (
+            '<p class="muted">no spans in this log '
+            "(spans are published when the event bus is active)</p>"
+        )
+    ranked = sorted(
+        traces.items(),
+        key=lambda kv: (
+            -len({s["process"] for s in kv[1]}),
+            -len(kv[1]),
+            kv[0],
+        ),
+    )[:max_traces]
+    out: List[str] = []
+    for trace_id, spans in ranked:
+        ordered = _walk_trace(spans)
+        processes = sorted({s["process"] for s in ordered})
+        palette = {p: i % 6 for i, p in enumerate(processes)}
+        t0 = min(s["start"] for s in ordered)
+        t1 = max(s["start"] + s["duration"] for s in ordered)
+        span_s = max(t1 - t0, 1e-9)
+        out.append(
+            f"<h3>trace <code>{_esc(trace_id)}</code> "
+            f'<span class="muted">({len(ordered)} spans, '
+            f"{len(processes)} processes)</span></h3>"
+        )
+        for s in ordered:
+            left = 100.0 * (s["start"] - t0) / span_s
+            width = max(100.0 * s["duration"] / span_s, 0.5)
+            cls = f'bar p{palette[s["process"]]}'
+            if s["status"] != "ok":
+                cls = "bar failed"
+            indent = "&nbsp;" * (2 * s["depth"])
+            label = f'{s["process"] or "local"} &middot; {_esc(s["name"])}'
+            out.append(
+                f'<div class="bar-row"><div class="bar-label" '
+                f'title="{_esc(s["name"])}">{indent}{label}</div>'
+                f'<div class="bar-track"><div class="{cls}" '
+                f'style="left:{left:.2f}%;width:{width:.2f}%"></div></div>'
+                f'<div style="width:6em;text-align:right">'
+                f'{s["duration"] * 1e3:.1f} ms</div></div>'
+            )
+        if len(processes) > 1:
+            # one collapsed lane per process: where each process spent the
+            # trace's wall clock, side by side
+            for proc in processes:
+                bars = []
+                for s in ordered:
+                    if s["process"] != proc:
+                        continue
+                    left = 100.0 * (s["start"] - t0) / span_s
+                    width = max(100.0 * s["duration"] / span_s, 0.5)
+                    bars.append(
+                        f'<div class="bar p{palette[proc]}" '
+                        f'title="{_esc(s["name"])}" '
+                        f'style="left:{left:.2f}%;width:{width:.2f}%"></div>'
+                    )
+                out.append(
+                    f'<div class="bar-row"><div class="lane-label">'
+                    f'lane: {_esc(proc or "local")}</div>'
+                    f'<div class="bar-track">{"".join(bars)}</div>'
+                    f'<div style="width:6em"></div></div>'
+                )
+    return "".join(out)
+
+
+def _incidents_table(incidents: List[Dict[str, Any]]) -> str:
+    if not incidents:
+        return '<p class="muted">no incidents recorded</p>'
+    return _table(
+        ["incident", "trigger", "trace", "bundle", "detail"],
+        [[
+            _esc(i.get("incident_id", "")),
+            _esc(i.get("trigger", "")),
+            f'<code>{_esc(i["trace_id"])}</code>'
+            if i.get("trace_id") else "&mdash;",
+            f'<code>{_esc(i.get("path", ""))}</code>',
+            _esc(i.get("detail", "")),
+        ] for i in incidents],
+    )
+
+
 def render_report(
     events: Iterable[Event],
     metrics: Optional[Dict[str, Any]] = None,
@@ -185,6 +344,11 @@ def render_report(
         cards.append(_card("processes lost", procs.get("lost", 0)))
     if streaming.get("epochs"):
         cards.append(_card("stream epochs", streaming["epochs"]))
+    by_process = summary.get("by_process") or {}
+    if by_process:
+        cards.append(_card("fleet processes", len(by_process)))
+    if summary.get("incidents"):
+        cards.append(_card("incidents", len(summary["incidents"])))
 
     sections = [
         f"<h1>{_esc(title)}</h1>",
@@ -242,6 +406,28 @@ def render_report(
                 [[_esc(f["direction"]), f["replicas"], f.get("replica", -1),
                   _esc(f.get("reason", ""))] for f in fleet],
             ))
+
+    if by_process:
+        sections += [
+            "<h2>Fleet event log</h2>",
+            "<p>merged per-process segments "
+            "(<code>events.jsonl@&lt;process&gt;</code>)</p>",
+            _table(
+                ["process", "events"],
+                [[_esc(p), n] for p, n in sorted(by_process.items())],
+            ),
+        ]
+
+    sections += [
+        "<h2>Distributed traces</h2>",
+        _trace_waterfall(events),
+    ]
+
+    if summary.get("incidents"):
+        sections += [
+            "<h2>Incidents</h2>",
+            _incidents_table(summary["incidents"]),
+        ]
 
     breakers = summary["breaker_trips"]
     swaps = summary["swaps"]
@@ -333,7 +519,19 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--title", default=None, help="report title")
     args = parser.parse_args(argv)
 
-    events = replay(args.eventlog)
+    # a base path with per-process siblings (events.jsonl@replica-0, ...)
+    # renders the federated fleet view; a plain log (including an
+    # already-merged file, whose records carry process stamps) replays
+    segments = collect(args.eventlog)
+    if len(segments) > 1:
+        events = merge(args.eventlog)
+        print(
+            f"federating {len(segments)} process logs: "
+            + ", ".join(sorted(segments)),
+            file=sys.stderr,
+        )
+    else:
+        events = replay(args.eventlog)
     metrics = None
     if args.metrics:
         with open(args.metrics) as fh:

@@ -74,6 +74,37 @@ def _hist(summary: Dict[str, Any], name: str) -> Dict[str, float]:
     return {"count": 0.0, "sum": 0.0, "p50": 0.0, "p95": 0.0, "p99": 0.0}
 
 
+def fleet_summary(registry: MetricsRegistry) -> Dict[str, Any]:
+    """Collapse a federated (``replica``-labeled) registry into the
+    unlabeled summary shape :meth:`SLOReport.fold` reads. Counters and
+    gauges already sum across children in the fold's ``_scalar``; the
+    work here is histograms — a parent whose observations all live in
+    labeled children reports ``count=0``, so merge the children
+    bucket-for-bucket into one series before interpolating quantiles.
+    Deterministic: the merge is pure addition over sorted metric names."""
+    from mmlspark_tpu.observability.registry import Histogram
+
+    summary = registry.summary()
+    with registry._lock:
+        metrics = dict(registry._metrics)
+    for name, metric in sorted(metrics.items()):
+        if not isinstance(metric, Histogram) or not metric._children:
+            continue
+        merged = Histogram(name, buckets=metric.buckets)
+        for _, series in metric._series():
+            with series._lock:
+                counts = list(series._counts)  # type: ignore[attr-defined]
+                total = series._count  # type: ignore[attr-defined]
+                ssum = series._sum  # type: ignore[attr-defined]
+            if len(counts) != len(merged._counts):
+                continue  # child scraped with mismatched buckets
+            merged._counts = [a + b for a, b in zip(merged._counts, counts)]
+            merged._count += total
+            merged._sum += ssum
+        summary[name] = merged.summary()
+    return summary
+
+
 @dataclasses.dataclass
 class SLOReport:
     """One serving-SLO verdict, derived from the registry + event log."""
@@ -198,6 +229,25 @@ class SLOReport:
             stages=stages,
             batches=batches,
         )
+
+    @classmethod
+    def fold_fleet(
+        cls,
+        registry: Union[MetricsRegistry, Dict[str, Any], None],
+        events: Optional[Iterable[Event]] = None,
+        targets: Optional[SLOTargets] = None,
+    ) -> "SLOReport":
+        """The fleet-wide verdict: fold a **federated** registry (every
+        series ``replica``-labeled, from
+        :meth:`~mmlspark_tpu.observability.federation.MetricsFederator.scrape`)
+        plus a **merged** multi-process event stream (from
+        :func:`~mmlspark_tpu.observability.events.merge`) into one report.
+        Histogram children merge bucket-for-bucket first, so the fleet p99
+        is interpolated over the union of observations, not the mean of
+        per-replica quantiles."""
+        if isinstance(registry, MetricsRegistry):
+            registry = fleet_summary(registry)
+        return cls.fold(registry, events=events, targets=targets)
 
     # -- renderers -----------------------------------------------------------
 

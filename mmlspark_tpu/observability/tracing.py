@@ -15,12 +15,23 @@ module is that layer:
 - ids are **deterministic**: process-wide counters, not random — two
   identical single-threaded runs produce identical span ids, which is
   what replay-based tests want;
+- :class:`TraceContext` carries a trace across the **wire**
+  (``X-Trace-Id`` / ``X-Parent-Span-Id`` headers, or a plain dict in a
+  process-group epoch spec); ``start_span(..., context=ctx)`` opens a
+  span whose trace id came from another process. Wire parent ids are
+  qualified ``<process>:<span_id>`` so the merged fleet log can resolve
+  parents unambiguously even though every process mints span ids from
+  its own counter;
 - every span entered through the context manager is bridged into
   :func:`mmlspark_tpu.core.profiling.annotate`, so an active xprof
   device trace shows the same names as the exported span tree.
 
 Finished spans accumulate in a bounded ring (default 4096) and export
-to JSON via :meth:`Tracer.export`.
+to JSON via :meth:`Tracer.export`. When the event bus has listeners
+(``MMLSPARK_TPU_EVENT_LOG`` set), every finished span is also published
+as a :class:`~mmlspark_tpu.observability.events.SpanRecorded` event, so
+the per-process event-log segments carry the span stream the history
+server's cross-process waterfall is rebuilt from.
 """
 
 from __future__ import annotations
@@ -62,6 +73,72 @@ class Span:
             "status": self.status,
             "tags": dict(self.tags),
         }
+
+
+#: wire headers a :class:`TraceContext` rides in (HTTP hop or epoch spec)
+TRACE_HEADER = "X-Trace-Id"
+PARENT_HEADER = "X-Parent-Span-Id"
+
+
+@dataclasses.dataclass(frozen=True)
+class TraceContext:
+    """A trace's identity off the wire: enough to parent a local span
+    under a span minted in another process.
+
+    ``parent_span_id`` is **qualified** as ``<process>:<span_id>`` when it
+    crosses a process boundary (see :meth:`from_span`) — span-id counters
+    are per-process, so the bare id alone is ambiguous in a merged fleet
+    log. In-process parent ids stay bare; the history server resolves a
+    bare id within the owning process first.
+    """
+
+    trace_id: str
+    parent_span_id: str = ""
+
+    def to_headers(self) -> Dict[str, str]:
+        """The HTTP carrier: ``X-Trace-Id`` (+ ``X-Parent-Span-Id``)."""
+        headers = {TRACE_HEADER: self.trace_id}
+        if self.parent_span_id:
+            headers[PARENT_HEADER] = self.parent_span_id
+        return headers
+
+    @classmethod
+    def from_headers(cls, headers: Any) -> Optional["TraceContext"]:
+        """Parse the carrier headers (any ``.get``-able mapping, e.g.
+        ``BaseHTTPRequestHandler.headers``); None when no trace rode in."""
+        if headers is None:
+            return None
+        trace_id = headers.get(TRACE_HEADER)
+        if not trace_id:
+            return None
+        return cls(
+            trace_id=str(trace_id),
+            parent_span_id=str(headers.get(PARENT_HEADER) or ""),
+        )
+
+    @classmethod
+    def from_span(cls, span: Span) -> "TraceContext":
+        """The context to ship when ``span`` is the remote parent; the
+        parent id is qualified with this process's event-log label."""
+        from mmlspark_tpu.observability.events import process_label
+
+        return cls(
+            trace_id=span.trace_id,
+            parent_span_id=f"{process_label()}:{span.span_id}",
+        )
+
+    def to_dict(self) -> Dict[str, str]:
+        """JSON-able form for non-HTTP carriers (epoch specs)."""
+        return {"trace_id": self.trace_id, "parent_span_id": self.parent_span_id}
+
+    @classmethod
+    def from_dict(cls, rec: Optional[Dict[str, Any]]) -> Optional["TraceContext"]:
+        if not rec or not rec.get("trace_id"):
+            return None
+        return cls(
+            trace_id=str(rec["trace_id"]),
+            parent_span_id=str(rec.get("parent_span_id") or ""),
+        )
 
 
 class Tracer:
@@ -116,12 +193,28 @@ class Tracer:
         self,
         name: str,
         parent: Optional[Span] = None,
+        context: Optional[TraceContext] = None,
         **tags: Any,
     ) -> Span:
         """Open a span without making it ambient. ``parent=None`` uses the
         ambient span; a detached root needs an explicit ``parent`` of a
-        fresh trace (or no ambient span)."""
+        fresh trace (or no ambient span). ``context`` adopts a trace that
+        arrived over the wire: the span joins the remote trace id with the
+        (qualified) remote span as its parent — a local ``parent`` wins
+        when both are given."""
         parent = parent if parent is not None else self.current()
+        if parent is None and context is not None:
+            with self._lock:
+                self._span_seq += 1
+                span_id = f"{self._span_seq:08x}"
+            return Span(
+                name=name,
+                trace_id=context.trace_id,
+                span_id=span_id,
+                parent_id=context.parent_span_id or None,
+                start=time.monotonic(),
+                tags=dict(tags),
+            )
         trace_id, span_id = self._next_ids(parent)
         return Span(
             name=name,
@@ -139,7 +232,33 @@ class Tracer:
             span.tags.update(tags)
         with self._lock:
             self._finished.append(span)
+        self._publish(span)
         return span
+
+    def _publish(self, span: Span) -> None:
+        """Mirror a finished span onto the event bus (SpanRecorded) so the
+        per-process event-log segments carry the span stream; free when
+        nobody listens."""
+        from mmlspark_tpu.observability import events as _events
+
+        bus = _events.get_bus()
+        if not bus.active:
+            return
+        duration = span.duration or 0.0
+        bus.publish(_events.SpanRecorded(
+            name=span.name,
+            trace_id=span.trace_id,
+            span_id=span.span_id,
+            parent_id=span.parent_id or "",
+            start=span.start,
+            duration=duration,
+            wall_start=time.time() - duration,
+            status=span.status,
+            tags={
+                k: v for k, v in span.tags.items()
+                if isinstance(v, (str, int, float, bool))
+            },
+        ))
 
     # -- context-managed spans (the common form) -----------------------------
 
@@ -148,13 +267,14 @@ class Tracer:
         self,
         name: str,
         parent: Optional[Span] = None,
+        context: Optional[TraceContext] = None,
         **tags: Any,
     ) -> Iterator[Span]:
         """Open a span as a child of ``parent`` (default: the ambient
-        span), make it ambient for the body, finish it on exit (status =
-        exception class name on error), and mirror the name into any
-        active xprof trace."""
-        sp = self.start_span(name, parent=parent, **tags)
+        span; ``context`` joins a wire-propagated trace), make it ambient
+        for the body, finish it on exit (status = exception class name on
+        error), and mirror the name into any active xprof trace."""
+        sp = self.start_span(name, parent=parent, context=context, **tags)
         token = self._current.set(sp)
         try:
             with self._annotate(name):

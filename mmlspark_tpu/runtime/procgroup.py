@@ -484,7 +484,21 @@ def worker_main(workdir: str, member: int, start_epoch: int = 0) -> int:
                     group=group,
                     fault_directives=list(spec.get("faults") or []),
                 )
-                result = _resolve_entry(spec["entry"])(ctx)
+                # the epoch spec ships the driver's TraceContext: the
+                # gang.worker span (and the payload's children — allreduce,
+                # histogram build) land in the driver's trace, tagged with
+                # this process's label in the federated event log
+                from mmlspark_tpu.observability.tracing import (
+                    TraceContext,
+                    get_tracer,
+                )
+
+                trace_ctx = TraceContext.from_dict(spec.get("trace"))
+                with get_tracer().span(
+                    "gang.worker", context=trace_ctx,
+                    member=member, rank=rank, epoch=epoch,
+                ):
+                    result = _resolve_entry(spec["entry"])(ctx)
                 if group is not None:
                     group.barrier()  # commit: the whole gang finished
                 _write_json(wd / f"done-{epoch}-{member}.json",
@@ -684,12 +698,17 @@ class ProcessGroup:
         self._generations[member] = gen
         log_path = self.workdir / f"log-{member}-{gen}.txt"
         log_fh = open(log_path, "wb")
+        # per-process event-log federation: the gang member writes its own
+        # ``<base>@member-<m>`` segment instead of clobbering the driver's
+        # live file (observability.events.collect folds them back)
+        env = dict(self.env)
+        env["MMLSPARK_TPU_EVENT_LOG_PROCESS"] = f"member-{member}"
         try:
             proc = subprocess.Popen(
                 [sys.executable, "-m", "mmlspark_tpu.runtime.procgroup",
                  "--worker", str(self.workdir), str(member),
                  "--start-epoch", str(start_epoch)],
-                env=self.env, stdout=log_fh, stderr=subprocess.STDOUT,
+                env=env, stdout=log_fh, stderr=subprocess.STDOUT,
                 cwd=str(self.workdir),
             )
         finally:
@@ -776,6 +795,13 @@ class ProcessGroup:
                 seed=self.seed * 1000 + epoch * 2 + 7,
                 exclude=[spec["coordinator_port"]],
             )
+        # ship the driver's ambient trace so worker spans (allreduce,
+        # histogram build) parent under it in the merged fleet trace
+        from mmlspark_tpu.observability.tracing import TraceContext, get_tracer
+
+        span = get_tracer().current()
+        if span is not None:
+            spec["trace"] = TraceContext.from_span(span).to_dict()
         _write_json(self.workdir / f"epoch-{epoch}.json", spec)
 
     # -- the gang loop -------------------------------------------------------
@@ -785,14 +811,40 @@ class ProcessGroup:
         ``{member: payload result}`` for the successful epoch. Raises
         :class:`GangFailedError` when recovery options run out and
         ``RuntimeError`` when a payload itself fails (a bug, surfaced with
-        the worker's log tail)."""
+        the worker's log tail).
+
+        The whole gang runs under one ``procgroup.run`` span whose
+        context ships in every epoch spec, so worker-side spans join the
+        driver's trace; a :class:`GangFailedError` trips the incident
+        flight recorder before it propagates."""
+        from mmlspark_tpu.observability.tracing import get_tracer
+
+        with get_tracer().span("procgroup.run", entry=self.entry):
+            return self._run_epochs(poll)
+
+    def _gang_failed(self, message: str) -> GangFailedError:
+        """Book the incident (when a recorder is installed) and build the
+        terminal error — gang death is exactly what the flight recorder
+        exists to capture."""
+        from mmlspark_tpu.observability.incidents import maybe_record
+        from mmlspark_tpu.observability.tracing import get_tracer
+
+        span = get_tracer().current()
+        maybe_record(
+            "gang_failed",
+            trace_id=span.trace_id if span is not None else "",
+            detail=message,
+        )
+        return GangFailedError(message)
+
+    def _run_epochs(self, poll: float) -> Dict[int, Any]:
         from mmlspark_tpu.observability import GroupReformed, ProcessLost
 
         if not self._procs:
             self.start()
         while True:
             if self.epoch >= self.max_epochs:
-                raise GangFailedError(
+                raise self._gang_failed(
                     f"no successful epoch within {self.max_epochs} attempts"
                 )
             epoch = self.epoch
@@ -833,7 +885,7 @@ class ProcessGroup:
                         self.health.is_quarantined(loss.member), self.respawn,
                     )
             if not next_members:
-                raise GangFailedError(
+                raise self._gang_failed(
                     "all members lost and none eligible for respawn"
                 )
             self.members = sorted(next_members)

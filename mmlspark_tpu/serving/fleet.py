@@ -58,6 +58,7 @@ class FleetController:
         supervisor: ReplicaSupervisor,
         registry: Optional[RegistrationService] = None,
         registry_url: Optional[str] = None,
+        federator: Optional[Any] = None,
         min_replicas: int = 1,
         max_replicas: int = 4,
         scale_up_inflight: float = 4.0,
@@ -76,6 +77,10 @@ class FleetController:
         self.supervisor = supervisor
         self._registry = registry
         self._registry_url = registry_url.rstrip("/") if registry_url else None
+        #: optional MetricsFederator — when set, every control pass swaps
+        #: the heartbeat load metadata for live /metrics scrapes, so the
+        #: autoscaler steers on fleet-wide truth instead of lease lag
+        self.federator = federator
         self.min_replicas = int(min_replicas)
         self.max_replicas = int(max_replicas)
         self.scale_up_inflight = float(scale_up_inflight)
@@ -115,6 +120,34 @@ class FleetController:
             self._registry_url + "/services", timeout=5
         ) as resp:
             return _parse_services(json.loads(resp.read()))
+
+    def _federated(self, services: List[ServiceInfo]) -> List[ServiceInfo]:
+        """Swap heartbeat load metadata for scraped signals where the
+        federator has them; a failed scrape round keeps the heartbeat
+        values (federation must never blind the control loop)."""
+        import dataclasses
+
+        try:
+            signals = self.federator.fleet_signals(services=[
+                {"name": s.name, "host": s.host, "port": s.port}
+                for s in services
+            ])
+        except Exception as e:  # noqa: BLE001 - replicas mid-churn
+            logger.debug("fleet signals scrape failed: %s", e)
+            return services
+        out: List[ServiceInfo] = []
+        for svc in services:
+            sig = signals.get(svc.name)
+            if not sig:
+                out.append(svc)
+                continue
+            out.append(dataclasses.replace(
+                svc,
+                inflight=int(sig["inflight"]),
+                shed_total=int(sig["shed_total"]),
+                p99_ms=float(sig["p99_ms"]),
+            ))
+        return out
 
     def decide(
         self, services: List[ServiceInfo], now: Optional[float] = None
@@ -213,6 +246,8 @@ class FleetController:
         except Exception as e:  # noqa: BLE001 - registry briefly down
             logger.warning("fleet controller lost the registry: %s", e)
             return None
+        if self.federator is not None:
+            services = self._federated(services)
         decision = self.decide(services)
         if decision is None:
             self._m_replicas.set(self.supervisor.live_count)

@@ -279,11 +279,18 @@ class ReplicaSupervisor:
             spec["hot_swap"] = self.hot_swap
         _write_json(self.workdir / f"replica-{index}.json", spec)
         log_fh = open(self.workdir / f"log-{index}-{gen}.txt", "wb")
+        # per-process event-log federation: the child inherits the shared
+        # MMLSPARK_TPU_EVENT_LOG base but writes its own
+        # ``<base>@replica-<index>`` segment, so two replicas never clobber
+        # one live file / rotation sequence (observability.events.collect
+        # folds the segments back together)
+        env = dict(self.env)
+        env["MMLSPARK_TPU_EVENT_LOG_PROCESS"] = f"replica-{index}"
         try:
             proc = subprocess.Popen(
                 [sys.executable, "-m", "mmlspark_tpu.serving.replicas",
                  "--replica", str(self.workdir), str(index)],
-                env=self.env, stdout=log_fh, stderr=subprocess.STDOUT,
+                env=env, stdout=log_fh, stderr=subprocess.STDOUT,
                 cwd=str(self.workdir),
             )
         finally:

@@ -55,6 +55,12 @@ from mmlspark_tpu.io.http.clients import BREAKER_FAILURE_STATUSES, _do_request
 from mmlspark_tpu.io.http.schema import EntityData, HTTPRequestData
 from mmlspark_tpu.observability.events import RequestRouted, get_bus
 from mmlspark_tpu.observability.registry import get_registry
+from mmlspark_tpu.observability.tracing import (
+    TRACE_HEADER,
+    Span,
+    TraceContext,
+    get_tracer,
+)
 from mmlspark_tpu.resilience.breaker import BreakerRegistry
 from mmlspark_tpu.resilience.budget import (
     DEADLINE_HEADER,
@@ -261,9 +267,14 @@ class FleetRouter:
 
     def _route(
         self, body: bytes, headers: Dict[str, str],
+        span: Optional[Span] = None,
     ) -> Tuple[int, bytes, Dict[str, str], str, int]:
         """One client request through the fleet. Returns
-        ``(status, body, extra_headers, final_replica, hops)``."""
+        ``(status, body, extra_headers, final_replica, hops)``.
+        ``span`` is the request's root span: each replica attempt opens a
+        ``router.hop`` child whose :class:`TraceContext` rides the hop
+        headers, so the replica's request->batch->apply spans parent
+        under this hop in the merged fleet trace."""
         deadline = Deadline.from_header(headers.get(DEADLINE_HEADER))
         if deadline is None and self.default_deadline_s:
             deadline = Deadline.after(self.default_deadline_s)
@@ -315,9 +326,26 @@ class FleetRouter:
                 # No breaker peek here: allow() claims half-open probes.
                 more = any(s.name not in tried for s in order)
                 hop_started = time.monotonic()
+                tracer = get_tracer()
+                hop_span = (
+                    tracer.start_span(
+                        "router.hop", parent=span, replica=candidate.name,
+                    )
+                    if span is not None else None
+                )
                 status, data, resp_headers = self._hop(
                     candidate, body, headers, deadline, hedge=more,
+                    trace=(
+                        TraceContext.from_span(hop_span)
+                        if hop_span is not None else None
+                    ),
                 )
+                if hop_span is not None:
+                    tracer.finish(
+                        hop_span,
+                        status="ok" if status < 500 else f"http_{status}",
+                        http_status=status,
+                    )
                 last = (status, data, resp_headers, candidate.name)
                 if not self.retry_policy.retryable(status):
                     return status, data, resp_headers, candidate.name, hops
@@ -352,17 +380,22 @@ class FleetRouter:
         headers: Dict[str, str],
         deadline: Optional[Deadline],
         hedge: bool = False,
+        trace: Optional[TraceContext] = None,
     ) -> Tuple[int, bytes, Dict[str, str]]:
         """One attempt against one replica, with breaker bookkeeping.
         Transport errors come back as a synthetic 502 so the retry loop
         has one shape to reason about. With ``hedge`` (other replicas
         remain untried) the socket wait is capped to a fraction of the
-        remaining deadline so a timeout still leaves room to fail over."""
+        remaining deadline so a timeout still leaves room to fail over.
+        ``trace`` is the hop span's wire context — the replica adopts it
+        so its spans land in the router's trace."""
         breaker = self.breakers.for_url(svc.url)
         timeout = self.hop_timeout_s
         extra: Dict[str, str] = {"Content-Type": "application/json"}
-        if headers.get("X-Trace-Id"):
-            extra["X-Trace-Id"] = headers["X-Trace-Id"]
+        if trace is not None:
+            extra.update(trace.to_headers())
+        elif headers.get(TRACE_HEADER):
+            extra[TRACE_HEADER] = headers[TRACE_HEADER]
         if deadline is not None:
             # forward the REMAINING budget; never wait on the socket
             # longer than the caller will wait for us
@@ -445,23 +478,40 @@ class FleetRouter:
                 length = int(self.headers.get("Content-Length", 0))
                 body = self.rfile.read(length)
                 headers = dict(self.headers.items())
+                # root span for the fleet-wide trace; a client-supplied
+                # X-Trace-Id is adopted so an upstream hop stays parent
+                tracer = get_tracer()
+                span = tracer.start_span(
+                    "router.request", rid=rid,
+                    context=TraceContext.from_headers(self.headers),
+                )
                 status, data, extra, replica, hops = router._route(
-                    body, headers
+                    body, headers, span=span
                 )
                 router._m_requests.inc()
                 if hops > 1:
                     router._m_failovers.inc()
                 latency = time.monotonic() - t0
                 router._m_latency.observe(latency)
+                # the trace id rides EVERY reply — 429/503/504 included —
+                # so a user-quoted incident id joins against the event log
+                extra = dict(extra)
+                extra[TRACE_HEADER] = span.trace_id
                 try:
                     self._reply_bytes(status, data, extra_headers=extra)
                 except OSError:
                     pass  # client hung up; the fold still sees the event
+                tracer.finish(
+                    span,
+                    status="ok" if status < 500 else f"http_{status}",
+                    http_status=status, hops=hops, replica=replica,
+                )
                 bus = get_bus()
                 if bus.active:
                     bus.publish(RequestRouted(
                         rid=rid, replica=replica, hops=hops,
                         status=status, latency=latency,
+                        trace_id=span.trace_id,
                     ))
 
             def log_message(self, *args):  # silence default stderr logging

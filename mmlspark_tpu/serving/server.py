@@ -51,7 +51,12 @@ from mmlspark_tpu.observability.events import (
 )
 from mmlspark_tpu.observability.profiler import get_profiler
 from mmlspark_tpu.observability.registry import get_registry
-from mmlspark_tpu.observability.tracing import Span, get_tracer
+from mmlspark_tpu.observability.tracing import (
+    TRACE_HEADER,
+    Span,
+    TraceContext,
+    get_tracer,
+)
 from mmlspark_tpu.resilience.admission import AdmissionController
 from mmlspark_tpu.resilience.budget import DEADLINE_HEADER, Deadline
 
@@ -566,13 +571,15 @@ class _ListenerMixin:
                 if req.deadline is None and server.request_deadline_s:
                     req.deadline = Deadline.after(server.request_deadline_s)
                 tracer = get_tracer()
-                # listener threads carry no ambient span, so this is a trace
-                # root: the request mints the trace id the batch loop joins
-                span = tracer.start_span("serving.request", rid=req.rid)
-                # honor a caller-supplied trace id (cross-service stitching)
-                upstream = self.headers.get("X-Trace-Id")
-                if upstream:
-                    span.tags["upstream_trace_id"] = upstream
+                # listener threads carry no ambient span; a wire-propagated
+                # TraceContext (the router's hop) is adopted so this
+                # request->batch->apply chain parents under the router's
+                # span in the merged fleet trace — otherwise the request
+                # mints the trace root itself
+                span = tracer.start_span(
+                    "serving.request", rid=req.rid,
+                    context=TraceContext.from_headers(self.headers),
+                )
                 req.span, req.trace_id = span, span.trace_id
                 loop.submit(req)
                 wait_s = server.reply_timeout_s
@@ -588,7 +595,10 @@ class _ListenerMixin:
                 else:
                     status, data = req.status, req.response
                 try:
-                    self._reply_bytes(status, data)
+                    self._reply_bytes(
+                        status, data,
+                        extra_headers={TRACE_HEADER: span.trace_id},
+                    )
                 except OSError as e:
                     # client disconnect on the reply path: answer computed
                     # but unwritable — count it, don't stack-trace (the

@@ -13,12 +13,20 @@ import pytest
 
 from mmlspark_tpu.data.table import Table
 from mmlspark_tpu.observability.events import (
+    EventLogSink,
     FleetScaled,
     RequestRouted,
+    RequestServed,
+    SpanRecorded,
     get_bus,
+    merge,
+    process_log_path,
     timeline,
+    write_merged,
 )
 from mmlspark_tpu.observability.registry import MetricsRegistry
+from mmlspark_tpu.observability.slo import SLOReport
+from mmlspark_tpu.observability.tracing import TRACE_HEADER
 from mmlspark_tpu.resilience.budget import RetryBudget
 from mmlspark_tpu.resilience.policy import RetryPolicy
 from mmlspark_tpu.runtime.faults import FaultPlan, inject_faults
@@ -59,6 +67,25 @@ def _post(url, payload, timeout=10, headers=None):
     except urllib.error.HTTPError as e:
         body = e.read()
         return e.code, (json.loads(body) if body else None)
+
+
+def _post_headers(url, payload, timeout=10, headers=None):
+    """Like _post, but also returns the response headers — the trace id
+    rides every reply, error paths included."""
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(), method="POST",
+        headers={"Content-Type": "application/json", **(headers or {})},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read()), dict(r.headers.items())
+    except urllib.error.HTTPError as e:
+        body = e.read()
+        return (
+            e.code,
+            (json.loads(body) if body else None),
+            dict(e.headers.items()),
+        )
 
 
 class _Fleet:
@@ -604,3 +631,138 @@ class TestFleetObservability:
         assert routed[0].status == 200
         assert routed[0].hops == 1
         assert routed[0].replica in ("replica-0", "replica-1")
+
+
+class TestRouterTracing:
+    def test_reply_carries_the_trace_id(self, fleet):
+        seen = []
+        bus = get_bus()
+        bus.add_listener(seen.append)
+        try:
+            with _router(fleet) as router:
+                status, _, headers = _post_headers(router.url, {"input": 1.0})
+                assert status == 200
+        finally:
+            bus.remove_listener(seen.append)
+        trace_id = headers.get(TRACE_HEADER)
+        assert trace_id
+        [routed] = [e for e in seen if isinstance(e, RequestRouted)]
+        assert routed.trace_id == trace_id
+
+    def test_error_reply_still_carries_the_trace_id(self):
+        # a user quoting a failed request's trace id must join against
+        # the event log, so 503s carry the header too
+        with RegistrationService() as registry:
+            with FleetRouter(registry=registry,
+                             discovery_interval_s=60.0) as router:
+                status, out, headers = _post_headers(
+                    router.url, {"input": 1.0}
+                )
+                assert status == 503
+                assert "no live replicas" in out["error"]
+                assert headers.get(TRACE_HEADER)
+
+    def test_replica_spans_join_the_router_trace(self, fleet):
+        seen = []
+        bus = get_bus()
+        bus.add_listener(seen.append)
+        try:
+            with _router(fleet) as router:
+                status, _, headers = _post_headers(router.url, {"input": 1.0})
+                assert status == 200
+        finally:
+            bus.remove_listener(seen.append)
+        trace_id = headers[TRACE_HEADER]
+        spans = [e for e in seen
+                 if isinstance(e, SpanRecorded) and e.trace_id == trace_id]
+        names = {s.name for s in spans}
+        assert {"router.request", "router.hop", "serving.request"} <= names
+        hop = next(s for s in spans if s.name == "router.hop")
+        serving = next(s for s in spans if s.name == "serving.request")
+        # the wire context qualified the hop as the replica's parent
+        assert serving.parent_id == f"driver:{hop.span_id}"
+
+    def test_client_supplied_trace_is_adopted(self, fleet):
+        with _router(fleet) as router:
+            status, _, headers = _post_headers(
+                router.url, {"input": 1.0},
+                headers={TRACE_HEADER: "upstream-trace"},
+            )
+            assert status == 200
+            assert headers[TRACE_HEADER] == "upstream-trace"
+
+
+class TestFleetLogDeterminism:
+    """The satellite contract: the SLO fold over a merged multi-process
+    event log is deterministic under seeded chaos — re-merging the same
+    segments is byte-identical, and the fleet report folds to identical
+    JSON every time."""
+
+    def test_merged_fold_is_deterministic_under_seeded_chaos(
+        self, fleet, tmp_path
+    ):
+        base = str(tmp_path / "events.jsonl")
+        plan = (
+            FaultPlan(seed=11)
+            .http_storm(count=3, status=503)
+            .kill_process(1, iteration=4)
+        )
+        directives = plan.process_kill_directives()
+        driver_sink = EventLogSink(base, process="driver")
+        replica_sinks = {
+            name: EventLogSink(process_log_path(base, name), process=name)
+            for name in fleet.servers
+        }
+        seen = []
+        bus = get_bus()
+        bus.add_listener(seen.append)
+        bus.add_listener(driver_sink)  # the driver books its real stream
+        try:
+            with _router(fleet) as router:
+                with inject_faults(plan):
+                    for i in range(20):
+                        _post(router.url, {"input": float(i)})
+        finally:
+            bus.remove_listener(driver_sink)
+            bus.remove_listener(seen.append)
+        assert any(kind == "http_status" for kind, _, _ in plan.fired)
+        # each replica books the requests it served into its own segment,
+        # until the seeded kill directive ends its stream mid-run
+        alive = {name: True for name in replica_sinks}
+        iters = {name: 0 for name in replica_sinks}
+        for e in (e for e in seen if isinstance(e, RequestRouted)):
+            name = e.replica
+            if name not in replica_sinks:
+                continue
+            member = int(name.rsplit("-", 1)[1])
+            if FaultPlan.should_die(
+                directives, member, iteration=iters[name], epoch=0
+            ):
+                alive[name] = False
+            iters[name] += 1
+            if alive[name] and e.status == 200:
+                replica_sinks[name](RequestServed(
+                    rid=e.rid, status=e.status, latency=e.latency,
+                    trace_id=e.trace_id,
+                ))
+        driver_sink.close()
+        for sink in replica_sinks.values():
+            sink.close()
+        assert not alive["replica-1"], "the seeded kill never landed"
+        # re-merging the same segments is byte-identical
+        out1, out2 = str(tmp_path / "m1.jsonl"), str(tmp_path / "m2.jsonl")
+        n1 = write_merged(base, out1)
+        n2 = write_merged(base, out2)
+        assert n1 == n2 > 0
+        with open(out1, "rb") as a, open(out2, "rb") as b:
+            assert a.read() == b.read()
+        # and the fleet SLO fold over the merged stream is deterministic
+        events = merge(base)
+        assert {getattr(e, "process", "") for e in events} >= {
+            "driver", "replica-0", "replica-1",
+        }
+        report = SLOReport.fold(None, events=events)
+        assert report.requests > 0
+        assert report.to_json() == SLOReport.fold(
+            None, events=merge(base)
+        ).to_json()

@@ -22,25 +22,41 @@ from mmlspark_tpu.core.pipeline import Pipeline, Transformer
 from mmlspark_tpu.core.profiling import StopWatch
 from mmlspark_tpu.data import Table
 from mmlspark_tpu.observability import (
+    PARENT_HEADER,
+    TRACE_HEADER,
     BatchFormed,
+    BreakerTripped,
     EventBus,
     EventLogSink,
+    FlightRecorder,
+    IncidentRecorded,
+    MetricsFederator,
     MetricsRegistry,
     ModelCommitted,
     RequestServed,
+    RequestShed,
+    SpanRecorded,
     StageCompleted,
     StageStarted,
     TaskDispatched,
     TaskFailed,
     TaskRetried,
+    TraceContext,
     Tracer,
+    collect,
+    fleet_summary,
     format_timeline,
     from_record,
     get_bus,
     get_tracer,
+    merge,
+    parse_exposition,
+    process_log_path,
     replay,
     timeline,
+    write_merged,
 )
+from mmlspark_tpu.observability.slo import SLOReport
 from mmlspark_tpu.serving import ServingServer
 from mmlspark_tpu.serving.server import _BatchLoop
 
@@ -560,3 +576,346 @@ class TestPipelineEvents:
         # no ambient span: the hot path must not pay per-stage spans
         model.transform(Table({"input": np.arange(2.0)}))
         assert len(tracer.export()) == before
+
+
+# ---------------------------------------------------------------------------
+# wire-propagated trace context
+# ---------------------------------------------------------------------------
+
+
+class TestTraceContext:
+    def test_header_round_trip(self):
+        ctx = TraceContext(trace_id="t00ab", parent_span_id="driver:00000003")
+        headers = ctx.to_headers()
+        assert headers == {
+            TRACE_HEADER: "t00ab",
+            PARENT_HEADER: "driver:00000003",
+        }
+        assert TraceContext.from_headers(headers) == ctx
+
+    def test_no_trace_header_means_no_context(self):
+        assert TraceContext.from_headers({}) is None
+        assert TraceContext.from_headers(None) is None
+        # a parent without a trace id is noise, not a context
+        assert TraceContext.from_headers({PARENT_HEADER: "x:01"}) is None
+
+    def test_start_span_adopts_remote_context(self):
+        tr = Tracer(xprof=False)
+        ctx = TraceContext(trace_id="t00ab", parent_span_id="driver:00000003")
+        span = tr.start_span("serving.request", context=ctx)
+        assert span.trace_id == "t00ab"
+        assert span.parent_id == "driver:00000003"
+
+    def test_local_parent_wins_over_context(self):
+        tr = Tracer(xprof=False)
+        ctx = TraceContext(trace_id="remote", parent_span_id="driver:01")
+        with tr.span("local-root") as root:
+            child = tr.start_span("child", context=ctx)
+        assert child.trace_id == root.trace_id
+        assert child.parent_id == root.span_id
+
+    def test_from_span_qualifies_parent_with_process_label(self, monkeypatch):
+        monkeypatch.setenv("MMLSPARK_TPU_EVENT_LOG_PROCESS", "replica-7")
+        tr = Tracer(xprof=False)
+        span = tr.start_span("router.hop")
+        ctx = TraceContext.from_span(span)
+        assert ctx.trace_id == span.trace_id
+        assert ctx.parent_span_id == f"replica-7:{span.span_id}"
+
+    def test_dict_round_trip_for_epoch_specs(self):
+        ctx = TraceContext(trace_id="t01", parent_span_id="driver:02")
+        assert TraceContext.from_dict(ctx.to_dict()) == ctx
+        assert TraceContext.from_dict(None) is None
+        assert TraceContext.from_dict({"parent_span_id": "x"}) is None
+
+
+class TestSpanRecorded:
+    def test_finished_spans_publish_when_bus_active(self):
+        bus = get_bus()
+        seen = []
+        bus.add_listener(seen.append)
+        try:
+            tr = Tracer(xprof=False)
+            with tr.span("outer"):
+                with tr.span("inner"):
+                    pass
+        finally:
+            bus.remove_listener(seen.append)
+        spans = [e for e in seen if isinstance(e, SpanRecorded)]
+        assert [s.name for s in spans] == ["inner", "outer"]
+        inner, outer = spans
+        assert inner.trace_id == outer.trace_id
+        assert inner.parent_id == outer.span_id
+        assert inner.duration >= 0 and inner.wall_start > 0
+
+
+# ---------------------------------------------------------------------------
+# fleet event-log federation
+# ---------------------------------------------------------------------------
+
+
+class TestEventLogFederation:
+    def test_process_log_path_suffixes_the_base(self):
+        assert (
+            process_log_path("/tmp/ev.jsonl", "replica-0")
+            == "/tmp/ev.jsonl@replica-0"
+        )
+        for bad in ("a.b", "a@b", "a/b", "a\\b"):
+            with pytest.raises(ValueError, match="invalid process label"):
+                process_log_path("/tmp/ev.jsonl", bad)
+
+    def test_sink_stamps_process_and_wall_time(self, tmp_path):
+        path = tmp_path / "ev.jsonl"
+        sink = EventLogSink(str(path), process="replica-3")
+        sink(RequestServed(rid="r1", status=200, latency=0.01))
+        sink.close()
+        [line] = path.read_text().splitlines()
+        rec = json.loads(line)
+        assert rec["process"] == "replica-3"
+        assert rec["wt"] > 0
+
+    def _write_fleet_log(self, tmp_path):
+        base = str(tmp_path / "events.jsonl")
+        driver = EventLogSink(base, process="driver")
+        replicas = [
+            EventLogSink(process_log_path(base, f"replica-{i}"),
+                         process=f"replica-{i}")
+            for i in range(2)
+        ]
+        driver(StageStarted(job_id=0, stage_id=0, name="route"))
+        replicas[0](RequestServed(rid="r0", status=200, latency=0.001))
+        replicas[1](RequestServed(rid="r1", status=200, latency=0.002))
+        driver(StageCompleted(job_id=0, stage_id=0, name="route",
+                              duration=0.01))
+        for sink in [driver, *replicas]:
+            sink.close()
+        return base
+
+    def test_collect_finds_driver_and_siblings(self, tmp_path):
+        base = self._write_fleet_log(tmp_path)
+        segments = collect(base)
+        assert sorted(segments) == ["driver", "replica-0", "replica-1"]
+        assert segments["driver"] == [base]
+        assert segments["replica-0"] == [base + "@replica-0"]
+
+    def test_merge_orders_by_wall_clock_and_tags_process(self, tmp_path):
+        base = self._write_fleet_log(tmp_path)
+        events = merge(base)
+        assert len(events) == 4
+        stamps = [e.wt for e in events]
+        assert stamps == sorted(stamps)
+        assert {e.process for e in events} == {
+            "driver", "replica-0", "replica-1",
+        }
+        served = [e for e in events if isinstance(e, RequestServed)]
+        assert {e.process for e in served} == {"replica-0", "replica-1"}
+
+    def test_write_merged_is_byte_identical_across_remerges(self, tmp_path):
+        base = self._write_fleet_log(tmp_path)
+        out1 = str(tmp_path / "merged-1.jsonl")
+        out2 = str(tmp_path / "merged-2.jsonl")
+        n1 = write_merged(base, out1)
+        n2 = write_merged(base, out2)
+        assert n1 == n2 == 4
+        with open(out1, "rb") as a, open(out2, "rb") as b:
+            assert a.read() == b.read()
+
+    def test_timeline_counts_per_process(self, tmp_path):
+        base = self._write_fleet_log(tmp_path)
+        summary = timeline(merge(base))
+        assert summary["by_process"] == {
+            "driver": 2, "replica-0": 1, "replica-1": 1,
+        }
+
+
+# ---------------------------------------------------------------------------
+# fleet metrics federation
+# ---------------------------------------------------------------------------
+
+
+def _replica_exposition(latencies, inflight, shed):
+    """One fake replica's /metrics body, built from a real registry so
+    parse_exposition stays the exact inverse of exposition()."""
+    reg = MetricsRegistry()
+    h = reg.histogram("serving_queue_wait_seconds", "Queue wait")
+    for v in latencies:
+        h.observe(v)
+    reg.gauge("serving_inflight").set(inflight)
+    reg.counter("serving_shed_total").inc(shed)
+    return reg.exposition()
+
+
+class TestMetricsFederation:
+    def test_parse_exposition_inverts_registry_exposition(self):
+        reg = MetricsRegistry()
+        reg.counter("requests_total", "Requests").inc(3)
+        reg.counter("failures_total").labels(reason="timeout").inc(2)
+        reg.histogram("lat_seconds", buckets=[0.01, 0.1]).observe(0.05)
+        kinds, samples = parse_exposition(reg.exposition())
+        assert kinds["requests_total"] == "counter"
+        assert kinds["lat_seconds"] == "histogram"
+        by_name = {}
+        for name, labels, value in samples:
+            by_name.setdefault(name, []).append((labels, value))
+        assert by_name["requests_total"] == [({}, 3.0)]
+        assert by_name["failures_total"] == [({"reason": "timeout"}, 2.0)]
+        buckets = dict(
+            (labels["le"], value)
+            for labels, value in by_name["lat_seconds_bucket"]
+        )
+        assert buckets["0.1"] == 1.0 and buckets["+Inf"] == 1.0
+
+    def _federator(self, bodies):
+        """A MetricsFederator whose fetch is served from ``bodies``:
+        {url-substring: text}."""
+        def fetch(url, timeout_s):
+            for part, body in bodies.items():
+                if part in url:
+                    return body
+            raise OSError(f"no route to {url}")
+
+        return MetricsFederator("http://registry:0", fetch=fetch)
+
+    def test_scrape_labels_every_series_with_the_replica(self):
+        fed = self._federator({
+            ":9000": _replica_exposition([0.002, 0.004], inflight=1, shed=0),
+            ":9001": _replica_exposition([0.2, 0.4], inflight=3, shed=5),
+        })
+        services = [
+            {"name": "replica-0", "host": "h", "port": 9000},
+            {"name": "replica-1", "host": "h", "port": 9001},
+        ]
+        reg = fed.scrape(services)
+        summary = reg.summary()
+        assert summary["serving_inflight"] == {
+            "replica=replica-0": 1.0, "replica=replica-1": 3.0,
+        }
+        hist = reg.histogram("serving_queue_wait_seconds")
+        assert hist.labels(replica="replica-0").count == 2
+        assert hist.labels(replica="replica-1").count == 2
+        # reconstructed buckets interpolate per-replica quantiles
+        assert hist.labels(replica="replica-0").percentile(0.5) < 0.05
+        assert hist.labels(replica="replica-1").percentile(0.5) > 0.05
+
+    def test_fleet_signals_read_load_at_the_source(self):
+        fed = self._federator({
+            ":9000": _replica_exposition([0.001] * 99, inflight=2, shed=1),
+        })
+        signals = fed.fleet_signals(
+            services=[{"name": "replica-0", "host": "h", "port": 9000}]
+        )
+        sig = signals["replica-0"]
+        assert sig["inflight"] == 2.0
+        assert sig["shed_total"] == 1.0
+        assert sig["p99_ms"] > 0
+
+    def test_scrape_failure_is_recorded_not_raised(self):
+        fed = self._federator({":9000": _replica_exposition([], 0, 0)})
+        services = [
+            {"name": "replica-0", "host": "h", "port": 9000},
+            {"name": "replica-gone", "host": "h", "port": 9999},
+        ]
+        reg = fed.scrape(services)
+        assert "replica-gone" in fed.last_errors
+        assert reg.summary()["serving_inflight"] == {
+            "replica=replica-0": 0.0,
+        }
+
+    def test_fleet_summary_merges_histogram_children(self):
+        fed = self._federator({
+            ":9000": _replica_exposition([0.002, 0.004], 0, 0),
+            ":9001": _replica_exposition([0.2, 0.4], 0, 0),
+        })
+        reg = fed.scrape([
+            {"name": "replica-0", "host": "h", "port": 9000},
+            {"name": "replica-1", "host": "h", "port": 9001},
+        ])
+        # the parent histogram has no direct observations, so the plain
+        # summary reports count=0 — the fleet fold must merge children
+        assert reg.summary()["serving_queue_wait_seconds"]["count"] == 0
+        merged = fleet_summary(reg)["serving_queue_wait_seconds"]
+        assert merged["count"] == 4
+        # the fleet fold interpolates over the union of observations
+        report = SLOReport.fold_fleet(reg)
+        assert report.stages["queue"]["count"] == 4
+        assert report.stages["queue"]["p99"] > 0
+
+
+# ---------------------------------------------------------------------------
+# incident flight recorder
+# ---------------------------------------------------------------------------
+
+
+class TestFlightRecorder:
+    def test_breaker_trip_dumps_an_atomic_bundle(self, tmp_path):
+        recorder = FlightRecorder(str(tmp_path), registry=MetricsRegistry(),
+                                  tracer=Tracer(xprof=False))
+        bus = get_bus()
+        seen = []
+        bus.add_listener(seen.append)
+        recorder.install()
+        try:
+            bus.publish(RequestServed(rid="r1", status=200, latency=0.001))
+            bus.publish(BreakerTripped(breaker="replica-0", failures=3,
+                                       window_s=10.0))
+        finally:
+            recorder.uninstall()
+            bus.remove_listener(seen.append)
+        [path] = recorder.recorded
+        manifest = json.loads(
+            (tmp_path / path.split("/")[-1] / "manifest.json").read_text()
+        )
+        assert manifest["trigger"] == "breaker_tripped"
+        assert "3 failures" in manifest["detail"]
+        lines = (
+            tmp_path / path.split("/")[-1] / "events.jsonl"
+        ).read_text().splitlines()
+        kinds = [json.loads(line)["event"] for line in lines]
+        assert kinds == ["RequestServed", "BreakerTripped"]
+        assert (tmp_path / path.split("/")[-1] / "metrics.json").exists()
+        assert (tmp_path / path.split("/")[-1] / "trace.json").exists()
+        booked = [e for e in seen if isinstance(e, IncidentRecorded)]
+        assert len(booked) == 1 and booked[0].path == path
+
+    def test_cooldown_suppresses_repeat_triggers(self, tmp_path):
+        clock = [1000.0]
+        recorder = FlightRecorder(str(tmp_path), cooldown_s=30.0,
+                                  registry=MetricsRegistry(),
+                                  tracer=Tracer(xprof=False),
+                                  clock=lambda: clock[0])
+        assert recorder.record("slo_budget", detail="p99 over") is not None
+        assert recorder.record("slo_budget") is None  # inside the window
+        # a different trigger has its own cooldown
+        assert recorder.record("gang_failed") is not None
+        clock[0] += 31.0
+        assert recorder.record("slo_budget") is not None
+        assert len(recorder.recorded) == 3
+
+    def test_incident_recorded_does_not_retrip(self, tmp_path):
+        recorder = FlightRecorder(str(tmp_path), registry=MetricsRegistry(),
+                                  tracer=Tracer(xprof=False))
+        recorder.install()
+        try:
+            get_bus().publish(IncidentRecorded(
+                incident_id="x", trigger="breaker_tripped", path="/p"
+            ))
+        finally:
+            recorder.uninstall()
+        assert recorder.recorded == []
+
+    def test_env_driven_recorder_lifecycle(self, tmp_path, monkeypatch):
+        from mmlspark_tpu.observability import incidents
+
+        monkeypatch.delenv("MMLSPARK_TPU_INCIDENT_DIR", raising=False)
+        assert incidents.get_recorder() is None
+        assert incidents.maybe_record("gang_failed") is None  # no-op
+        monkeypatch.setenv("MMLSPARK_TPU_INCIDENT_DIR", str(tmp_path / "inc"))
+        try:
+            recorder = incidents.get_recorder()
+            assert recorder is not None
+            assert recorder.directory == str(tmp_path / "inc")
+            path = incidents.maybe_record("gang_failed", detail="epoch budget")
+            assert path is not None and path.startswith(str(tmp_path / "inc"))
+        finally:
+            monkeypatch.delenv("MMLSPARK_TPU_INCIDENT_DIR")
+            incidents.get_recorder()  # re-sync uninstalls

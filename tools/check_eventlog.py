@@ -10,17 +10,28 @@ and duration-valued fields (``seconds``/``latency``/``duration``, the
 Profile*/RequestServed/TaskFailed payloads) must be non-negative.
 
 Rotated logs (``MMLSPARK_TPU_EVENT_LOG_MAX_BYTES``) are validated whole:
-every ``<path>.<seq>`` segment plus the live file, in write order.
+every ``<path>.<seq>`` segment plus the live file, in write order — and
+federated logs whole too: per-process siblings
+(``events.jsonl@replica-0``, ...) are discovered and validated alongside
+the driver log, or pass an already-merged fleet log directly.
 
     python tools/check_eventlog.py /path/to/events.jsonl
+    python tools/check_eventlog.py --trace-continuity fleet-events.jsonl
+
+``--trace-continuity`` additionally asserts the distributed-tracing
+contract over the (merged) stream: every successfully served
+``RequestRouted`` trace id must resolve to its full cross-process span
+chain — the router's root span AND the replica's serving span, from at
+least two distinct processes, under one trace id.
 
 Exit status 0 with a one-line summary when the log is clean; 1 with one
 diagnostic per bad line otherwise (CI gates on this; see the
-``observability`` job in .github/workflows/ci.yml).
+``observability`` and ``fleet-chaos`` jobs in .github/workflows/ci.yml).
 """
 
 from __future__ import annotations
 
+import argparse
 import dataclasses
 import json
 import sys
@@ -36,6 +47,10 @@ _JSON_TYPES = {
     "str": (str,),
     "bool": (bool,),
 }
+
+#: sink-level federation stamps — written by EventLogSink on every
+#: record (and re-stamped by merge), deliberately NOT dataclass fields
+_STAMP_FIELDS = {"process", "wt"}
 
 
 def _check_record(rec: object) -> typing.List[str]:
@@ -68,7 +83,7 @@ def _check_record(rec: object) -> typing.List[str]:
             problems.append(
                 f"{kind}.{name}: expected {f.type}, got {type(got).__name__}"
             )
-    unknown = set(rec) - set(fields) - {"event"}
+    unknown = set(rec) - set(fields) - {"event"} - _STAMP_FIELDS
     if unknown:
         problems.append(f"{kind}: unknown fields {sorted(unknown)}")
     t = rec.get("t")
@@ -81,15 +96,83 @@ def _check_record(rec: object) -> typing.List[str]:
     return problems
 
 
-def main(argv: typing.List[str]) -> int:
-    if len(argv) != 2:
-        print(__doc__.strip().splitlines()[0], file=sys.stderr)
-        print(f"usage: {argv[0]} EVENT_LOG", file=sys.stderr)
-        return 2
-    path = argv[1]
+def check_trace_continuity(
+    records: typing.List[dict],
+) -> typing.Tuple[typing.List[str], str]:
+    """(problems, summary) for the distributed-tracing contract over a
+    decoded (merged) record stream: every 200-served RequestRouted trace
+    id resolves to the router's root span AND a replica-side serving span
+    from a different process."""
+    spans: typing.Dict[str, typing.List[dict]] = {}
+    served: typing.List[dict] = []
+    for rec in records:
+        kind = rec.get("event")
+        if kind == "SpanRecorded" and rec.get("trace_id"):
+            spans.setdefault(rec["trace_id"], []).append(rec)
+        elif (
+            kind == "RequestRouted"
+            and rec.get("status") == 200
+            and rec.get("trace_id")
+        ):
+            served.append(rec)
+    problems = []
+    cross_process = 0
+    for rec in served:
+        tid = rec["trace_id"]
+        trace = spans.get(tid, [])
+        names = {s.get("name") for s in trace}
+        procs = {s.get("process", "") for s in trace}
+        missing = {"router.request", "serving.request"} - names
+        if missing:
+            problems.append(
+                f"trace {tid} (rid {rec.get('rid')}): "
+                f"missing span(s) {sorted(missing)} "
+                f"(have {sorted(n for n in names if n)})"
+            )
+        elif len(procs) < 2:
+            problems.append(
+                f"trace {tid} (rid {rec.get('rid')}): all spans from one "
+                f"process {sorted(procs)} — the wire hop dropped the context"
+            )
+        else:
+            cross_process += 1
+    if not served:
+        problems.append(
+            "no 200-served RequestRouted events with a trace id — "
+            "nothing to verify"
+        )
+    summary = (
+        f"trace continuity: {cross_process}/{len(served)} served traces "
+        f"span >=2 processes"
+    )
+    return problems, summary
+
+
+def main(argv: typing.Optional[typing.List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="tools/check_eventlog.py",
+        description="Validate a JSON-lines event log "
+                    "(rotated + federated segments included).",
+    )
+    parser.add_argument("eventlog", help="event log path (driver log with "
+                        "per-process siblings, or a merged fleet log)")
+    parser.add_argument(
+        "--trace-continuity", action="store_true",
+        help="also assert every served RequestRouted trace id resolves "
+             "to its full cross-process span chain",
+    )
+    args = parser.parse_args(argv)
+    path = args.eventlog
     counts: typing.Dict[str, int] = {}
+    valid_records: typing.List[dict] = []
     bad = 0
-    segments = ev.log_segments(path)
+    # per-process siblings federate into the segment list; a plain or
+    # already-merged log is just its own rotation chain
+    collected = ev.collect(path)
+    segments = [seg for label in sorted(collected)
+                for seg in collected[label]]
+    if not segments:
+        segments = ev.log_segments(path)
     for seg in segments:
         with open(seg, "r", encoding="utf-8") as fh:
             for lineno, line in enumerate(fh, 1):
@@ -110,16 +193,24 @@ def main(argv: typing.List[str]) -> int:
                     bad += 1
                 else:
                     counts[rec["event"]] = counts.get(rec["event"], 0) + 1
+                    valid_records.append(rec)
     total = sum(counts.values())
     where = path if len(segments) == 1 else f"{path} ({len(segments)} segments)"
+    trace_summary = ""
+    if args.trace_continuity:
+        problems, trace_summary = check_trace_continuity(valid_records)
+        for p in problems:
+            print(f"{path}: {p}", file=sys.stderr)
+        bad += len(problems)
     if bad:
-        print(f"{where}: {bad} invalid line(s), {total} valid",
+        print(f"{where}: {bad} problem(s), {total} valid event(s)",
               file=sys.stderr)
         return 1
     breakdown = " ".join(f"{k}={v}" for k, v in sorted(counts.items()))
-    print(f"{where}: {total} events ok ({breakdown})")
+    tail = f"; {trace_summary}" if trace_summary else ""
+    print(f"{where}: {total} events ok ({breakdown}){tail}")
     return 0
 
 
 if __name__ == "__main__":
-    sys.exit(main(sys.argv))
+    sys.exit(main())

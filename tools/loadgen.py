@@ -158,6 +158,7 @@ class LoadClients:
 
 def run_campaign(args):
     from mmlspark_tpu import observability as obs
+    from mmlspark_tpu.observability.federation import MetricsFederator
     from mmlspark_tpu.observability.registry import get_registry
     from mmlspark_tpu.observability.slo import SLOReport, SLOTargets
     from mmlspark_tpu.runtime.faults import FaultPlan, inject_faults
@@ -212,8 +213,14 @@ def run_campaign(args):
         registry_url=registry.info.url, policy=args.policy,
         discovery_interval_s=0.1, hop_timeout_s=2.0,
     ).start()
+    # federation: the controller steers on live /metrics scrapes instead
+    # of heartbeat lag, and the flight recorder bundles the fleet snapshot
+    federator = MetricsFederator(registry.info.url)
+    recorder = obs.get_recorder()
+    if recorder is not None:
+        recorder.federator = federator
     controller = FleetController(
-        sup, registry_url=registry.info.url,
+        sup, registry_url=registry.info.url, federator=federator,
         min_replicas=min_replicas, max_replicas=max_replicas,
         scale_up_inflight=1.5, scale_down_inflight=0.5,
         scale_up_shed_rate=1.0, cooldown_s=1.0,
@@ -325,9 +332,23 @@ def run_campaign(args):
         registry.stop()
 
     # -- fold ----------------------------------------------------------------
-    events = obs.replay(event_log_path())
+    # federate the per-process segments (router/controller in the driver
+    # log, each replica in events.jsonl@replica-<i>) into one merged
+    # fleet log — the file CI's check_eventlog validates
+    merged_path = os.path.join(args.out, "fleet-events.jsonl")
+    merged_count = obs.write_merged(event_log_path(), merged_path)
+    events = obs.merge(event_log_path())
+    segments = obs.collect(event_log_path())
+    print(f"fleet log: {merged_count} events from "
+          f"{len(segments)} processes -> {merged_path}")
     targets = SLOTargets()
     report = SLOReport.fold(None, events=events, targets=targets)
+    if not report.ok():
+        obs.maybe_record("slo_budget", detail=(
+            f"campaign SLO missed: apply p50 {report.apply_p50_ms:.2f}ms "
+            f"p99 {report.apply_p99_ms:.2f}ms, error budget "
+            f"{report.error_budget_consumed:.1%}"
+        ))
     phases = clients.phase_stats()
     non_shed_5xx = sum(s["errors_5xx"] for s in phases.values())
     transport = sum(s["transport"] for s in phases.values())
@@ -344,6 +365,41 @@ def run_campaign(args):
     )
     fleet_events = [e for e in events if type(e).__name__ == "FleetScaled"]
     routed = [e for e in events if type(e).__name__ == "RequestRouted"]
+
+    # trace continuity over the merged log: every successfully served
+    # routed request's trace id must resolve to spans from BOTH sides of
+    # the wire — the router's root/hop spans and the replica's serving
+    # spans, distinct processes under one trace id
+    spans_by_trace = {}
+    for e in events:
+        if type(e).__name__ == "SpanRecorded":
+            spans_by_trace.setdefault(e.trace_id, []).append(e)
+
+    def _chain_ok(trace_id):
+        spans = spans_by_trace.get(trace_id, [])
+        names = {s.name for s in spans}
+        procs = {getattr(s, "process", "") for s in spans}
+        return (
+            "router.request" in names
+            and "serving.request" in names
+            and len(procs) >= 2
+        )
+
+    served_routed = [e for e in routed if e.status == 200 and e.trace_id]
+    broken = [e.trace_id for e in served_routed if not _chain_ok(e.trace_id)]
+    checks["trace_continuity"] = bool(served_routed) and not broken
+    if broken:
+        print(f"trace continuity broken for {len(broken)} of "
+              f"{len(served_routed)} traces (e.g. {broken[:3]})")
+
+    incident_dir = os.environ.get("MMLSPARK_TPU_INCIDENT_DIR", "")
+    bundles = sorted(
+        d for d in (os.listdir(incident_dir) if os.path.isdir(incident_dir)
+                    else [])
+        if not d.startswith(".")
+    )
+    checks["incident_recorded"] = bool(bundles)
+    print(f"incidents: {len(bundles)} bundle(s) in {incident_dir}")
 
     checks["zero_non_shed_5xx"] = non_shed_5xx == 0 and transport == 0
     checks["steady_p99_within_target"] = steady_p99_ms <= p99_target_ms
@@ -368,6 +424,10 @@ def run_campaign(args):
              "reason": e.reason} for e in fleet_events
         ],
         "requests_routed": len(routed),
+        "merged_events": merged_count,
+        "processes": sorted(segments),
+        "traces_served": len(served_routed),
+        "incident_bundles": bundles,
         "kill_windows_s": [round(b - a, 2) for a, b in kill_windows],
         "phases": phases,
         "checks": checks,
@@ -451,10 +511,20 @@ def main(argv=None):
     args = parser.parse_args(argv)
     os.makedirs(args.out, exist_ok=True)
     # shared across the router, the controller, and every replica process;
-    # truncate so a re-run into the same --out folds only its own campaign
+    # truncate so a re-run into the same --out folds only its own campaign.
+    # Each replica writes its own events.jsonl@replica-<i> segment; clear
+    # stale ones too or the merge would federate a previous run's ghosts.
     log = os.path.abspath(os.path.join(args.out, "events.jsonl"))
     open(log, "w").close()
+    import glob
+
+    for stale in glob.glob(glob.escape(log) + "@*"):
+        os.unlink(stale)
     os.environ["MMLSPARK_TPU_EVENT_LOG"] = log
+    os.environ.setdefault(
+        "MMLSPARK_TPU_INCIDENT_DIR",
+        os.path.abspath(os.path.join(args.out, "incidents")),
+    )
     return run_campaign(args)
 
 
