@@ -157,6 +157,65 @@ class ShardedDataset:
             return df.to_numpy(np.float64), y, w
         raise ValueError(f"unsupported shard format: {path}")
 
+    @staticmethod
+    def load_rows(
+        path: str, lo: int, hi: int
+    ) -> Tuple[np.ndarray, Optional[np.ndarray], Optional[np.ndarray]]:
+        """Decode only rows ``[lo, hi)`` of a shard — the memory-bounded
+        load. ``.npy`` slices a read-only memmap (only the touched pages
+        become resident); ``.npz`` seeks within the zip member past the
+        skipped rows and reads exactly the requested range (``np.savez``
+        stores members uncompressed, so the seek is a file seek, not a
+        decompress-and-discard); parquet has no streamable row access and
+        falls back to a full decode plus slice."""
+        _verify_shard(path)
+        lo, hi = int(lo), int(hi)
+        if path.endswith(".npy"):
+            mm = np.load(path, mmap_mode="r")
+            return np.asarray(mm[lo:hi], dtype=np.float64), None, None
+        if path.endswith(".npz"):
+            import zipfile
+
+            def member_rows(z, name):
+                with z.open(name) as fh:
+                    version = np.lib.format.read_magic(fh)
+                    if version == (1, 0):
+                        shape, fortran, dtype = \
+                            np.lib.format.read_array_header_1_0(fh)
+                    else:
+                        shape, fortran, dtype = \
+                            np.lib.format.read_array_header_2_0(fh)
+                    if fortran:
+                        # column-major rows aren't contiguous in the
+                        # stream; decode the member, then slice
+                        data = np.frombuffer(fh.read(), dtype=dtype)
+                        return data.reshape(shape, order="F")[lo:hi] \
+                            .astype(np.float64)
+                    count = hi - lo
+                    row_elems = 1
+                    for d in shape[1:]:
+                        row_elems *= int(d)
+                    rowbytes = row_elems * dtype.itemsize
+                    fh.seek(lo * rowbytes, 1)
+                    buf = fh.read(count * rowbytes)
+                    arr = np.frombuffer(buf, dtype=dtype).reshape(
+                        (count,) + tuple(shape[1:])
+                    )
+                    return arr.astype(np.float64)
+
+            with zipfile.ZipFile(path) as z:
+                names = set(z.namelist())
+                X = member_rows(z, "X.npy")
+                y = member_rows(z, "y.npy") if "y.npy" in names else None
+                w = member_rows(z, "w.npy") if "w.npy" in names else None
+            return X, y, w
+        X, y, w = ShardedDataset._load(path)
+        return (
+            X[lo:hi],
+            y[lo:hi] if y is not None else None,
+            w[lo:hi] if w is not None else None,
+        )
+
     def iter_shards(self) -> Iterator[Tuple[np.ndarray, Optional[np.ndarray], Optional[np.ndarray]]]:
         for p in self.paths:
             yield self._load(p)
@@ -247,6 +306,7 @@ class ShardedDataset:
         out_path: Optional[str] = None,
         policy=None,
         metrics=None,
+        rows_per_task: Optional[int] = None,
     ) -> Tuple[np.memmap, np.ndarray, Optional[np.ndarray]]:
         """Stream every shard through ``apply_bins`` into an on-disk uint8
         matrix. Returns (bins memmap (N, F) uint8, y (N,), w or None) —
@@ -257,8 +317,14 @@ class ShardedDataset:
         the fault-tolerant scheduler: shards bin concurrently into their
         disjoint memmap slices, a dead executor's shard is retried, and the
         shard file itself is the lineage source (a lost partition re-reads
-        from disk). Output is bit-identical to the sequential pass — every
-        task writes only its own row range."""
+        from disk). Tasks decode only their own row range
+        (:meth:`load_rows`), so worker RSS is bounded by the task's rows,
+        not the shard file. ``rows_per_task`` caps rows per task
+        explicitly; when None, whole-shard tasks are used unless the
+        resource watchdog reports ambient memory pressure, in which case
+        shards auto-split (halved ranges at WARN, quartered at CRITICAL).
+        Output is bit-identical to the sequential pass — every task writes
+        only its own row range."""
         self._scan()
         n, f = self.num_rows, self.num_features
         # fail fast on unlabeled data — BEFORE the (potentially hours-long)
@@ -287,19 +353,40 @@ class ShardedDataset:
                 lo = hi
         else:
             offsets = np.cumsum([0] + [i.num_rows for i in self._infos])
-            lineage = runtime.Lineage()
-            shards = [
-                lineage.record(
-                    si,
-                    (lambda si=si, p=path: (si,) + self._load(p)),
-                    describe=path,
+            split = rows_per_task
+            if split is None:
+                # first consumer of the resource watchdog's host-memory
+                # signal: under ambient pressure, cap the rows a single
+                # task may decode so worker RSS shrinks with the level
+                from mmlspark_tpu.runtime.pressure import (
+                    PressureLevel, current_pressure_level,
                 )
-                for si, path in enumerate(self.paths)
+
+                level = current_pressure_level("memory")
+                if level >= PressureLevel.WARN:
+                    biggest = max(i.num_rows for i in self._infos)
+                    div = 4 if level >= PressureLevel.CRITICAL else 2
+                    split = max(1, -(-biggest // div))
+            parts = []  # (shard index, row lo, row hi) within the shard
+            for si, info in enumerate(self._infos):
+                step = split if split is not None else max(info.num_rows, 1)
+                for plo in range(0, info.num_rows, step):
+                    parts.append((si, plo, min(plo + step, info.num_rows)))
+            lineage = runtime.Lineage()
+            tasks = [
+                lineage.record(
+                    pi,
+                    (lambda si=si, plo=plo, phi=phi, p=self.paths[si]:
+                        (si, plo, phi) + self.load_rows(p, plo, phi)),
+                    describe=f"{self.paths[si]}[{plo}:{phi}]",
+                )
+                for pi, (si, plo, phi) in enumerate(parts)
             ]
 
-            def bin_shard(payload):
-                si, X, y, w = payload
-                lo, hi = int(offsets[si]), int(offsets[si + 1])
+            def bin_part(payload):
+                si, plo, phi, X, y, w = payload
+                lo = int(offsets[si]) + int(plo)
+                hi = int(offsets[si]) + int(phi)
                 bins[lo:hi] = apply_bins(X, mapper)
                 y_all[lo:hi] = y
                 if have_w:
@@ -307,7 +394,7 @@ class ShardedDataset:
                 return hi - lo
 
             runtime.run_partitioned(
-                bin_shard, shards, pol, lineage=lineage, metrics=metrics
+                bin_part, tasks, pol, lineage=lineage, metrics=metrics
             )
         bins.flush()
         return bins, y_all, w_all

@@ -1654,6 +1654,7 @@ def train(
     import os as _os
 
     u_spec = None
+    u_budget = 0  # the in-force U HBM budget; the OOM ladder halves it
     if (
         mesh is None
         and opts.tree_learner != "voting_parallel"
@@ -1701,6 +1702,7 @@ def train(
             # whole fit fell back to the compare-built kernels).
             cand = chunked_u_spec(n + pad, cand, budget)
         u_spec = cand
+        u_budget = budget
         if u_spec.chunk_rows:
             chunks = num_u_chunks(n + pad, u_spec)
             from mmlspark_tpu.core.profiling import get_logger
@@ -1818,6 +1820,96 @@ def train(
         ("valid_update", opts.routing_steps, bundle),
         lambda: _make_valid_update(opts.routing_steps, bundle),
     )
+
+    # -- RESOURCE_EXHAUSTED degradation ladder (docs/resilience.md) ----------
+    # An HBM OOM during a histogram dispatch is retryable at a reduced
+    # footprint: halve the in-memory U budget (floor 1 MiB), re-derive the
+    # chunked-U spec, rebuild the step program, and re-run the SAME
+    # iteration. Chunked and resident passes are bit-exact, so the final
+    # model text matches an undisturbed run byte for byte. The last rung —
+    # a smaller ``leaf_batch`` — changes split-scheduling and is left to
+    # the caller (it trades reproducibility for survival).
+    from mmlspark_tpu.runtime.faults import (
+        current_faults as _current_faults,
+        is_oom_error as _is_oom,
+    )
+
+    _fault_plan = _current_faults()
+    _oom_retry_cap = 8
+
+    def _degrade_for_oom(err, stage, iteration, retries) -> bool:
+        """Walk one rung down the ladder; True when the caller may retry."""
+        nonlocal u_spec, u_budget, okey, step_raw, step, u_builder
+        if u_spec is None:
+            return False  # no U path active: nothing to shrink in-loop
+        new_budget = max(u_budget // 2, 1 << 20)
+        if new_budget == u_budget and u_spec.chunk_rows:
+            return False  # floor reached; the OOM is genuine scarcity
+        u_budget = new_budget
+        from mmlspark_tpu.ops.u_histogram import (
+            build_u,
+            chunked_u_spec,
+            prepare_chunked_bins,
+        )
+
+        u_spec = chunked_u_spec(
+            n + pad, dataclasses.replace(u_spec, chunk_rows=0), u_budget
+        )
+        okey = (
+            _opts_key(opts), num_bins, mesh, u_spec, bundle,
+            objective.cache_token,
+        )
+        if opts.boosting_type == "goss":
+            okey = okey + (n,)
+        if hist_reduce is not None:
+            step_raw = _make_step(
+                opts, objective, num_bins, mesh, n_real=n, u_spec=u_spec,
+                hist_reduce=hist_reduce, bundle=bundle,
+            )
+            step = jax.jit(step_raw, donate_argnums=(3,))
+        else:
+            step_raw = _cached_program(
+                ("step_raw", okey),
+                lambda: _make_step(
+                    opts, objective, num_bins, mesh, n_real=n, u_spec=u_spec,
+                    bundle=bundle,
+                ),
+            )
+            step = _cached_program(
+                ("step_jit", okey),
+                lambda: jax.jit(step_raw, donate_argnums=(3,)),
+            )
+        u_builder = (
+            partial(prepare_chunked_bins, spec=u_spec) if u_spec.chunk_rows
+            else partial(build_u, spec=u_spec)
+        )
+        from mmlspark_tpu.core.profiling import get_logger
+
+        get_logger("mmlspark_tpu.lightgbm").warning(
+            "histogram %s dispatch hit RESOURCE_EXHAUSTED at iteration %d "
+            "(%s); degrading: U budget -> %d bytes, chunk_rows -> %d, "
+            "retry %d",
+            stage, iteration, str(err)[:120], u_budget, u_spec.chunk_rows,
+            retries,
+        )
+        from mmlspark_tpu.observability.events import (
+            HistogramDegraded,
+            MemoryPressure,
+            get_bus,
+        )
+
+        bus = get_bus()
+        if bus.active:
+            bus.publish(MemoryPressure(
+                source="device", level="critical", used_bytes=0.0,
+                limit_bytes=0.0, detail=str(err)[:200],
+            ))
+            bus.publish(HistogramDegraded(
+                rows=n + pad, budget_bytes=u_budget,
+                chunk_rows=u_spec.chunk_rows, stage=stage,
+                iteration=int(iteration), retries=int(retries),
+            ))
+        return True
 
     valid_sets = list(valid_sets or [])
     valid_state = []
@@ -1960,36 +2052,72 @@ def train(
         parts = []
         for s0 in range(0, opts.num_iterations, seg):
             s1 = min(s0 + seg, opts.num_iterations)
-            # profiling forces a per-segment sync (an honest device window
-            # needs block_until_ready); the unprofiled fit keeps the async
-            # dispatch pipeline.
-            t_seg = time.perf_counter() if _prof_on else 0.0
-            cache_before = (
-                runner._cache_size() if _prof_on
-                and hasattr(runner, "_cache_size") else None
-            )
-            margins, part = runner(
-                bins_dev, y_dev, w_dev, margins, edges_dev,
-                bag_arg[s0:s1] if bag_resampling else bag_arg,
-                fm_all[s0:s1],
-                lr_arg[s0:s1] if per_iter_lr else lr_arg,
-                jnp.int32(s0),
-                u_dev_scan,
-            )
+            # margins is donated into the runner; a degraded retry of
+            # this segment needs the pre-dispatch value back, so keep a
+            # host snapshot (segments are rare — usually one per fit)
+            margins_before = np.asarray(margins)
+            oom_retries = 0
+            while True:
+                try:
+                    # injected OOM fires pre-dispatch (margins not donated
+                    # yet), so the degraded retry re-dispatches cleanly
+                    if _fault_plan is not None:
+                        _fault_plan.apply_on_histogram(s0, oom_retries)
+                    # profiling forces a per-segment sync (an honest device
+                    # window needs block_until_ready); the unprofiled fit
+                    # keeps the async dispatch pipeline.
+                    t_seg = time.perf_counter() if _prof_on else 0.0
+                    cache_before = (
+                        runner._cache_size() if _prof_on
+                        and hasattr(runner, "_cache_size") else None
+                    )
+                    margins, part = runner(
+                        bins_dev, y_dev, w_dev, margins, edges_dev,
+                        bag_arg[s0:s1] if bag_resampling else bag_arg,
+                        fm_all[s0:s1],
+                        lr_arg[s0:s1] if per_iter_lr else lr_arg,
+                        jnp.int32(s0),
+                        u_dev_scan,
+                    )
+                    if _prof_on:
+                        jax.block_until_ready((margins, part))
+                        dt = time.perf_counter() - t_seg
+                        compiled = (
+                            cache_before is not None
+                            and hasattr(runner, "_cache_size")
+                            and runner._cache_size() > cache_before
+                        )
+                        if compiled:
+                            _prof.note_compile("gbdt.scan", dt)
+                        else:
+                            _prof.note_cache_hit("gbdt.scan")
+                        _prof.note_execute("gbdt.scan", dt)
+                    break
+                except Exception as e:  # noqa: BLE001 - OOM-classified below
+                    if (
+                        not _is_oom(e)
+                        or oom_retries >= _oom_retry_cap
+                        or not _degrade_for_oom(e, "scan", s0, oom_retries + 1)
+                    ):
+                        raise
+                    oom_retries += 1
+                    # recreate the donated margins buffer and rebuild the
+                    # scan program + fit-resident U under the new spec
+                    margins = jnp.asarray(margins_before)
+                    runner = _cached_program(
+                        ("scan", okey, bag_resampling, per_iter_lr),
+                        lambda: _make_scan_steps(
+                            step_raw, per_iter_bag=bag_resampling,
+                            per_iter_lr=per_iter_lr,
+                            with_u=u_builder is not None,
+                        ),
+                    )
+                    if u_builder is not None:
+                        u_jit = _cached_program(
+                            ("u_build_jit", u_spec), lambda: jax.jit(u_builder)
+                        )
+                        u_dev_scan = u_jit(bins_dev)
             parts.append(part)
-            if _prof_on:
-                jax.block_until_ready((margins, part))
-                dt = time.perf_counter() - t_seg
-                compiled = (
-                    cache_before is not None
-                    and hasattr(runner, "_cache_size")
-                    and runner._cache_size() > cache_before
-                )
-                if compiled:
-                    _prof.note_compile("gbdt.scan", dt)
-                else:
-                    _prof.note_cache_hit("gbdt.scan")
-                _prof.note_execute("gbdt.scan", dt)
         stacked_trees = (
             parts[0]
             if len(parts) == 1
@@ -2057,15 +2185,39 @@ def train(
             else:
                 margins_in = margins
 
-            t_step = time.perf_counter() if _prof_on else 0.0
-            step_cache_before = (
-                step._cache_size() if _prof_on
-                and hasattr(step, "_cache_size") else None
-            )
-            tree, new_margins = step(
-                bins_dev, y_dev, w_dev, margins_in, edges_dev, bag_dev, fm_dev,
-                jnp.int32(it), lr_it, u=u_dev,
-            )
+            # Injected OOM faults fire here, BEFORE dispatch, so margins_in
+            # has not been donated when the degraded retry re-dispatches.
+            # A real device OOM surfaces after donation; the retry is then
+            # best-effort (the allocator usually fails before consuming the
+            # donated buffer, but that is not contractual).
+            oom_retries = 0
+            while True:
+                try:
+                    if _fault_plan is not None:
+                        _fault_plan.apply_on_histogram(it, oom_retries)
+                    t_step = time.perf_counter() if _prof_on else 0.0
+                    step_cache_before = (
+                        step._cache_size() if _prof_on
+                        and hasattr(step, "_cache_size") else None
+                    )
+                    tree, new_margins = step(
+                        bins_dev, y_dev, w_dev, margins_in, edges_dev,
+                        bag_dev, fm_dev, jnp.int32(it), lr_it, u=u_dev,
+                    )
+                    break
+                except Exception as e:  # noqa: BLE001 - OOM-classified below
+                    if (
+                        not _is_oom(e)
+                        or oom_retries >= _oom_retry_cap
+                        or not _degrade_for_oom(e, "loop", it, oom_retries + 1)
+                    ):
+                        raise
+                    oom_retries += 1
+                    if u_builder is not None:
+                        u_jit = _cached_program(
+                            ("u_build_jit", u_spec), lambda: jax.jit(u_builder)
+                        )
+                        u_dev = u_jit(bins_dev)
 
             if dropped:
                 k = len(dropped)

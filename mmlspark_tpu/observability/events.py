@@ -360,6 +360,24 @@ class HistogramChunked(Event):
 
 
 @_event
+class HistogramDegraded(Event):
+    """A GBDT histogram launch hit ``RESOURCE_EXHAUSTED`` and the train
+    loop stepped down the degradation ladder (halve the U budget ->
+    chunked-U -> smaller leaf batch) before retrying the SAME iteration
+    (``lightgbm/train.py``). ``stage`` is the dispatch path ("scan" or
+    "loop"), ``retries`` the OOM retry count at this iteration, and the
+    model text stays byte-identical to an undisturbed run."""
+
+    rows: int
+    budget_bytes: int
+    chunk_rows: int
+    stage: str
+    iteration: int = 0
+    retries: int = 1
+    detail: str = ""
+
+
+@_event
 class FeatureBundled(Event):
     """Exclusive Feature Bundling fitted at binning time
     (``lightgbm/bundling.py``): ``k_before``/``k_after`` are Σ per-feature
@@ -416,6 +434,47 @@ class IncidentRecorded(Event):
     events: int = 0
     trace_id: str = ""
     detail: str = ""
+
+
+@_event
+class IncidentSkipped(Event):
+    """The flight recorder hit a failure (ENOSPC, permissions) while
+    dumping a bundle and dropped it instead of raising mid-incident —
+    the observability plane must never make an outage worse."""
+
+    trigger: str
+    reason: str
+    incident_id: str = ""
+
+
+# -- resource pressure -------------------------------------------------------
+
+
+@_event
+class MemoryPressure(Event):
+    """The resource watchdog (or an in-loop OOM catch) observed memory
+    pressure: ``source`` is "hbm:<device>", "host", or "device" (an
+    in-loop RESOURCE_EXHAUSTED); ``level`` is "warn"/"critical" at onset
+    and "ok" on recovery, so every onset pairs with either a degradation
+    event or a later "ok" record (``check_eventlog.py --pressure``)."""
+
+    source: str
+    level: str
+    used_bytes: float
+    limit_bytes: float
+    detail: str = ""
+
+
+@_event
+class DiskPressure(Event):
+    """Free space on a durable volume (checkpoint dir, event-log dir)
+    crossed a watchdog threshold; ``level`` is "warn"/"critical" at
+    onset and "ok" on recovery."""
+
+    path: str
+    level: str
+    free_bytes: float
+    total_bytes: float
 
 
 # -- resilience --------------------------------------------------------------
@@ -546,6 +605,10 @@ class EventLogSink:
         self._seq = max(existing) + 1 if existing else 1
         self._fh: Optional[IO[str]] = open(path, "a", encoding="utf-8")
         self._size = self._fh.tell()
+        #: ENOSPC posture: failed writes are counted and dropped, never
+        #: raised — losing event records must not fail the workload
+        self.write_errors = 0
+        self._warned_write_error = False
 
     def __call__(self, event: Event) -> None:
         rec = event.to_record()
@@ -555,18 +618,43 @@ class EventLogSink:
         with self._lock:
             if self._fh is None:
                 return
-            # rotate BEFORE the write so a segment never exceeds the
-            # bound; an empty live file always accepts (one oversized
-            # event must not rotate forever)
-            if (
-                self.max_bytes
-                and self._size
-                and self._size + len(line) > self.max_bytes
-            ):
-                self._rotate()
-            self._fh.write(line)
-            self._fh.flush()
-            self._size += len(line)
+            try:
+                from mmlspark_tpu.runtime.faults import check_write
+
+                check_write(self.path)
+                # rotate BEFORE the write so a segment never exceeds the
+                # bound; an empty live file always accepts (one oversized
+                # event must not rotate forever)
+                if (
+                    self.max_bytes
+                    and self._size
+                    and self._size + len(line) > self.max_bytes
+                ):
+                    self._rotate()
+                self._fh.write(line)
+                self._fh.flush()
+                self._size += len(line)
+            except OSError as e:
+                self.write_errors += 1
+                self._count_write_error()
+                if not self._warned_write_error:
+                    self._warned_write_error = True
+                    logger.warning(
+                        "event log %s write failed (%s); dropping records "
+                        "(counted in eventlog_write_errors_total)",
+                        self.path, e,
+                    )
+
+    def _count_write_error(self) -> None:
+        try:
+            from mmlspark_tpu.observability.registry import get_registry
+
+            get_registry().counter(
+                "eventlog_write_errors_total",
+                "Event-log records dropped because the write/rotation failed",
+            ).inc()
+        except Exception:  # noqa: BLE001 - metrics must not break the drop path
+            pass
 
     def _rotate(self) -> None:
         """Close the live file and shelve it as the next numbered
@@ -818,6 +906,9 @@ def timeline(events: Iterable[Event]) -> Dict[str, Any]:
     #: per-function compile/execute fold from Profile* events
     profiler: Dict[str, Dict[str, Any]] = {}
     incidents: List[Dict[str, Any]] = []
+    incidents_skipped = 0
+    pressure: List[Dict[str, Any]] = []
+    degradations: List[Dict[str, Any]] = []
     #: events per federation process label ("" = untagged single-process log)
     by_process: Dict[str, int] = {}
     for ev in events:
@@ -904,6 +995,24 @@ def timeline(events: Iterable[Event]) -> Dict[str, Any]:
                 "incident_id": ev.incident_id, "trigger": ev.trigger,
                 "path": ev.path, "trace_id": ev.trace_id,
             })
+        elif isinstance(ev, IncidentSkipped):
+            incidents_skipped += 1
+        elif isinstance(ev, MemoryPressure):
+            pressure.append({
+                "kind": "memory", "source": ev.source, "level": ev.level,
+                "t": ev.t,
+            })
+        elif isinstance(ev, DiskPressure):
+            pressure.append({
+                "kind": "disk", "source": ev.path, "level": ev.level,
+                "t": ev.t,
+            })
+        elif isinstance(ev, HistogramDegraded):
+            degradations.append({
+                "iteration": ev.iteration, "stage": ev.stage,
+                "budget_bytes": ev.budget_bytes, "chunk_rows": ev.chunk_rows,
+                "retries": ev.retries,
+            })
         elif isinstance(ev, (ProfileCompiled, ProfileExecuted)):
             rec = profiler.setdefault(ev.name, {
                 "compiles": 0, "compile_seconds": 0.0,
@@ -945,6 +1054,9 @@ def timeline(events: Iterable[Event]) -> Dict[str, Any]:
         "processes": dict(processes, loss_reasons=loss_reasons),
         "profiler": profiler,
         "incidents": incidents,
+        "incidents_skipped": incidents_skipped,
+        "pressure": pressure,
+        "degradations": degradations,
         "by_process": by_process,
     }
 
@@ -1039,7 +1151,30 @@ def format_timeline(summary: Dict[str, Any]) -> str:
     if incidents:
         lines.append("== incidents == " + ", ".join(
             f"{i['trigger']} ({i['incident_id']})" for i in incidents
+        ) + (
+            f" skipped={summary['incidents_skipped']}"
+            if summary.get("incidents_skipped") else ""
         ))
+    pressure = summary.get("pressure") or []
+    degradations = summary.get("degradations") or []
+    if pressure or degradations:
+        onsets = [p for p in pressure if p["level"] != "ok"]
+        recoveries = [p for p in pressure if p["level"] == "ok"]
+        line = (
+            f"== pressure == onsets={len(onsets)} "
+            f"recoveries={len(recoveries)} degradations={len(degradations)}"
+        )
+        if onsets:
+            line += " (" + ", ".join(
+                f"{p['kind']}:{p['source']} {p['level']}" for p in onsets
+            ) + ")"
+        lines.append(line)
+        for d in degradations:
+            lines.append(
+                f"   iter {d['iteration']} [{d['stage']}] -> "
+                f"budget={d['budget_bytes']} chunk_rows={d['chunk_rows']} "
+                f"retry {d['retries']}"
+            )
     by_process = summary.get("by_process") or {}
     if by_process:
         lines.append("== fleet log == " + ", ".join(

@@ -49,6 +49,7 @@ from mmlspark_tpu.observability.events import (
     BreakerTripped,
     Event,
     IncidentRecorded,
+    IncidentSkipped,
     WorkerQuarantined,
 )
 
@@ -115,7 +116,7 @@ class FlightRecorder:
         _events.get_bus().remove_listener(self._on_event)
 
     def _on_event(self, event: Event) -> None:
-        if isinstance(event, IncidentRecorded):
+        if isinstance(event, (IncidentRecorded, IncidentSkipped)):
             return  # our own bookkeeping must not re-trip the recorder
         with self._lock:
             self._ring.append(event)
@@ -152,6 +153,11 @@ class FlightRecorder:
             path = self._write_bundle(incident_id, trigger, trace_id, detail, now)
         except Exception as e:  # noqa: BLE001 - see docstring
             logger.warning("incident bundle %s failed: %s", incident_id, e)
+            _events.get_bus().publish(IncidentSkipped(
+                trigger=trigger,
+                reason=str(e)[:200],
+                incident_id=incident_id,
+            ))
             return None
         self.recorded.append(path)
         _events.get_bus().publish(IncidentRecorded(
@@ -217,8 +223,13 @@ class FlightRecorder:
         detail: str,
         now: float,
     ) -> str:
+        from mmlspark_tpu.runtime.faults import check_write
+
         records = self._recent_records()
         final = os.path.join(self.directory, incident_id)
+        # injected-ENOSPC gate: a full incident volume skips the bundle
+        # (record() books IncidentSkipped) instead of crashing the caller
+        check_write(final)
         tmp = os.path.join(self.directory, f".tmp-{incident_id}-{os.getpid()}")
         os.makedirs(tmp, exist_ok=True)
         try:

@@ -16,6 +16,13 @@ Sheds are counted (``serving_shed_total``), the in-flight depth is a live
 gauge, and each shed publishes
 :class:`~mmlspark_tpu.observability.events.RequestShed` when the bus has
 listeners.
+
+Under ambient memory pressure (the resource watchdog's process-wide
+:class:`~mmlspark_tpu.runtime.pressure.PressureLevel`) the effective
+bound tightens — half of ``max_pending`` at WARN, a quarter at
+CRITICAL — so the serving edge sheds *before* the allocator OOMs, and
+restores the full bound the moment the level clears (docs/resilience.md
+"Resource pressure").
 """
 
 from __future__ import annotations
@@ -57,12 +64,31 @@ class AdmissionController:
         with self._lock:
             return self._inflight
 
+    def effective_max_pending(self) -> int:
+        """The in-flight bound after the ambient memory-pressure level is
+        applied: ``max_pending`` at OK, half at WARN, a quarter (floor 1)
+        at CRITICAL. Restoration is automatic — the next request after
+        the level clears sees the full bound again."""
+        from mmlspark_tpu.runtime.pressure import (
+            PressureLevel, current_pressure_level,
+        )
+
+        level = current_pressure_level("memory")
+        if level >= PressureLevel.CRITICAL:
+            return max(1, self.max_pending // 4)
+        if level >= PressureLevel.WARN:
+            return max(1, self.max_pending // 2)
+        return self.max_pending
+
     def try_acquire(self) -> bool:
-        """Admit one request, or shed it (False) when ``max_pending``
-        requests are already in flight. A shed is counted and published;
-        the caller answers 429 with ``Retry-After: retry_after_s``."""
+        """Admit one request, or shed it (False) when the effective bound
+        is reached. A shed is counted and published; the caller answers
+        429 with ``Retry-After: retry_after_s``. The shed reason is
+        ``"memory_pressure"`` when the request would have been admitted
+        under the unpressured bound."""
+        bound = self.effective_max_pending()
         with self._lock:
-            if self._inflight >= self.max_pending:
+            if self._inflight >= bound:
                 depth = self._inflight
                 admitted = False
             else:
@@ -78,7 +104,10 @@ class AdmissionController:
         bus = get_bus()
         if bus.active:
             bus.publish(RequestShed(
-                reason="max_pending",
+                reason=(
+                    "memory_pressure" if depth < self.max_pending
+                    else "max_pending"
+                ),
                 queue_depth=depth,
                 retry_after=self.retry_after_s,
             ))

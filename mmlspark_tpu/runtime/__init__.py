@@ -23,9 +23,13 @@ deterministic enough to test fault recovery bit-for-bit:
   from its recorded source instead of failing the job;
 - :mod:`~mmlspark_tpu.runtime.faults`    — seeded fault injection for
   chaos tests: task-plane (kill-task, delay-task, slow-task stragglers,
-  corrupt-result, drop-heartbeat) and HTTP-plane (503 storms, latency
+  corrupt-result, drop-heartbeat), HTTP-plane (503 storms, latency
   spikes, connection resets — consumed by the ``mmlspark_tpu.resilience``
-  layer's clients);
+  layer's clients), and exhaustion-plane (``oom_task`` host/device OOM,
+  ``disk_full`` ENOSPC on guarded writes);
+- :mod:`~mmlspark_tpu.runtime.pressure`  — the resource watchdog: HBM /
+  host-RSS / disk gauges, a process-wide :class:`PressureLevel`, and
+  ``MemoryPressure``/``DiskPressure`` events on threshold transitions;
 - :mod:`~mmlspark_tpu.runtime.metrics`   — per-task timings, retry
   counts, queue depth via ``core/profiling.py`` conventions.
 
@@ -49,10 +53,13 @@ Quick start::
 
 from mmlspark_tpu.runtime.executor import ExecutorPool
 from mmlspark_tpu.runtime.faults import (
+    DeviceOomError,
     ExecutorDeathError,
     FaultPlan,
+    check_write,
     current_faults,
     inject_faults,
+    is_oom_error,
 )
 from mmlspark_tpu.runtime.health import HealthTracker
 from mmlspark_tpu.runtime.journal import (
@@ -64,6 +71,14 @@ from mmlspark_tpu.runtime.journal import (
 )
 from mmlspark_tpu.runtime.lineage import Lineage, PartitionLostError, ShardLineage
 from mmlspark_tpu.runtime.metrics import RuntimeMetrics
+from mmlspark_tpu.runtime.pressure import (
+    PressureLevel,
+    ResourceWatchdog,
+    current_pressure_level,
+    get_watchdog,
+    reduced_footprint,
+    set_pressure_level,
+)
 from mmlspark_tpu.runtime.procgroup import (
     AllreduceGroup,
     ExitStatus,
@@ -94,6 +109,7 @@ __all__ = [
     "AllreduceGroup",
     "AttemptInfo",
     "CHECKPOINT_DIR_ENV",
+    "DeviceOomError",
     "ExecutorDeathError",
     "ExecutorPool",
     "ExitStatus",
@@ -106,7 +122,9 @@ __all__ = [
     "Lineage",
     "ModelStore",
     "PartitionLostError",
+    "PressureLevel",
     "ProcessGroup",
+    "ResourceWatchdog",
     "ResultCorruptedError",
     "RuntimeMetrics",
     "Scheduler",
@@ -115,14 +133,20 @@ __all__ = [
     "TaskLostError",
     "TaskState",
     "WorkerContext",
+    "check_write",
     "current_faults",
     "current_policy",
+    "current_pressure_level",
     "default_checkpoint_dir",
+    "get_watchdog",
     "inject_faults",
+    "is_oom_error",
     "pick_port",
     "policy",
+    "reduced_footprint",
     "result_crc",
     "run_partitioned",
+    "set_pressure_level",
     "scrub_env",
     "worker_main",
 ]

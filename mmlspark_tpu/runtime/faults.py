@@ -51,6 +51,24 @@ ambient plan before every wire call:
 - ``http_reset(n)``   — the next ``n`` matching requests raise
   ``ConnectionResetError`` (the mid-flight TCP reset).
 
+The exhaustion plane injects *resource* failures instead of crashes —
+the class of fault the pressure watchdog and degradation ladders
+(docs/resilience.md "Resource pressure") exist to absorb:
+
+- ``oom_task(n, kind)`` — attempt 0 of task ``n`` raises an
+  out-of-memory error at the task boundary: ``kind="host"`` raises
+  ``MemoryError`` (a gang worker blowing host RSS), ``kind="device"``
+  raises :class:`DeviceOomError` whose message carries
+  ``RESOURCE_EXHAUSTED`` exactly like an XLA allocator failure. Device
+  OOMs registered against a fit are consumed by the histogram dispatch
+  (:func:`FaultPlan.apply_on_histogram`) keyed by iteration, so the
+  GBDT degradation ladder is exercised at the real catch site;
+- ``disk_full(substr, n)`` — the next ``n`` guarded writes whose path
+  contains ``substr`` raise ``OSError(ENOSPC)``. Every durable writer
+  (FitJournal, ModelStore, streaming WAL/commit, EventLogSink,
+  FlightRecorder) consults :func:`check_write` first, so the injection
+  lands at the exact byte-never-written point of each plane.
+
 Each registered fault fires at most once; ``plan.fired`` records what
 actually triggered, so tests assert the fault happened AND was survived.
 ``kill_random_task`` draws its victim from the plan's seeded RNG — the
@@ -60,10 +78,11 @@ actually triggered, so tests assert the fault happened AND was survived.
 from __future__ import annotations
 
 import contextlib
+import errno
 import os
 import threading
 import time
-from typing import Iterator, List, Optional, Tuple
+from typing import Dict, Iterator, List, Optional, Tuple
 
 import numpy as np
 
@@ -71,6 +90,13 @@ import numpy as np
 class ExecutorDeathError(RuntimeError):
     """Simulated executor death: the worker thread running the task exits
     (the scheduler retries the task on a surviving/replacement worker)."""
+
+
+class DeviceOomError(RuntimeError):
+    """Simulated accelerator out-of-memory. The message carries the
+    ``RESOURCE_EXHAUSTED`` marker XLA's allocator uses, so every catch
+    site that classifies by :func:`is_oom_error` treats an injected
+    device OOM exactly like the real ``XlaRuntimeError``."""
 
 
 class FaultPlan:
@@ -99,6 +125,11 @@ class FaultPlan:
         #: ordered HTTP fault directives, consumed first-match per request
         self._http: List[dict] = []
         self._http_seq = 0
+        #: (index, attempt) -> "host"|"device" out-of-memory directives
+        self._oom: Dict[Tuple[int, int], str] = {}
+        #: ordered disk-full directives, consumed first-match per write
+        self._disk_full: List[dict] = []
+        self._write_seq = 0
         self._lock = threading.Lock()
         #: [(kind, task_index, attempt)] in fire order
         self.fired: List[Tuple[str, int, int]] = []
@@ -269,6 +300,32 @@ class FaultPlan:
         })
         return self
 
+    def oom_task(
+        self, index: int, kind: str = "host", attempt: int = 0
+    ) -> "FaultPlan":
+        """Attempt ``attempt`` of task ``index`` exhausts memory at its
+        boundary: ``kind="host"`` raises ``MemoryError`` (host RSS blown),
+        ``kind="device"`` raises :class:`DeviceOomError` with a
+        ``RESOURCE_EXHAUSTED`` message (HBM allocation failure). Device
+        OOMs registered against a GBDT fit fire from the histogram
+        dispatch instead (``index`` = fit iteration), so the in-loop
+        degradation ladder is what absorbs them."""
+        if kind not in ("host", "device"):
+            raise ValueError(
+                f"unknown OOM kind {kind!r} (expected 'host' or 'device')"
+            )
+        self._oom[(int(index), int(attempt))] = str(kind)
+        return self
+
+    def disk_full(self, path_substr: str, count: int = 1) -> "FaultPlan":
+        """The next ``count`` guarded writes whose target path contains
+        ``path_substr`` raise ``OSError(ENOSPC)`` before any byte is
+        written — the volume under the journal/WAL/event log filling up.
+        Consumed by :func:`check_write`, which every durable writer calls
+        first, so the fault leaves no torn file behind."""
+        self._disk_full.append({"substr": str(path_substr), "n": int(count)})
+        return self
+
     def will_corrupt(self, index: int, attempt: int) -> bool:
         """True while a ``corrupt_result`` fault is registered for this
         (task, attempt) — the executor checks this to know it must take
@@ -284,6 +341,8 @@ class FaultPlan:
                 + len(self._slow) + len(self._corrupt)
                 + len(self._kill_process) + len(self._kill_stream)
                 + sum(d["n"] for d in self._http)
+                + len(self._oom)
+                + sum(d["n"] for d in self._disk_full)
             )
 
     # -- worker-side hook ----------------------------------------------------
@@ -303,6 +362,7 @@ class FaultPlan:
             slow = self._slow.pop(key, None)
             drop = self._drop_beat.pop(key, None)
             kill = self._kill.pop(key, None)
+            oom = self._oom.pop(key, None)
         if delay is not None:
             self.fired.append(("delay", index, attempt))
             time.sleep(delay)
@@ -332,6 +392,35 @@ class FaultPlan:
             raise ExecutorDeathError(
                 f"injected executor death on task {index} attempt {attempt}"
             )
+        if oom is not None:
+            self.fired.append((f"oom_{oom}", index, attempt))
+            if oom == "host":
+                raise MemoryError(
+                    f"injected host OOM on task {index} attempt {attempt}"
+                )
+            raise DeviceOomError(
+                "RESOURCE_EXHAUSTED: injected device OOM on task "
+                f"{index} attempt {attempt}"
+            )
+
+    def apply_on_histogram(self, iteration: int, attempt: int) -> None:
+        """Consulted by the GBDT histogram dispatch before each launch.
+        Pops a registered *device* OOM keyed (iteration, retry-attempt)
+        and raises it as :class:`DeviceOomError` — the train loop's
+        ``RESOURCE_EXHAUSTED`` catch then walks the degradation ladder
+        and retries the same iteration. Host OOMs are never fired here;
+        they belong to the task boundary."""
+        key = (int(iteration), int(attempt))
+        with self._lock:
+            kind = self._oom.get(key)
+            if kind != "device":
+                return
+            self._oom.pop(key)
+        self.fired.append(("oom_device", int(iteration), int(attempt)))
+        raise DeviceOomError(
+            "RESOURCE_EXHAUSTED: injected device OOM at histogram "
+            f"iteration {iteration} attempt {attempt}"
+        )
 
     def apply_on_result(self, index: int, attempt: int, result):
         """Consulted by the executor AFTER the task body returns and AFTER
@@ -373,6 +462,30 @@ class FaultPlan:
             directive["status"] if kind == "status" else 0,
         ))
         return directive
+
+    # -- write-side hook (consulted by durable writers per file) -------------
+
+    def apply_on_write(self, path: str) -> None:
+        """Pop the first registered ``disk_full`` directive matching
+        ``path`` and raise ``OSError(ENOSPC)`` — before the caller opens
+        the file, so the failed write is clean (no torn temp file).
+        Directives are consumed in registration order, one per write."""
+        with self._lock:
+            matched = None
+            for d in self._disk_full:
+                if d["n"] > 0 and d["substr"] in str(path):
+                    d["n"] -= 1
+                    matched = d
+                    break
+            if matched is None:
+                return
+            self._disk_full = [d for d in self._disk_full if d["n"] > 0]
+            seq = self._write_seq
+            self._write_seq += 1
+        self.fired.append(("disk_full", seq, 0))
+        raise OSError(
+            errno.ENOSPC, "No space left on device (injected)", str(path)
+        )
 
 
 class _TaintedResult:
@@ -421,3 +534,22 @@ def inject_faults(plan: FaultPlan) -> Iterator[FaultPlan]:
 
 def current_faults() -> Optional[FaultPlan]:
     return _ACTIVE[-1] if _ACTIVE else None
+
+
+def check_write(path: str) -> None:
+    """Guarded-write gate: every durable writer (journal checkpoints,
+    ModelStore commits, streaming WAL/commit, event-log sink, incident
+    bundles) calls this with its target path before touching the
+    filesystem. Raises ``OSError(ENOSPC)`` when the ambient plan holds a
+    matching :meth:`FaultPlan.disk_full` directive; no-op otherwise."""
+    plan = current_faults()
+    if plan is not None:
+        plan.apply_on_write(path)
+
+
+def is_oom_error(err: BaseException) -> bool:
+    """Classify ``err`` as memory exhaustion: a host ``MemoryError`` or
+    any error whose message carries XLA's ``RESOURCE_EXHAUSTED`` marker
+    (real ``XlaRuntimeError`` allocation failures and the injected
+    :class:`DeviceOomError` alike)."""
+    return isinstance(err, MemoryError) or "RESOURCE_EXHAUSTED" in str(err)

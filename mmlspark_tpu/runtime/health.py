@@ -8,9 +8,12 @@ and a timeout paroles it back into the pool. This module is that policy
 for the thread-based runtime:
 
 - every attempt failure (error / timeout / heartbeat loss / corrupt
-  result) books ``1.0`` against the worker that ran it; being overtaken
-  by a speculative copy books ``straggle_weight`` (chronic slowness is a
-  health signal too, at a discount);
+  result) books ``1.0`` against the worker that ran it; an OOM failure
+  books ``oom_weight`` (default 2.0 — a worker that keeps exhausting
+  memory poisons every task placed on it, the posture of Spark's
+  OOM-aware ``excludeOnFailure``); being overtaken by a speculative
+  copy books ``straggle_weight`` (chronic slowness is a health signal
+  too, at a discount);
 - scores are summed over a rolling ``window_s`` window; a worker at or
   above ``threshold`` is quarantined: the executor pool refuses to hand
   it new attempts (:meth:`ExecutorPool._admit`) until ``parole_s``
@@ -47,6 +50,7 @@ class HealthTracker:
         window_s: float = 60.0,
         parole_s: float = 30.0,
         straggle_weight: float = 0.5,
+        oom_weight: float = 2.0,
         clock: Callable[[], float] = time.monotonic,
         metrics=None,
         on_quarantine: Optional[Callable[[int, float], None]] = None,
@@ -58,6 +62,7 @@ class HealthTracker:
         self.window_s = float(window_s)
         self.parole_s = float(parole_s)
         self.straggle_weight = float(straggle_weight)
+        self.oom_weight = float(oom_weight)
         self.clock = clock
         self.metrics = metrics
         self.on_quarantine = on_quarantine
@@ -75,9 +80,12 @@ class HealthTracker:
 
     def note_failure(self, worker_id: Optional[int], reason: str = "error") -> None:
         """Book one attempt failure against ``worker_id`` (None = the
-        attempt never reached a worker; nothing to book)."""
+        attempt never reached a worker; nothing to book). OOM failures
+        score ``oom_weight`` — memory exhaustion on a worker predicts
+        exhaustion for whatever lands there next."""
         if worker_id is not None:
-            self._book(int(worker_id), 1.0)
+            weight = self.oom_weight if reason == "oom" else 1.0
+            self._book(int(worker_id), weight)
 
     def note_straggle(self, worker_id: Optional[int]) -> None:
         """The worker's attempt was overtaken by a speculative copy."""

@@ -43,6 +43,7 @@ import zlib
 from typing import Any, Dict, List, Optional, Tuple
 
 from mmlspark_tpu.core.profiling import get_logger
+from mmlspark_tpu.runtime.faults import check_write
 
 logger = get_logger("mmlspark_tpu.runtime")
 
@@ -75,7 +76,10 @@ def _safe_key(key: str) -> str:
 
 def _atomic_write(path: str, data: bytes) -> None:
     """tmp + fsync + rename: the file at ``path`` is either the old
-    content or the complete new content, never a prefix."""
+    content or the complete new content, never a prefix. The guarded-write
+    gate (``FaultPlan.disk_full``) fires before the temp file opens, so an
+    injected ENOSPC leaves no trace on disk."""
+    check_write(path)
     tmp = path + ".tmp"
     with open(tmp, "wb") as fh:
         fh.write(data)
@@ -193,8 +197,12 @@ class FitJournal:
         """Durably record task ``index`` as complete: checkpoint (atomic,
         checksummed) then journal line. Returns False when the task was
         already recorded (recovered or raced by a speculative sibling) —
-        nothing is written, which is what "zero re-executions" means."""
+        nothing is written, which is what "zero re-executions" means.
+        An injected/real ENOSPC fires before the index is reserved, so a
+        failed record leaves the journal state clean and the ``OSError``
+        propagates to the caller (the epoch/task owner decides)."""
         index = int(index)
+        check_write(os.path.join(self.dir, f"task-{index:05d}.ckpt"))
         with self._lock:
             if index in self._recorded:
                 return False

@@ -63,7 +63,7 @@ from mmlspark_tpu.observability.events import (
 )
 from mmlspark_tpu.observability.tracing import get_tracer
 from mmlspark_tpu.runtime.executor import ExecutorPool
-from mmlspark_tpu.runtime.faults import FaultPlan, current_faults
+from mmlspark_tpu.runtime.faults import FaultPlan, current_faults, is_oom_error
 from mmlspark_tpu.runtime.health import HealthTracker
 from mmlspark_tpu.runtime.journal import FitJournal, result_crc as _result_crc
 from mmlspark_tpu.runtime.lineage import Lineage, PartitionLostError, ShardLineage
@@ -108,7 +108,7 @@ class AttemptInfo:
 
     attempt: int
     worker: int  # executor worker id; -1 = never reached a worker
-    reason: str  # ok|error|timeout|heartbeat|executor_death|corrupt|superseded
+    reason: str  # ok|error|oom|timeout|heartbeat|executor_death|corrupt|superseded
     duration: float
     speculative: bool = False
 
@@ -207,6 +207,8 @@ class TaskRecord:
     error: Optional[BaseException] = None
     not_before: float = 0.0  # monotonic time before which we won't re-dispatch
     needs_recompute: bool = False
+    #: OOM failures so far — the retry's reduced-footprint hint
+    oom_failures: int = 0
     #: ordered AttemptInfo per settled attempt (success, failure, supersede)
     history: List[AttemptInfo] = dataclasses.field(default_factory=list)
 
@@ -262,7 +264,13 @@ class _Attempt:
         payload = self.task.payload
         if isinstance(payload, ShardLineage):
             payload = payload.materialize()
-        result = self.job.fn(payload)
+        # an OOM relaunch runs under a reduced-footprint hint (how many
+        # times this task has OOMed); footprint-aware task bodies consult
+        # pressure.reduced_footprint() to shrink their working set
+        from mmlspark_tpu.runtime.pressure import _footprint_hint
+
+        with _footprint_hint(self.task.oom_failures):
+            result = self.job.fn(payload)
         if self.job.policy.result_integrity or (
             plan is not None
             and plan.will_corrupt(self.task.index, self.task_attempt)
@@ -404,8 +412,16 @@ class _Job:
             self.cond.notify_all()
         if accepted and self.journal is not None:
             # durable record outside the job lock: checkpoint + journal
-            # line on the worker's time, never blocking the driver
-            self.journal.record(t.index, result)
+            # line on the worker's time, never blocking the driver. A
+            # full checkpoint volume degrades durability, not the job —
+            # the task's success already stands
+            try:
+                self.journal.record(t.index, result)
+            except OSError as e:
+                logger.warning(
+                    "journal record for task %d failed (%s); result kept "
+                    "in memory, recovery will recompute it", t.index, e,
+                )
 
     def _on_failure(self, att: _Attempt, err: BaseException, executor_died: bool) -> None:
         with self.cond:
@@ -418,7 +434,16 @@ class _Job:
                 siblings.remove(att)
             if not siblings:
                 self.running.pop(t.index, None)
-            reason = "executor_death" if executor_died else "error"
+            if executor_died:
+                reason = "executor_death"
+            elif is_oom_error(err):
+                # memory exhaustion is its own retryable class: the
+                # relaunch carries a reduced-footprint hint, and the
+                # health tracker scores it heavier than a plain error
+                reason = "oom"
+                t.oom_failures += 1
+            else:
+                reason = "error"
             if att.span is not None:
                 get_tracer().finish(att.span, status=reason, error=str(err)[:200])
             self._register_failure(t, err, reason, att=att)

@@ -260,18 +260,34 @@ class _BatchLoop:
 
     # -- batching ------------------------------------------------------------
 
+    def effective_max_batch_size(self) -> int:
+        """``max_batch_size`` after ambient memory pressure: half at
+        WARN, a quarter (floor 1) at CRITICAL — smaller device batches
+        under pressure, full size again the moment the level clears."""
+        from mmlspark_tpu.runtime.pressure import (
+            PressureLevel, current_pressure_level,
+        )
+
+        level = current_pressure_level("memory")
+        if level >= PressureLevel.CRITICAL:
+            return max(1, self.max_batch_size // 4)
+        if level >= PressureLevel.WARN:
+            return max(1, self.max_batch_size // 2)
+        return self.max_batch_size
+
     def _gather_batch(self) -> List[_PendingRequest]:
-        """Collect up to max_batch_size requests, waiting at most
-        max_latency_ms past the first (``getNextRequest`` epoch-advance
-        timeout, ``HTTPSourceV2.scala:588-623``)."""
+        """Collect up to the (pressure-adjusted) max batch size, waiting
+        at most max_latency_ms past the first (``getNextRequest``
+        epoch-advance timeout, ``HTTPSourceV2.scala:588-623``)."""
         batch: List[_PendingRequest] = []
         try:
             first = self.queue.get(timeout=0.05)
         except queue.Empty:
             return batch
         batch.append(first)
+        bound = self.effective_max_batch_size()
         deadline = time.perf_counter() + self.max_latency_ms / 1000.0
-        while len(batch) < self.max_batch_size:
+        while len(batch) < bound:
             remaining = deadline - time.perf_counter()
             if remaining <= 0:
                 break

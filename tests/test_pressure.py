@@ -1,0 +1,463 @@
+"""Resource-exhaustion resilience tests: the pressure watchdog, OOM and
+ENOSPC fault injection, and graceful degradation across the fit, task,
+serving, and checkpoint planes (docs/resilience.md "Resource pressure").
+
+Every test that raises the ambient :class:`PressureLevel` restores it —
+the level is process-global and a leaked WARN would tighten every
+admission bound in the rest of the suite.
+"""
+
+import errno
+import glob
+import json
+import os
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu import runtime
+from mmlspark_tpu.observability.events import (
+    DiskPressure,
+    EventLogSink,
+    HistogramDegraded,
+    IncidentSkipped,
+    MemoryPressure,
+    TaskRetried,
+    get_bus,
+)
+from mmlspark_tpu.observability.registry import MetricsRegistry
+from mmlspark_tpu.resilience import AdmissionController
+from mmlspark_tpu.runtime.faults import (
+    DeviceOomError,
+    FaultPlan,
+    check_write,
+    inject_faults,
+    is_oom_error,
+)
+from mmlspark_tpu.runtime.health import HealthTracker
+from mmlspark_tpu.runtime.journal import _atomic_write
+from mmlspark_tpu.runtime.pressure import (
+    PressureLevel,
+    ResourceWatchdog,
+    _footprint_hint,
+    current_pressure_level,
+    reduced_footprint,
+    set_pressure_level,
+)
+
+
+@pytest.fixture(autouse=True)
+def _reset_levels():
+    yield
+    set_pressure_level("memory", PressureLevel.OK)
+    set_pressure_level("disk", PressureLevel.OK)
+
+
+@pytest.fixture
+def bus_events():
+    seen = []
+    bus = get_bus()
+    bus.add_listener(seen.append)
+    yield seen
+    bus.remove_listener(seen.append)
+
+
+# -- fault directives ---------------------------------------------------------
+
+
+class TestExhaustionFaults:
+    def test_oom_task_rejects_unknown_kind(self):
+        with pytest.raises(ValueError):
+            FaultPlan().oom_task(0, kind="gpu")
+
+    def test_host_oom_fires_once_at_task_start(self):
+        plan = FaultPlan().oom_task(2, "host")
+        with pytest.raises(MemoryError):
+            plan.apply_on_start(2, 0)
+        assert ("oom_host", 2, 0) in plan.fired
+        plan.apply_on_start(2, 1)  # consumed; the relaunch runs clean
+
+    def test_device_oom_fires_at_histogram_dispatch(self):
+        plan = FaultPlan().oom_task(0, "device")
+        with pytest.raises(DeviceOomError) as ei:
+            plan.apply_on_histogram(0, 0)
+        assert "RESOURCE_EXHAUSTED" in str(ei.value)
+        assert ("oom_device", 0, 0) in plan.fired
+        plan.apply_on_histogram(0, 1)  # consumed
+
+    def test_is_oom_error_classification(self):
+        assert is_oom_error(MemoryError())
+        assert is_oom_error(DeviceOomError("RESOURCE_EXHAUSTED: out of HBM"))
+        assert is_oom_error(RuntimeError("RESOURCE_EXHAUSTED by XLA"))
+        assert not is_oom_error(RuntimeError("network down"))
+
+    def test_disk_full_fails_matching_writes(self, tmp_path):
+        plan = FaultPlan().disk_full("victim", 2)
+        with inject_faults(plan):
+            with pytest.raises(OSError) as ei:
+                _atomic_write(str(tmp_path / "victim-a"), b"x")
+            assert ei.value.errno == errno.ENOSPC
+            _atomic_write(str(tmp_path / "other"), b"x")  # no substring match
+            with pytest.raises(OSError):
+                _atomic_write(str(tmp_path / "victim-b"), b"x")
+            # count exhausted: the volume has "space" again
+            _atomic_write(str(tmp_path / "victim-c"), b"x")
+        assert (tmp_path / "other").read_bytes() == b"x"
+        assert (tmp_path / "victim-c").read_bytes() == b"x"
+        assert not (tmp_path / "victim-a").exists()
+        assert sum(1 for f in plan.fired if f[0] == "disk_full") == 2
+
+    def test_check_write_is_noop_without_a_plan(self, tmp_path):
+        check_write(str(tmp_path / "anything"))
+
+
+# -- pressure level + footprint hint ------------------------------------------
+
+
+class TestPressureLevel:
+    def test_set_and_read(self):
+        assert current_pressure_level("memory") == PressureLevel.OK
+        prev = set_pressure_level("memory", PressureLevel.CRITICAL)
+        assert prev == PressureLevel.OK
+        assert current_pressure_level("memory") == PressureLevel.CRITICAL
+
+    def test_footprint_hint_scoped(self):
+        assert reduced_footprint() == 0
+        with _footprint_hint(2):
+            assert reduced_footprint() == 2
+            with _footprint_hint(3):
+                assert reduced_footprint() == 3
+            assert reduced_footprint() == 2
+        assert reduced_footprint() == 0
+
+
+# -- the watchdog -------------------------------------------------------------
+
+
+class TestResourceWatchdog:
+    def _watchdog(self, state, disk):
+        return ResourceWatchdog(
+            checkpoint_dir="/tmp",
+            eventlog_dir=None,
+            registry=MetricsRegistry(),
+            hbm_sampler=lambda: [("d0", state["used"], 100.0)],
+            rss_sampler=lambda: None,
+            disk_sampler=lambda p: disk["free_total"],
+        )
+
+    def test_memory_transitions_publish_onset_and_recovery(self, bus_events):
+        state = {"used": 10.0}
+        wd = self._watchdog(state, {"free_total": (90.0, 100.0)})
+        assert wd.poll()["memory"] == PressureLevel.OK
+        state["used"] = 90.0
+        assert wd.poll()["memory"] == PressureLevel.WARN
+        assert current_pressure_level("memory") == PressureLevel.WARN
+        state["used"] = 99.0
+        assert wd.poll()["memory"] == PressureLevel.CRITICAL
+        state["used"] = 99.0
+        wd.poll()  # steady state: no repeat event
+        state["used"] = 10.0
+        assert wd.poll()["memory"] == PressureLevel.OK
+        mem = [e for e in bus_events if isinstance(e, MemoryPressure)]
+        assert [e.level for e in mem] == ["warn", "critical", "ok"]
+        assert mem[0].source == "hbm:d0"
+
+    def test_disk_transitions(self, bus_events):
+        state = {"used": 10.0}
+        disk = {"free_total": (50.0, 100.0)}
+        wd = self._watchdog(state, disk)
+        assert wd.poll()["disk"] == PressureLevel.OK
+        disk["free_total"] = (4.0, 100.0)  # 96% used
+        assert wd.poll()["disk"] == PressureLevel.CRITICAL
+        assert current_pressure_level("disk") == PressureLevel.CRITICAL
+        disk["free_total"] = (60.0, 100.0)
+        assert wd.poll()["disk"] == PressureLevel.OK
+        levels = [e.level for e in bus_events if isinstance(e, DiskPressure)]
+        assert levels == ["critical", "ok"]
+
+
+# -- serving degradation ------------------------------------------------------
+
+
+class TestAdmissionUnderPressure:
+    def _controller(self, max_pending=8):
+        return AdmissionController(
+            max_pending=max_pending, registry=MetricsRegistry(),
+        )
+
+    def test_bound_tightens_and_restores(self):
+        ac = self._controller(8)
+        assert ac.effective_max_pending() == 8
+        set_pressure_level("memory", PressureLevel.WARN)
+        assert ac.effective_max_pending() == 4
+        set_pressure_level("memory", PressureLevel.CRITICAL)
+        assert ac.effective_max_pending() == 2
+        set_pressure_level("memory", PressureLevel.OK)
+        assert ac.effective_max_pending() == 8
+
+    def test_sheds_with_memory_pressure_reason(self, bus_events):
+        ac = self._controller(8)
+        set_pressure_level("memory", PressureLevel.WARN)
+        for _ in range(4):
+            assert ac.try_acquire()
+        assert not ac.try_acquire()  # 5th: over the tightened bound
+        sheds = [
+            e for e in bus_events
+            if type(e).__name__ == "RequestShed"
+        ]
+        assert sheds and sheds[-1].reason == "memory_pressure"
+        # recovery: the full bound is back without any release
+        set_pressure_level("memory", PressureLevel.OK)
+        assert ac.try_acquire()
+
+    def test_batch_loop_bound(self):
+        from mmlspark_tpu.serving.server import _BatchLoop
+
+        loop = _BatchLoop(
+            model=lambda t: t, input_col="x", output_col="y",
+            max_batch_size=16, max_latency_ms=1.0,
+            registry=MetricsRegistry(),
+        )
+        assert loop.effective_max_batch_size() == 16
+        set_pressure_level("memory", PressureLevel.CRITICAL)
+        assert loop.effective_max_batch_size() == 4
+        set_pressure_level("memory", PressureLevel.OK)
+        assert loop.effective_max_batch_size() == 16
+
+
+# -- scheduler OOM classification ---------------------------------------------
+
+
+class TestSchedulerOom:
+    def test_host_oom_relaunches_and_classifies(self, bus_events):
+        plan = FaultPlan().oom_task(1, "host")
+        with inject_faults(plan):
+            results = runtime.run_partitioned(
+                lambda x: x * 10, [1, 2, 3],
+                runtime.SchedulerPolicy(max_workers=2),
+            )
+        assert results == [10, 20, 30]
+        assert ("oom_host", 1, 0) in plan.fired
+        retried = [e for e in bus_events if isinstance(e, TaskRetried)]
+        assert any(e.reason == "oom" for e in retried)
+
+    def test_health_books_oom_heavier(self):
+        h = HealthTracker(threshold=3.0, oom_weight=2.0)
+        h.note_failure(0, "oom")
+        assert h.score(0) == 2.0
+        h.note_failure(1, "error")
+        assert h.score(1) == 1.0
+        h.note_failure(0, "oom")  # 4.0 >= threshold: quarantined
+        assert h.is_quarantined(0)
+        assert not h.is_quarantined(1)
+
+
+# -- OOM-degraded fit parity --------------------------------------------------
+
+
+class TestDegradedFitParity:
+    def _fit(self, plan):
+        from mmlspark_tpu.lightgbm.binning import apply_bins, fit_bin_mapper
+        from mmlspark_tpu.lightgbm.train import TrainOptions, train
+
+        rng = np.random.default_rng(5)
+        X = rng.normal(size=(200, 4))
+        y = (X[:, 0] - 0.4 * X[:, 1] > 0).astype(np.float64)
+        mapper = fit_bin_mapper(X, max_bin=31)
+        bins = apply_bins(X, mapper)
+        opts = TrainOptions(
+            objective="binary", num_iterations=4, num_leaves=5, seed=9,
+            histogram_method="u",
+        )
+        with inject_faults(plan):
+            result = train(bins, y, opts, mapper=mapper)
+        return result.booster.model_to_string()
+
+    def test_device_oom_degrades_to_identical_model(self, bus_events):
+        reference = self._fit(FaultPlan())
+        plan = FaultPlan().oom_task(0, "device")
+        degraded = self._fit(plan)
+        assert ("oom_device", 0, 0) in plan.fired
+        assert degraded == reference  # byte-identical despite the retry
+        booked = [e for e in bus_events if isinstance(e, HistogramDegraded)]
+        assert booked and booked[0].retries == 1
+        assert booked[0].chunk_rows > 0
+        assert any(
+            isinstance(e, MemoryPressure) and e.level == "critical"
+            for e in bus_events
+        )
+
+
+# -- ENOSPC on the checkpoint/streaming plane ---------------------------------
+
+
+class TestStreamingEnospc:
+    def test_epoch_aborts_cleanly_and_resumes(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("MMLSPARK_TPU_CHECKPOINT_DIR", str(tmp_path))
+        from mmlspark_tpu.lightgbm import LightGBMClassifier
+        from mmlspark_tpu.streaming import (
+            FileStreamSource,
+            ModelCommitSink,
+            StreamingQuery,
+        )
+
+        incoming = tmp_path / "incoming"
+        incoming.mkdir()
+        rng = np.random.default_rng(3)
+        for i in range(3):
+            X = rng.normal(size=(50, 3))
+            y = (X[:, 0] > 0).astype(np.float64)
+            np.savez(incoming / f"part-{i:05d}.npz", features=X, label=y)
+
+        def make_query():
+            source = FileStreamSource(
+                str(incoming), pattern="part-*.npz", max_per_trigger=1
+            )
+            sink = ModelCommitSink(
+                lambda: LightGBMClassifier(
+                    numIterations=2, numLeaves=4, seed=1
+                ),
+                name="enospc-test",
+            )
+            return StreamingQuery(source, sink, name="enospc-test"), sink
+
+        query, sink = make_query()
+        plan = FaultPlan().disk_full("offsets/000001", 1)
+        with inject_faults(plan):
+            with pytest.raises(OSError) as ei:
+                query.process_all_available()
+        assert ei.value.errno == errno.ENOSPC
+        assert query.committed_epochs == [0]  # epoch 0 landed; 1 aborted
+        sink.close()
+
+        # space returns: a restarted query finishes every epoch
+        query2, sink2 = make_query()
+        query2.process_all_available()
+        assert query2.committed_epochs == [0, 1, 2]
+        sink2.close()
+        # zero refits: the journal holds each epoch exactly once
+        epochs = []
+        for path in glob.glob(
+            str(tmp_path / "streaming-models" / "**" / "journal.jsonl"),
+            recursive=True,
+        ):
+            with open(path, "r", encoding="utf-8") as fh:
+                epochs += [
+                    int(json.loads(line)["task"])
+                    for line in fh if line.strip()
+                ]
+        assert sorted(epochs) == [0, 1, 2]
+
+
+# -- event-log + incident ENOSPC hardening ------------------------------------
+
+
+class TestEventLogEnospc:
+    def test_sink_counts_and_drops(self, tmp_path):
+        log = tmp_path / "events.jsonl"
+        sink = EventLogSink(str(log))
+        sink(MemoryPressure(source="host", level="warn",
+                            used_bytes=1.0, limit_bytes=2.0))
+        plan = FaultPlan().disk_full("events.jsonl", 2)
+        with inject_faults(plan):
+            sink(MemoryPressure(source="host", level="critical",
+                                used_bytes=1.0, limit_bytes=2.0))
+            sink(MemoryPressure(source="host", level="ok",
+                                used_bytes=1.0, limit_bytes=2.0))
+        sink(DiskPressure(path="/x", level="warn",
+                          free_bytes=1.0, total_bytes=100.0))
+        sink.close()
+        assert sink.write_errors == 2
+        lines = [
+            json.loads(x) for x in log.read_text().splitlines() if x.strip()
+        ]
+        assert [r["event"] for r in lines] == ["MemoryPressure", "DiskPressure"]
+
+    def test_flight_recorder_skips_bundle(self, tmp_path, bus_events):
+        from mmlspark_tpu.observability.incidents import FlightRecorder
+
+        recorder = FlightRecorder(str(tmp_path / "incidents"), cooldown_s=0.0)
+        plan = FaultPlan().disk_full("incidents", 1)
+        with inject_faults(plan):
+            assert recorder.record("slo_budget", detail="test") is None
+        skipped = [e for e in bus_events if isinstance(e, IncidentSkipped)]
+        assert skipped and skipped[0].trigger == "slo_budget"
+        assert "No space left" in skipped[0].reason
+        # space returns: the next record succeeds
+        path = recorder.record("slo_budget", detail="test")
+        assert path is not None and os.path.isdir(path)
+
+
+# -- sharded ingest: bounded row-range loads ----------------------------------
+
+
+class TestShardedRowRanges:
+    def _dataset(self, tmp_path, n=70, f=4, rows_per_shard=30):
+        from mmlspark_tpu.data.sharded import ShardedDataset
+
+        rng = np.random.default_rng(17)
+        X = rng.normal(size=(n, f))
+        y = (X[:, 0] > 0).astype(np.float64)
+        w = rng.uniform(0.5, 1.5, size=n)
+        ds = ShardedDataset.write_shards(
+            str(tmp_path / "shards"), X, y, w, rows_per_shard=rows_per_shard
+        )
+        return ds, X, y, w
+
+    def test_load_rows_matches_full_decode(self, tmp_path):
+        from mmlspark_tpu.data.sharded import ShardedDataset
+
+        ds, X, y, w = self._dataset(tmp_path)
+        path = ds.paths[0]
+        full_X, full_y, full_w = ShardedDataset._load(path)
+        part_X, part_y, part_w = ShardedDataset.load_rows(path, 5, 21)
+        np.testing.assert_array_equal(part_X, full_X[5:21])
+        np.testing.assert_array_equal(part_y, full_y[5:21])
+        np.testing.assert_array_equal(part_w, full_w[5:21])
+
+    def test_load_rows_npy(self, tmp_path):
+        from mmlspark_tpu.data.sharded import ShardedDataset
+
+        arr = np.arange(40, dtype=np.float64).reshape(10, 4)
+        path = str(tmp_path / "only.npy")
+        np.save(path, arr)
+        X, y, w = ShardedDataset.load_rows(path, 2, 7)
+        np.testing.assert_array_equal(X, arr[2:7])
+        assert y is None and w is None
+
+    def test_scheduled_binning_with_row_ranges(self, tmp_path):
+        ds, X, y, w = self._dataset(tmp_path)
+        mapper = ds.fit_mapper(max_bin=15)
+        seq_bins, seq_y, seq_w = ds.bin_to_memmap(
+            mapper, out_path=str(tmp_path / "seq.u8")
+        )
+        sched_bins, sched_y, sched_w = ds.bin_to_memmap(
+            mapper,
+            out_path=str(tmp_path / "sched.u8"),
+            policy=runtime.SchedulerPolicy(max_workers=2),
+            rows_per_task=13,
+        )
+        np.testing.assert_array_equal(
+            np.asarray(sched_bins), np.asarray(seq_bins)
+        )
+        np.testing.assert_array_equal(sched_y, seq_y)
+        np.testing.assert_array_equal(sched_w, seq_w)
+
+    def test_pressure_splits_tasks(self, tmp_path):
+        ds, X, y, w = self._dataset(tmp_path)
+        mapper = ds.fit_mapper(max_bin=15)
+        seq_bins, _, _ = ds.bin_to_memmap(
+            mapper, out_path=str(tmp_path / "seq2.u8")
+        )
+        set_pressure_level("memory", PressureLevel.WARN)
+        try:
+            split_bins, _, _ = ds.bin_to_memmap(
+                mapper,
+                out_path=str(tmp_path / "split.u8"),
+                policy=runtime.SchedulerPolicy(max_workers=2),
+            )
+        finally:
+            set_pressure_level("memory", PressureLevel.OK)
+        np.testing.assert_array_equal(
+            np.asarray(split_bins), np.asarray(seq_bins)
+        )

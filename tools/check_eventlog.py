@@ -24,6 +24,12 @@ contract over the (merged) stream: every successfully served
 chain — the router's root span AND the replica's serving span, from at
 least two distinct processes, under one trace id.
 
+``--pressure`` additionally asserts the resource-pressure contract:
+every ``MemoryPressure``/``DiskPressure`` onset (level != "ok") must be
+followed by a degradation event (``HistogramDegraded``, a
+``memory_pressure`` ``RequestShed``, an ``oom`` ``TaskRetried``) or the
+matching recovery record (same event type, level == "ok").
+
 Exit status 0 with a one-line summary when the log is clean; 1 with one
 diagnostic per bad line otherwise (CI gates on this; see the
 ``observability`` and ``fleet-chaos`` jobs in .github/workflows/ci.yml).
@@ -148,6 +154,51 @@ def check_trace_continuity(
     return problems, summary
 
 
+def check_pressure_pairing(
+    records: typing.List[dict],
+) -> typing.Tuple[typing.List[str], str]:
+    """(problems, summary) for the resource-pressure contract over a
+    decoded record stream: every MemoryPressure/DiskPressure onset
+    (level != "ok") must be followed by a degradation event — a
+    HistogramDegraded, a RequestShed with reason ``memory_pressure``, or
+    a TaskRetried with reason ``oom`` — or by the matching recovery
+    record (same event type, level == "ok"). An onset nobody reacted to
+    means the watchdog fired into the void."""
+    onsets: typing.List[typing.Tuple[int, dict]] = []
+    recoveries: typing.List[typing.Tuple[int, str]] = []
+    degradations: typing.List[int] = []
+    for i, rec in enumerate(records):
+        kind = rec.get("event")
+        if kind in ("MemoryPressure", "DiskPressure"):
+            if rec.get("level") == "ok":
+                recoveries.append((i, kind))
+            else:
+                onsets.append((i, rec))
+        elif kind == "HistogramDegraded":
+            degradations.append(i)
+        elif kind == "RequestShed" and rec.get("reason") == "memory_pressure":
+            degradations.append(i)
+        elif kind == "TaskRetried" and rec.get("reason") == "oom":
+            degradations.append(i)
+    problems = []
+    paired = 0
+    for idx, rec in onsets:
+        kind = rec["event"]
+        reacted = any(j > idx for j in degradations) or any(
+            j > idx and k == kind for j, k in recoveries
+        )
+        if reacted:
+            paired += 1
+        else:
+            where = rec.get("source") or rec.get("path") or "?"
+            problems.append(
+                f"{kind} onset (level={rec.get('level')!r}, {where}) has no "
+                f"subsequent degradation or recovery event — unpaired pressure"
+            )
+    summary = f"pressure pairing: {paired}/{len(onsets)} onsets paired"
+    return problems, summary
+
+
 def main(argv: typing.Optional[typing.List[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="tools/check_eventlog.py",
@@ -160,6 +211,11 @@ def main(argv: typing.Optional[typing.List[str]] = None) -> int:
         "--trace-continuity", action="store_true",
         help="also assert every served RequestRouted trace id resolves "
              "to its full cross-process span chain",
+    )
+    parser.add_argument(
+        "--pressure", action="store_true",
+        help="also assert every MemoryPressure/DiskPressure onset pairs "
+             "with a later degradation or recovery event",
     )
     args = parser.parse_args(argv)
     path = args.eventlog
@@ -196,18 +252,25 @@ def main(argv: typing.Optional[typing.List[str]] = None) -> int:
                     valid_records.append(rec)
     total = sum(counts.values())
     where = path if len(segments) == 1 else f"{path} ({len(segments)} segments)"
-    trace_summary = ""
+    summaries = []
     if args.trace_continuity:
-        problems, trace_summary = check_trace_continuity(valid_records)
+        problems, summary = check_trace_continuity(valid_records)
         for p in problems:
             print(f"{path}: {p}", file=sys.stderr)
         bad += len(problems)
+        summaries.append(summary)
+    if args.pressure:
+        problems, summary = check_pressure_pairing(valid_records)
+        for p in problems:
+            print(f"{path}: {p}", file=sys.stderr)
+        bad += len(problems)
+        summaries.append(summary)
     if bad:
         print(f"{where}: {bad} problem(s), {total} valid event(s)",
               file=sys.stderr)
         return 1
     breakdown = " ".join(f"{k}={v}" for k, v in sorted(counts.items()))
-    tail = f"; {trace_summary}" if trace_summary else ""
+    tail = "".join(f"; {s}" for s in summaries)
     print(f"{where}: {total} events ok ({breakdown}){tail}")
     return 0
 

@@ -1,0 +1,268 @@
+#!/usr/bin/env python
+"""Resource-exhaustion smoke: OOM and ENOSPC chaos end to end (CI:
+resource-chaos).
+
+Three scenarios, each a fresh child process (clean JAX + event-bus
+state), all driven by seeded :class:`FaultPlan` exhaustion directives:
+
+1. **device OOM during a GBDT fit** — ``oom_task(0, "device")`` raises
+   RESOURCE_EXHAUSTED at the first histogram dispatch; the fit halves
+   its U budget, re-streams the pass row-chunked (bit-exact math), and
+   finishes. Asserted: the final model text is byte-identical to an
+   undisturbed run's, and the event log carries the
+   ``MemoryPressure`` -> ``HistogramDegraded`` pair
+   (``check_eventlog.py --pressure`` validates the pairing contract).
+
+2. **host OOM at a task boundary** — ``oom_task(1, "host")`` raises
+   MemoryError when task 1 starts; the scheduler classifies it ``oom``
+   (not a generic error), relaunches at reduced footprint, and the job's
+   results are unchanged. Asserted: correct results, the directive
+   fired, and a ``TaskRetried`` with ``reason="oom"`` in the event log.
+
+3. **ENOSPC mid-stream** — ``disk_full("offsets/000001")`` fails epoch
+   1's WAL write after epoch 0 committed; the query aborts cleanly
+   (nonzero exit, no torn files). A restart without the fault commits
+   every epoch exactly once, never refits a journaled epoch, and lands
+   byte-identical to an undisturbed run.
+
+Exit code 0 + "resource chaos smoke OK" on success.
+
+Usage: python tools/resource_chaos_smoke.py                # the smoke
+       python tools/resource_chaos_smoke.py --child-* ...  # victims
+"""
+
+import glob
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import zlib
+
+# runnable both installed (CI) and straight from a checkout
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+import numpy as np
+
+NUM_CHUNKS = 4
+MODEL = "reschaos"
+
+
+# -- scenario 1: device OOM during fit ----------------------------------------
+
+def run_fit_child(out_path: str, fault: bool) -> None:
+    from mmlspark_tpu.lightgbm.binning import apply_bins, fit_bin_mapper
+    from mmlspark_tpu.lightgbm.train import TrainOptions, train
+    from mmlspark_tpu.runtime.faults import FaultPlan, inject_faults
+
+    rng = np.random.default_rng(7)
+    X = rng.normal(size=(400, 6))
+    y = (X[:, 0] + 0.3 * X[:, 1] > 0).astype(np.float64)
+    mapper = fit_bin_mapper(X, max_bin=63)
+    bins = apply_bins(X, mapper)
+    opts = TrainOptions(
+        objective="binary", num_iterations=6, num_leaves=7, seed=3,
+        histogram_method="u",
+    )
+    plan = FaultPlan()
+    if fault:
+        plan.oom_task(0, "device")
+    with inject_faults(plan):
+        result = train(bins, y, opts, mapper=mapper)
+    if fault:
+        assert ("oom_device", 0, 0) in plan.fired, plan.fired
+    with open(out_path, "w", encoding="utf-8") as fh:
+        fh.write(result.booster.model_to_string())
+
+
+# -- scenario 2: host OOM at the task boundary --------------------------------
+
+def run_tasks_child() -> None:
+    from mmlspark_tpu import runtime
+
+    plan = runtime.FaultPlan().oom_task(1, "host")
+    with runtime.inject_faults(plan):
+        results = runtime.run_partitioned(
+            lambda x: x * x, [0, 1, 2, 3],
+            runtime.SchedulerPolicy(max_workers=2),
+        )
+    assert results == [0, 1, 4, 9], results
+    assert ("oom_host", 1, 0) in plan.fired, plan.fired
+
+
+# -- scenario 3: ENOSPC mid-stream --------------------------------------------
+
+def run_stream_child(root: str, incoming: str, fault: bool) -> None:
+    os.environ["MMLSPARK_TPU_CHECKPOINT_DIR"] = root
+    from mmlspark_tpu.lightgbm import LightGBMClassifier
+    from mmlspark_tpu.runtime.faults import FaultPlan, inject_faults
+    from mmlspark_tpu.streaming import (
+        FileStreamSource,
+        ModelCommitSink,
+        StreamingQuery,
+    )
+
+    source = FileStreamSource(incoming, pattern="part-*.npz", max_per_trigger=1)
+    sink = ModelCommitSink(
+        lambda: LightGBMClassifier(numIterations=4, numLeaves=7, seed=5),
+        name=MODEL,
+    )
+    query = StreamingQuery(source, sink, name="reschaos")
+    plan = FaultPlan()
+    if fault:
+        # epoch 1's write-ahead log entry — fires AFTER epoch 0 committed
+        plan.disk_full("offsets/000001", 1)
+    with inject_faults(plan):
+        query.process_all_available()
+    sink.close()
+
+
+# -- harness ------------------------------------------------------------------
+
+def make_chunks(incoming: str) -> None:
+    rng = np.random.default_rng(13)
+    os.makedirs(incoming, exist_ok=True)
+    for i in range(NUM_CHUNKS):
+        X = rng.normal(size=(60, 4))
+        y = (X[:, 0] - 0.5 * X[:, 2] > 0).astype(np.float64)
+        final = os.path.join(incoming, f"part-{i:05d}.npz")
+        np.savez(final + ".tmp.npz", features=X, label=y)
+        os.rename(final + ".tmp.npz", final)
+
+
+def spawn(argv, eventlog=None) -> int:
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    env.pop("MMLSPARK_TPU_EVENT_LOG", None)
+    if eventlog is not None:
+        env["MMLSPARK_TPU_EVENT_LOG"] = eventlog
+    child = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__)] + argv, env=env,
+    )
+    child.wait(timeout=600)
+    return child.returncode
+
+
+def read_events(path: str):
+    out = []
+    with open(path, "r", encoding="utf-8") as fh:
+        for line in fh:
+            if line.strip():
+                out.append(json.loads(line))
+    return out
+
+
+def check_pressure(path: str) -> None:
+    env = {**os.environ}
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    rc = subprocess.call([
+        sys.executable, os.path.join(_REPO, "tools", "check_eventlog.py"),
+        "--pressure", path,
+    ], env=env)
+    assert rc == 0, f"check_eventlog --pressure failed on {path}"
+
+
+def stream_state(root: str):
+    """(version, crc32-of-model-text, committed epochs, journal epochs)."""
+    from mmlspark_tpu.runtime.journal import ModelStore
+
+    store = ModelStore(os.path.join(root, "models"))
+    version, text = store.latest(MODEL)
+    commits = sorted(
+        int(os.path.basename(p)[:-5])
+        for p in glob.glob(
+            os.path.join(root, "streaming", "reschaos", "commits", "*.json")
+        )
+    )
+    journal_epochs = []
+    for path in glob.glob(
+        os.path.join(root, "streaming-models", "**", "journal.jsonl"),
+        recursive=True,
+    ):
+        with open(path, "r", encoding="utf-8") as fh:
+            for line in fh:
+                if line.strip():
+                    journal_epochs.append(int(json.loads(line)["task"]))
+    return version, zlib.crc32(text.encode()), commits, sorted(journal_epochs)
+
+
+def crc_of(path: str) -> int:
+    with open(path, "rb") as fh:
+        return zlib.crc32(fh.read())
+
+
+def main() -> int:
+    work = tempfile.mkdtemp(prefix="mmlspark-tpu-reschaos-")
+
+    # 1. device OOM during fit: degraded retry, byte-identical model
+    ref_model = os.path.join(work, "fit-ref.txt")
+    oom_model = os.path.join(work, "fit-oom.txt")
+    fit_log = os.path.join(work, "fit-events.jsonl")
+    assert spawn(["--child-fit", ref_model, "0"]) == 0, "undisturbed fit failed"
+    assert spawn(["--child-fit", oom_model, "1"], eventlog=fit_log) == 0, \
+        "device-OOM fit did not recover"
+    assert crc_of(ref_model) == crc_of(oom_model), (
+        "degraded fit diverged from the undisturbed model"
+    )
+    kinds = [r.get("event") for r in read_events(fit_log)]
+    assert "HistogramDegraded" in kinds, kinds
+    assert "MemoryPressure" in kinds, kinds
+    check_pressure(fit_log)
+    print(f"device-OOM fit: degraded + byte-identical "
+          f"(crc={crc_of(ref_model):08x})")
+
+    # 2. host OOM at a task boundary: oom-classified relaunch
+    task_log = os.path.join(work, "task-events.jsonl")
+    assert spawn(["--child-tasks"], eventlog=task_log) == 0, \
+        "host-OOM job did not recover"
+    retried = [
+        r for r in read_events(task_log)
+        if r.get("event") == "TaskRetried" and r.get("reason") == "oom"
+    ]
+    assert retried, "no TaskRetried with reason='oom' in the event log"
+    check_pressure(task_log)
+    print(f"host-OOM task: {len(retried)} oom-classified relaunch(es)")
+
+    # 3. ENOSPC mid-stream: clean abort, exactly-once resume
+    incoming = os.path.join(work, "incoming")
+    make_chunks(incoming)
+    ref_root = os.path.join(work, "stream-ref")
+    assert spawn(["--child-stream", ref_root, incoming, "0"]) == 0, \
+        "undisturbed stream failed"
+    ref_version, ref_crc, ref_commits, _ = stream_state(ref_root)
+    assert ref_commits == list(range(NUM_CHUNKS)), ref_commits
+
+    enospc_root = os.path.join(work, "stream-enospc")
+    rc = spawn(["--child-stream", enospc_root, incoming, "1"])
+    assert rc != 0, "injected ENOSPC should abort the query"
+    assert spawn(["--child-stream", enospc_root, incoming, "0"]) == 0, \
+        "post-ENOSPC restart failed"
+    version, crc, commits, journal = stream_state(enospc_root)
+    assert commits == list(range(NUM_CHUNKS)), (
+        f"each epoch must commit exactly once: {commits}"
+    )
+    assert journal == list(range(NUM_CHUNKS)), (
+        f"a journaled epoch was refitted (or skipped): {journal}"
+    )
+    assert (version, crc) == (ref_version, ref_crc), (
+        f"diverged from undisturbed run: v{version} crc={crc:08x} "
+        f"!= v{ref_version} crc={ref_crc:08x}"
+    )
+    print(f"ENOSPC stream: aborted at epoch 1, resumed to "
+          f"v{version:06d} crc={crc:08x} epochs={commits}")
+
+    print("resource chaos smoke OK")
+    return 0
+
+
+if __name__ == "__main__":
+    if len(sys.argv) > 1 and sys.argv[1] == "--child-fit":
+        run_fit_child(sys.argv[2], sys.argv[3] == "1")
+        sys.exit(0)
+    if len(sys.argv) > 1 and sys.argv[1] == "--child-tasks":
+        run_tasks_child()
+        sys.exit(0)
+    if len(sys.argv) > 1 and sys.argv[1] == "--child-stream":
+        run_stream_child(sys.argv[2], sys.argv[3], sys.argv[4] == "1")
+        sys.exit(0)
+    sys.exit(main())
