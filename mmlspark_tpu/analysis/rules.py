@@ -12,6 +12,9 @@ Five framework contracts, one rule each — catalog and rationale in
   threaded ``runtime/`` / ``serving/`` layers.
 - ``bare-except-policy``: ``except Exception`` must re-raise, log, or
   carry an explicit justification.
+- ``socket-deadline-policy``: every socket wait in ``runtime/`` /
+  ``serving/`` must carry an explicit timeout — an unbounded socket is
+  how a network partition becomes a hung gang.
 """
 
 from __future__ import annotations
@@ -321,6 +324,60 @@ class LockDisciplineRule(Rule):
         if attr in self._NETWORK_METHODS:
             return f"network call .{attr}()"
         return None
+
+
+@register_rule
+class SocketDeadlinePolicyRule(Rule):
+    name = "socket-deadline-policy"
+    description = (
+        "Every socket wait in runtime/ and serving/ must carry an explicit "
+        "deadline: urlopen()/create_connection() without a timeout and "
+        ".settimeout(None) wait forever, so a partitioned peer or a dead "
+        "registry hangs the calling thread instead of failing over."
+    )
+
+    _PATH_PARTS = ("runtime", "serving")
+    #: (callable suffix, index of the positional timeout argument)
+    _TIMEOUT_CALLS = {
+        "urlopen": 2,            # urlopen(url, data=None, timeout=...)
+        "create_connection": 1,  # create_connection(address, timeout=...)
+    }
+
+    def _applies(self, ctx: FileContext) -> bool:
+        parts = ctx.path.replace("\\", "/").split("/")
+        return any(p in parts for p in self._PATH_PARTS)
+
+    def check(self, ctx: FileContext) -> Iterator[Violation]:
+        if not self._applies(ctx):
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func)
+            short = (name or "").split(".")[-1]
+            if short in self._TIMEOUT_CALLS:
+                pos = self._TIMEOUT_CALLS[short]
+                kwargs = {kw.arg for kw in node.keywords}
+                if "timeout" in kwargs or len(node.args) > pos:
+                    continue
+                yield self.violation(
+                    ctx, node,
+                    f"{name or short}() without timeout=: the call blocks "
+                    "forever when the peer is partitioned or dead — pass "
+                    "an explicit deadline",
+                )
+            elif (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr == "settimeout"
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and node.args[0].value is None
+            ):
+                yield self.violation(
+                    ctx, node,
+                    ".settimeout(None) removes the socket deadline: a "
+                    "silent peer then hangs this thread forever",
+                )
 
 
 @register_rule

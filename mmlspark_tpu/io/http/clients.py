@@ -105,6 +105,12 @@ def _do_request(
     fault = _injected_fault(request.url)
     if fault is not None:
         return fault
+    # net chaos sits BELOW the storm layer: storms answer without a
+    # socket, net directives degrade the socket itself (unreachable,
+    # stalled, timed out) or garble the bytes that come back
+    from mmlspark_tpu.runtime.faults import check_net
+
+    net = check_net(request.url)
     headers = request.header_map()
     if extra_headers:
         headers.update(extra_headers)
@@ -117,6 +123,10 @@ def _do_request(
     try:
         with urllib.request.urlopen(req, timeout=timeout) as resp:
             body = resp.read()
+            if net is not None and net.get("kind") == "corrupt":
+                from mmlspark_tpu.runtime.netchaos import corrupt_bytes
+
+                body = corrupt_bytes(body)
             return HTTPResponseData(
                 statusLine=StatusLineData("HTTP/1.1", resp.status, resp.reason or ""),
                 headers=[HeaderData(k, v) for k, v in resp.headers.items()],

@@ -49,12 +49,16 @@ from mmlspark_tpu.observability.events import (
     GroupReformed,
     HistogramChunked,
     IncidentRecorded,
+    LeaseRecovered,
     ModelCommitted,
     ModelSwapped,
+    NetworkPartitioned,
+    PeerSlow,
     ProcessLost,
     ProcessStarted,
     ProfileCompiled,
     ProfileExecuted,
+    RegistryUnavailable,
     RequestRouted,
     RequestServed,
     RequestShed,
@@ -146,15 +150,19 @@ __all__ = [
     "Histogram",
     "HistogramChunked",
     "IncidentRecorded",
+    "LeaseRecovered",
     "MetricsFederator",
     "MetricsRegistry",
     "ModelCommitted",
     "ModelSwapped",
+    "NetworkPartitioned",
     "PARENT_HEADER",
+    "PeerSlow",
     "ProcessLost",
     "ProcessStarted",
     "ProfileCompiled",
     "ProfileExecuted",
+    "RegistryUnavailable",
     "RequestRouted",
     "RequestServed",
     "RequestShed",

@@ -201,6 +201,33 @@ class GroupReformed(Event):
     lost: int
 
 
+@_event
+class NetworkPartitioned(Event):
+    """An epoch revoked with every process alive — a partitioned, lossy,
+    or silent link stalled the collective past its io deadline. The
+    supervisor resolved the gang's blame votes to ``member`` (the peer
+    it killed so recovery can use the normal loss path); ``reason``
+    concatenates each reporter's revocation message. Every onset must be
+    followed by a ``GroupReformed`` recovery record
+    (``check_eventlog.py --partition``)."""
+
+    member: int
+    epoch: int
+    reason: str = ""
+
+
+@_event
+class PeerSlow(Event):
+    """The collective's soft straggler detector: a round that succeeded
+    but made a member wait at least the slow-peer threshold for
+    ``member``'s frame. Booked as a health straggle, so a chronically
+    slow peer is quarantined out of the next re-formation."""
+
+    member: int
+    epoch: int
+    wait_s: float
+
+
 # -- serving -----------------------------------------------------------------
 
 
@@ -264,6 +291,32 @@ class RequestRouted(Event):
     status: int
     latency: float
     trace_id: str = ""
+
+
+@_event
+class RegistryUnavailable(Event):
+    """A registry consumer (``source`` = "router" / "controller" /
+    "replica") could not reach ``/services`` or heartbeat the
+    :class:`RegistrationService`. Routers and controllers keep serving
+    from their last-known-good table (``stale_replicas`` entries,
+    stamped stale); replicas fall back to jittered re-registration.
+    Published once per outage onset, not per failed poll."""
+
+    source: str
+    error: str
+    stale_replicas: int = 0
+
+
+@_event
+class LeaseRecovered(Event):
+    """A restarted :class:`RegistrationService` recovered one journaled
+    replica lease from disk (CRC-verified, ``age_s`` since it was
+    journaled) — the fleet re-appears without any replica re-registering
+    from scratch."""
+
+    name: str
+    url: str
+    age_s: float = 0.0
 
 
 # -- streaming ---------------------------------------------------------------

@@ -51,6 +51,34 @@ ambient plan before every wire call:
 - ``http_reset(n)``   — the next ``n`` matching requests raise
   ``ConnectionResetError`` (the mid-flight TCP reset).
 
+The network plane injects *gray* failures — the link is degraded but
+nobody is dead, the class of fault every other mode here cannot express:
+
+- ``net_partition(a, b)`` — the link between gang members ``a`` and
+  ``b`` goes dark: frames between them are silently swallowed in both
+  directions (a half-open TCP connection), so each side stalls until
+  its collective deadline revokes the epoch. With a string target
+  (``net_partition("registry")``) the next matching outbound HTTP
+  connection raises an unreachable ``OSError`` instead;
+- ``net_delay(member, ms)`` — member ``member`` lags every outgoing
+  frame by ``ms`` milliseconds (the slow link / slow peer); string
+  targets stall the matching HTTP request;
+- ``net_drop(member, p)`` — each of the member's outgoing frames is
+  dropped with seeded probability ``p`` (lossy link); string targets
+  time the matching HTTP request out;
+- ``net_corrupt(member, n)`` — the member's next ``n`` outgoing frames
+  are bit-flipped on the wire *after* checksumming, so the receiver's
+  CRC check must catch them and the retransmit path must absorb them;
+  string targets garble the matching HTTP response body.
+
+Gang directives (int members) are serialized into the epoch spec and
+enacted worker-side by :class:`~mmlspark_tpu.runtime.netchaos.NetChaos`
+(seeded per member, so the chaos replays exactly); the supervisor marks
+them fired when it observes the partition-triggered revocation
+(:meth:`mark_net_fired`). String directives enact on the outbound HTTP
+path via :func:`check_net`, *below* ``http_storm`` — storms fake status
+codes without a socket, net chaos degrades the socket itself.
+
 The exhaustion plane injects *resource* failures instead of crashes —
 the class of fault the pressure watchdog and degradation ladders
 (docs/resilience.md "Resource pressure") exist to absorb:
@@ -125,6 +153,11 @@ class FaultPlan:
         #: ordered HTTP fault directives, consumed first-match per request
         self._http: List[dict] = []
         self._http_seq = 0
+        #: ordered network-degradation directives: gang-targeted entries
+        #: (int members) ship in the epoch spec; HTTP-targeted entries
+        #: (str url parts) are consumed by :meth:`apply_on_socket`
+        self._net: List[dict] = []
+        self._net_seq = 0
         #: (index, attempt) -> "host"|"device" out-of-memory directives
         self._oom: Dict[Tuple[int, int], str] = {}
         #: ordered disk-full directives, consumed first-match per write
@@ -300,6 +333,159 @@ class FaultPlan:
         })
         return self
 
+    def net_partition(
+        self, a, b: int = 0, epoch: int = 0,
+        after_round: int = 0, count: int = 1,
+    ) -> "FaultPlan":
+        """Partition the link between gang members ``a`` and ``b`` during
+        gang ``epoch``: from allreduce round ``after_round`` on, frames
+        between them are swallowed in both directions and each side's
+        collective deadline — not a hang — ends the epoch. With a string
+        ``a`` (URL substring) the next ``count`` matching outbound HTTP
+        connections raise an unreachable ``OSError`` instead."""
+        if isinstance(a, str):
+            self._net.append({
+                "target": "http", "kind": "partition",
+                "url_part": str(a), "n": int(count),
+            })
+        else:
+            self._net.append({
+                "target": "gang", "kind": "partition", "a": int(a),
+                "b": int(b), "epoch": int(epoch),
+                "after_round": int(after_round),
+            })
+        return self
+
+    def net_delay(
+        self, member, ms: float, epoch: int = 0, count: int = 1
+    ) -> "FaultPlan":
+        """Member ``member`` lags every outgoing frame of gang ``epoch``
+        by ``ms`` milliseconds (the slow peer the soft slow-peer detector
+        and, past the io deadline, the revoke path exist for). String
+        targets stall the next ``count`` matching HTTP requests."""
+        if isinstance(member, str):
+            self._net.append({
+                "target": "http", "kind": "delay",
+                "url_part": str(member), "n": int(count), "ms": float(ms),
+            })
+        else:
+            self._net.append({
+                "target": "gang", "kind": "delay", "member": int(member),
+                "ms": float(ms), "epoch": int(epoch),
+            })
+        return self
+
+    def net_drop(
+        self, member, p: float, epoch: int = 0, count: int = 1
+    ) -> "FaultPlan":
+        """Each outgoing frame of gang member ``member`` is dropped with
+        probability ``p``, drawn from the worker's seeded RNG — a lossy
+        link, reproducible run to run. String targets make the next
+        ``count`` matching HTTP requests time out."""
+        if isinstance(member, str):
+            self._net.append({
+                "target": "http", "kind": "drop",
+                "url_part": str(member), "n": int(count), "p": float(p),
+            })
+        else:
+            self._net.append({
+                "target": "gang", "kind": "drop", "member": int(member),
+                "p": float(p), "epoch": int(epoch),
+            })
+        return self
+
+    def net_corrupt(
+        self, member, n: int = 1, epoch: int = 0
+    ) -> "FaultPlan":
+        """The next ``n`` frames gang member ``member`` sends are
+        bit-flipped *after* checksumming — on-the-wire corruption the
+        receiver's CRC check must reject and the bounded retransmit must
+        absorb (the fit stays byte-identical). String targets garble the
+        next ``n`` matching HTTP response bodies, exercising the
+        malformed-payload tolerance of the consumer."""
+        if isinstance(member, str):
+            self._net.append({
+                "target": "http", "kind": "corrupt",
+                "url_part": str(member), "n": int(n),
+            })
+        else:
+            self._net.append({
+                "target": "gang", "kind": "corrupt", "member": int(member),
+                "n": int(n), "epoch": int(epoch),
+            })
+        return self
+
+    def net_directives(self, epoch: Optional[int] = None) -> List[dict]:
+        """JSON-serializable gang-targeted net directives (for ``epoch``
+        when given) for the supervisor to embed in the epoch spec. Not
+        consumed here — the driver pops them via :meth:`mark_net_fired`
+        when it observes the degradation's effect."""
+        with self._lock:
+            return [
+                dict(d) for d in self._net
+                if d["target"] == "gang"
+                and (epoch is None or d["epoch"] == int(epoch))
+            ]
+
+    def mark_net_fired(
+        self, kind: str, member: Optional[int] = None,
+        epoch: Optional[int] = None,
+    ) -> bool:
+        """Driver-side acknowledgement: the supervisor observed the effect
+        of a gang net directive (a partition-triggered revocation, a
+        retransmit-absorbed corruption). Pops the first matching directive
+        and books it in ``fired`` as ``("net_<kind>", member, epoch)``."""
+
+        def _involves(d: dict, m: int) -> bool:
+            if "member" in d:
+                return int(d["member"]) == m
+            return m in (int(d.get("a", -1)), int(d.get("b", -1)))
+
+        with self._lock:
+            popped = None
+            for i, d in enumerate(self._net):
+                if d["target"] != "gang" or d["kind"] != str(kind):
+                    continue
+                if epoch is not None and d["epoch"] != int(epoch):
+                    continue
+                if member is not None and not _involves(d, int(member)):
+                    continue
+                popped = self._net.pop(i)
+                break
+        if popped is None:
+            return False
+        who = member if member is not None else popped.get(
+            "member", popped.get("a", -1))
+        self.fired.append((f"net_{kind}", int(who), int(popped["epoch"])))
+        return True
+
+    def apply_on_socket(self, url: str) -> Optional[dict]:
+        """Pop the first HTTP-targeted net directive matching ``url``, or
+        None. The caller (:func:`check_net`, below ``http_storm`` in the
+        client stack) enacts it at the socket boundary: raise the
+        unreachable error, sleep the delay, time out, or garble the
+        response body. Consumed in registration order, one per request."""
+        with self._lock:
+            directive = None
+            for d in self._net:
+                if (
+                    d["target"] == "http" and d["n"] > 0
+                    and d["url_part"] in url
+                ):
+                    d["n"] -= 1
+                    directive = dict(d)
+                    break
+            if directive is None:
+                return None
+            self._net = [
+                d for d in self._net
+                if d["target"] != "http" or d["n"] > 0
+            ]
+            seq = self._net_seq
+            self._net_seq += 1
+        self.fired.append((f"net_{directive['kind']}", seq, 0))
+        return directive
+
     def oom_task(
         self, index: int, kind: str = "host", attempt: int = 0
     ) -> "FaultPlan":
@@ -343,6 +529,10 @@ class FaultPlan:
                 + sum(d["n"] for d in self._http)
                 + len(self._oom)
                 + sum(d["n"] for d in self._disk_full)
+                + sum(
+                    d["n"] if d["target"] == "http" else 1
+                    for d in self._net
+                )
             )
 
     # -- worker-side hook ----------------------------------------------------
@@ -545,6 +735,45 @@ def check_write(path: str) -> None:
     plan = current_faults()
     if plan is not None:
         plan.apply_on_write(path)
+
+
+def check_net(url: str) -> Optional[dict]:
+    """Net-chaos gate for outbound HTTP: every registry/router client
+    calls this with its target URL right before opening the socket —
+    *below* ``http_storm``, which answers without a socket at all. Enacts
+    any ambient HTTP-targeted net directive: ``partition`` raises an
+    unreachable ``OSError``, ``delay`` sleeps, ``drop`` raises
+    ``socket.timeout``; a ``corrupt`` directive is returned for the
+    caller to garble the received body with (callers that ignore the
+    return value simply skip response corruption). No-op without a plan."""
+    plan = current_faults()
+    if plan is None:
+        return None
+    directive = plan.apply_on_socket(url)
+    if directive is None:
+        return None
+    try:  # the counter is observability, never a reason to skip the fault
+        from mmlspark_tpu.observability import get_registry
+
+        get_registry().counter(
+            "netchaos_http_faults_total",
+            "Injected network degradations enacted on the HTTP client path",
+        ).inc()
+    except Exception:  # noqa: BLE001 - registry unavailable in stripped envs
+        pass
+    kind = directive["kind"]
+    if kind == "partition":
+        raise OSError(
+            errno.EHOSTUNREACH, "Network partition (injected)", url
+        )
+    if kind == "delay":
+        time.sleep(directive["ms"] / 1000.0)
+        return None
+    if kind == "drop":
+        import socket
+
+        raise socket.timeout(f"injected frame drop for {url}")
+    return directive  # "corrupt": caller garbles the response body
 
 
 def is_oom_error(err: BaseException) -> bool:

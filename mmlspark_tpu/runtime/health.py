@@ -51,6 +51,7 @@ class HealthTracker:
         parole_s: float = 30.0,
         straggle_weight: float = 0.5,
         oom_weight: float = 2.0,
+        partition_weight: float = 2.0,
         clock: Callable[[], float] = time.monotonic,
         metrics=None,
         on_quarantine: Optional[Callable[[int, float], None]] = None,
@@ -63,6 +64,7 @@ class HealthTracker:
         self.parole_s = float(parole_s)
         self.straggle_weight = float(straggle_weight)
         self.oom_weight = float(oom_weight)
+        self.partition_weight = float(partition_weight)
         self.clock = clock
         self.metrics = metrics
         self.on_quarantine = on_quarantine
@@ -82,9 +84,15 @@ class HealthTracker:
         """Book one attempt failure against ``worker_id`` (None = the
         attempt never reached a worker; nothing to book). OOM failures
         score ``oom_weight`` — memory exhaustion on a worker predicts
-        exhaustion for whatever lands there next."""
+        exhaustion for whatever lands there next — and partition blame
+        scores ``partition_weight``: a member the gang voted off for
+        stalling the collective will stall the re-formed gang too."""
         if worker_id is not None:
-            weight = self.oom_weight if reason == "oom" else 1.0
+            weight = 1.0
+            if reason == "oom":
+                weight = self.oom_weight
+            elif reason == "partition":
+                weight = self.partition_weight
             self._book(int(worker_id), weight)
 
     def note_straggle(self, worker_id: Optional[int]) -> None:

@@ -1,0 +1,125 @@
+"""Worker-side enactment of gang network-degradation directives.
+
+The driver serializes :meth:`FaultPlan.net_partition` / ``net_delay`` /
+``net_drop`` / ``net_corrupt`` directives into the epoch spec
+(``net_faults`` + ``net_seed``); each gang member builds one
+:class:`NetChaos` from them and hands it to its
+:class:`~mmlspark_tpu.runtime.procgroup.AllreduceGroup`, which consults
+:meth:`NetChaos.on_send` for every outgoing frame. The degradation is
+therefore enacted at the real socket boundary of the collective — the
+same frames, the same rounds — with no live ``FaultPlan`` object in the
+worker (mirroring ``FaultPlan.should_die``).
+
+Determinism: the drop RNG is seeded from ``(net_seed, member, epoch)``,
+so a pinned ``MMLSPARK_TPU_FAULT_SEED`` replays the exact same frame
+losses run after run. Corruption happens *after* the sender checksums
+the frame, so the receiver's CRC check sees a genuine wire flip.
+
+A partition swallows frames in *both* directions (each side filters its
+own sends), which is what makes the failure gray: neither peer errors,
+both just stop hearing from each other, and only the collective's io
+deadline — never a blocked ``recv`` — ends the round.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+
+def corrupt_bytes(data: bytes) -> bytes:
+    """A length-preserving wire flip of ``data`` (first byte XOR 0xFF) —
+    shared by the gang frame path and the HTTP response-corruption path
+    so both chaos modes garble payloads the same way."""
+    if not data:
+        return data
+    bad = bytearray(data)
+    bad[0] ^= 0xFF
+    return bytes(bad)
+
+
+class NetChaos:
+    """Per-member network degradation for one gang epoch.
+
+    ``directives`` is the ``net_faults`` list from the epoch spec;
+    entries for other members/epochs are ignored, so every member can be
+    handed the same list. ``enacted`` records what actually fired as
+    ``(kind, round)`` pairs — the worker ships it back in its revoked /
+    done report so the driver can mark the plan's directives fired.
+    """
+
+    def __init__(
+        self,
+        directives: List[Dict[str, Any]],
+        member: int,
+        epoch: int,
+        seed: int = 0,
+    ):
+        self.member = int(member)
+        self.epoch = int(epoch)
+        self._rng = np.random.default_rng(
+            (int(seed) * 1_000_003 + self.member * 8191 + self.epoch)
+            & 0xFFFFFFFF
+        )
+        #: (peer, after_round) pairs this member stops talking to
+        self._partitions: List[tuple] = []
+        self._delay_ms = 0.0
+        self._drops: List[float] = []
+        self._corrupt_left = 0
+        self.enacted: List[tuple] = []
+        for d in directives or []:
+            if int(d.get("epoch", 0)) != self.epoch:
+                continue
+            kind = d.get("kind")
+            if kind == "partition":
+                a, b = int(d.get("a", -1)), int(d.get("b", -1))
+                if self.member == a:
+                    self._partitions.append((b, int(d.get("after_round", 0))))
+                elif self.member == b:
+                    self._partitions.append((a, int(d.get("after_round", 0))))
+            elif int(d.get("member", -1)) != self.member:
+                continue
+            elif kind == "delay":
+                self._delay_ms += float(d.get("ms", 0.0))
+            elif kind == "drop":
+                self._drops.append(float(d.get("p", 0.0)))
+            elif kind == "corrupt":
+                self._corrupt_left += int(d.get("n", 1))
+
+    @property
+    def active(self) -> bool:
+        return bool(
+            self._partitions or self._delay_ms
+            or self._drops or self._corrupt_left
+        )
+
+    def partitioned(self, peer: int, round_no: int) -> bool:
+        return any(
+            int(peer) == p and int(round_no) >= after
+            for p, after in self._partitions
+        )
+
+    def on_send(
+        self, peer: int, round_no: int, payload: bytes
+    ) -> Optional[bytes]:
+        """The wire between this member and ``peer`` for one outgoing
+        frame: returns the bytes to actually send (possibly delayed or
+        corrupted), or None when the frame is swallowed (partition /
+        drop) — the sender then simply doesn't send, and the peer's io
+        deadline is what notices."""
+        if self.partitioned(peer, round_no):
+            self.enacted.append(("partition", int(round_no)))
+            return None
+        if any(float(self._rng.random()) < p for p in self._drops):
+            self.enacted.append(("drop", int(round_no)))
+            return None
+        if self._delay_ms > 0.0:
+            self.enacted.append(("delay", int(round_no)))
+            time.sleep(self._delay_ms / 1000.0)
+        if self._corrupt_left > 0:
+            self._corrupt_left -= 1
+            self.enacted.append(("corrupt", int(round_no)))
+            return corrupt_bytes(payload)
+        return payload

@@ -66,6 +66,7 @@ import sys
 import threading
 import time
 import traceback
+import zlib
 from pathlib import Path
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -87,7 +88,17 @@ _SCRUB_EXACT = ("XLA_FLAGS",)
 class GroupRevokedError(RuntimeError):
     """The current gang epoch is dead: a peer was lost mid-collective (or
     the rendezvous timed out). Not a payload bug — the worker reports the
-    revocation and waits for the re-formed epoch."""
+    revocation and waits for the re-formed epoch.
+
+    ``suspect`` (when the collective could attribute the failure) is the
+    stable member id of the peer this process blames — a non-root always
+    blames the star center, rank 0 blames the member on the failed
+    connection. ``stats`` is the collective's retransmit/CRC/slow-peer
+    tally at death. Both ride the worker's revoked report so the driver
+    can pick the victim by vote."""
+
+    suspect: Optional[int] = None
+    stats: Optional[Dict[str, Any]] = None
 
 
 class GangFailedError(RuntimeError):
@@ -162,24 +173,82 @@ class AllreduceGroup:
 
     Rank 0 binds ``port``, accepts ``world - 1`` connections, sums the
     incoming buffers and broadcasts the total; other ranks send and
-    receive. Every frame is ``(round, nbytes)`` + payload; a round-counter
-    mismatch means the members desynchronized (one resumed a different
-    iteration) and revokes the group rather than silently mixing
-    histograms from different trees. Any socket error — peer SIGKILL'd,
-    accept/connect timeout, short read — raises
-    :class:`GroupRevokedError` and marks the group ``revoked``.
+    receive. Every frame is ``(round, nbytes, crc32)`` + payload and is
+    acknowledged: the receiver verifies the payload CRC and answers ACK,
+    or NAK for a wire-corrupted frame, which the sender answers with a
+    bounded retransmit (``max_retransmits``) of the clean bytes — a
+    flipped bit degrades to one extra round trip instead of a corrupt
+    histogram. A round-counter mismatch means the members desynchronized
+    (one resumed a different iteration) and revokes the group rather
+    than silently mixing histograms from different trees.
+
+    Deadlines, not hangs: formation runs under ``timeout`` and every
+    per-round socket op under ``io_timeout``, so a partitioned or
+    alive-but-silent peer surfaces as ``socket.timeout`` within one io
+    window — including a dead star center, which every non-root notices
+    the same way (the coordinator-stall watchdog is nothing more than
+    this deadline plus blame: a non-root's ``suspect`` is always the
+    coordinator). Any socket error — peer SIGKILL'd, accept/connect
+    timeout, short read, retransmit exhaustion — raises
+    :class:`GroupRevokedError` carrying the suspected member and the
+    collective's stats, and marks the group ``revoked``.
+
+    ``member``/``members`` carry the *stable* supervisor ids (rank order)
+    so blame and chaos directives survive re-formation renumbering; a
+    hello frame after connect tells rank 0 which member each accepted
+    connection belongs to. ``chaos`` (a
+    :class:`~mmlspark_tpu.runtime.netchaos.NetChaos`) filters every
+    outgoing frame; ``slow_peer_s`` is the soft detection threshold — a
+    successful round that made rank 0 wait at least this long books the
+    peer into ``stats["slow_peers"]`` (the driver turns that into health
+    straggle bookings and ``PeerSlow`` events).
     """
 
-    _HDR = struct.Struct(">QQ")
+    _HDR = struct.Struct(">QQI")
+    _HELLO = struct.Struct(">Q")
+    _ACK, _NAK = b"\x06", b"\x15"
 
-    def __init__(self, rank: int, world: int, port: int, timeout: float = 30.0):
+    def __init__(
+        self,
+        rank: int,
+        world: int,
+        port: int,
+        timeout: float = 30.0,
+        io_timeout: Optional[float] = None,
+        member: Optional[int] = None,
+        members: Optional[Sequence[int]] = None,
+        chaos=None,
+        slow_peer_s: Optional[float] = None,
+        max_retransmits: int = 2,
+    ):
         self.rank, self.world, self.port = int(rank), int(world), int(port)
         self.timeout = float(timeout)
+        self.io_timeout = float(io_timeout if io_timeout is not None
+                                else timeout)
+        self.member = int(member if member is not None else rank)
+        self.members = [int(m) for m in (
+            members if members is not None else range(world)
+        )]
+        self.chaos = chaos
+        self.slow_peer_s = float(
+            slow_peer_s if slow_peer_s is not None else self.io_timeout / 2.0
+        )
+        self.max_retransmits = int(max_retransmits)
         self.revoked = False
         self.rounds = 0
+        #: member id this process blames for the revocation, when known
+        self.suspect: Optional[int] = None
+        self.stats: Dict[str, Any] = {
+            "retransmits": 0, "crc_drops": 0, "slow_peers": {},
+        }
         self._conns: List[socket.socket] = []
+        #: stable member id behind each entry of ``_conns`` (rank 0 learns
+        #: them from the hello frames; a non-root's single peer is the
+        #: coordinator)
+        self._peers: List[int] = []
         if self.world <= 1:
             return
+        coordinator = self.members[0]
         try:
             if self.rank == 0:
                 srv = socket.socket()
@@ -191,7 +260,12 @@ class AllreduceGroup:
                     for _ in range(self.world - 1):
                         conn, _ = srv.accept()
                         conn.settimeout(self.timeout)
+                        hello, = self._HELLO.unpack(
+                            self._recv_exact(conn, self._HELLO.size)
+                        )
+                        conn.settimeout(self.io_timeout)
                         self._conns.append(conn)
+                        self._peers.append(int(hello))
                 finally:
                     srv.close()
             else:
@@ -207,26 +281,83 @@ class AllreduceGroup:
                             raise
                         time.sleep(0.05)
                 conn.settimeout(self.timeout)
+                conn.sendall(self._HELLO.pack(self.member))
+                conn.settimeout(self.io_timeout)
                 self._conns.append(conn)
-        except OSError as e:
+                self._peers.append(coordinator)
+        except (OSError, ConnectionError, struct.error) as e:
+            if self.suspect is None and self.rank != 0:
+                self.suspect = coordinator
             self._die(f"group formation failed (rank {self.rank}): {e}")
 
     def _die(self, why: str) -> None:
         self.revoked = True
         self.close()
-        raise GroupRevokedError(why)
+        err = GroupRevokedError(why)
+        err.suspect = self.suspect
+        err.stats = dict(self.stats)
+        raise err
 
-    def _send(self, conn: socket.socket, buf: bytes) -> None:
-        conn.sendall(self._HDR.pack(self.rounds, len(buf)) + buf)
+    def _send(self, conn: socket.socket, peer: int, buf: bytes) -> None:
+        """One acknowledged frame to ``peer``: CRC over the clean bytes,
+        chaos applied after (so injected corruption is a genuine wire
+        flip), retransmit the clean copy on NAK up to
+        ``max_retransmits`` times."""
+        hdr = self._HDR.pack(
+            self.rounds, len(buf), zlib.crc32(buf) & 0xFFFFFFFF
+        )
+        for _ in range(self.max_retransmits + 1):
+            wire = buf
+            if self.chaos is not None:
+                wire = self.chaos.on_send(peer, self.rounds, buf)
+                if wire is None:
+                    # swallowed (partition/drop): nothing on the wire,
+                    # nothing to wait for — the peer's io deadline and
+                    # ours end this round
+                    return
+            conn.sendall(hdr + wire)
+            ack = self._recv_exact(conn, 1)
+            if ack == self._ACK:
+                return
+            self.stats["retransmits"] += 1
+        raise ConnectionError(
+            f"peer {peer} rejected frame {self.rounds} "
+            f"{self.max_retransmits + 1} times (CRC)"
+        )
 
-    def _recv(self, conn: socket.socket) -> bytes:
-        hdr = self._recv_exact(conn, self._HDR.size)
-        rnd, nbytes = self._HDR.unpack(hdr)
-        if rnd != self.rounds:
-            raise ConnectionError(
-                f"round mismatch: peer at {rnd}, local at {self.rounds}"
-            )
-        return self._recv_exact(conn, nbytes)
+    def _recv(self, conn: socket.socket, peer: int) -> bytes:
+        """One verified frame from ``peer``: NAK + re-read on CRC
+        mismatch, bounded like the send side."""
+        for _ in range(self.max_retransmits + 1):
+            hdr = self._recv_exact(conn, self._HDR.size)
+            rnd, nbytes, want = self._HDR.unpack(hdr)
+            if rnd != self.rounds:
+                raise ConnectionError(
+                    f"round mismatch: peer {peer} at {rnd}, "
+                    f"local at {self.rounds}"
+                )
+            payload = self._recv_exact(conn, nbytes)
+            if zlib.crc32(payload) & 0xFFFFFFFF == want:
+                conn.sendall(self._ACK)
+                return payload
+            self.stats["crc_drops"] += 1
+            conn.sendall(self._NAK)
+        raise ConnectionError(
+            f"frame from peer {peer} failed CRC "
+            f"{self.max_retransmits + 1} times"
+        )
+
+    def _timed_recv(self, conn: socket.socket, peer: int) -> bytes:
+        """A receive that also feeds the soft slow-peer detector: waits
+        that clear ``slow_peer_s`` (but still succeed) are remembered as
+        the peer's worst observed lag."""
+        t0 = time.monotonic()
+        data = self._recv(conn, peer)
+        wait = time.monotonic() - t0
+        if self.slow_peer_s > 0 and wait >= self.slow_peer_s:
+            slow = self.stats["slow_peers"]
+            slow[str(peer)] = max(float(slow.get(str(peer), 0.0)), wait)
+        return data
 
     @staticmethod
     def _recv_exact(conn: socket.socket, n: int) -> bytes:
@@ -247,24 +378,31 @@ class AllreduceGroup:
         if self.revoked:
             raise GroupRevokedError("allreduce on a revoked group")
         a = np.ascontiguousarray(arr, dtype=np.float32)
+        peer = -1
         try:
             if self.rank == 0:
                 total = a.copy()
-                for conn in self._conns:
+                for conn, peer in zip(self._conns, self._peers):
                     total += np.frombuffer(
-                        self._recv(conn), np.float32
+                        self._timed_recv(conn, peer), np.float32
                     ).reshape(a.shape)
                 buf = total.tobytes()
-                for conn in self._conns:
-                    self._send(conn, buf)
+                for conn, peer in zip(self._conns, self._peers):
+                    self._send(conn, peer, buf)
                 out = total
             else:
-                self._send(self._conns[0], a.tobytes())
+                peer = self._peers[0]
+                self._send(self._conns[0], peer, a.tobytes())
                 out = np.frombuffer(
-                    self._recv(self._conns[0]), np.float32
+                    self._timed_recv(self._conns[0], peer), np.float32
                 ).reshape(a.shape)
         except (OSError, ConnectionError, struct.error) as e:
-            self._die(f"allreduce round {self.rounds} failed: {e}")
+            self.suspect = peer if peer >= 0 else None
+            kind = "deadline" if isinstance(e, socket.timeout) else "error"
+            self._die(
+                f"allreduce round {self.rounds} failed "
+                f"({kind}, suspect member {self.suspect}): {e}"
+            )
         self.rounds += 1
         return out
 
@@ -279,6 +417,7 @@ class AllreduceGroup:
             except OSError:  # pragma: no cover - close is best-effort
                 pass
         self._conns = []
+        self._peers = []
 
 
 # -- worker side --------------------------------------------------------------
@@ -436,9 +575,26 @@ def _form_epoch(
             )
     group = None
     if world > 1:
+        chaos = None
+        net = spec.get("net_faults") or []
+        if net:
+            from mmlspark_tpu.runtime.netchaos import NetChaos
+
+            chaos = NetChaos(
+                net, member, int(spec.get("epoch", 0)),
+                seed=int(spec.get("net_seed", 0)),
+            )
+            if not chaos.active:
+                chaos = None
         group = AllreduceGroup(
             rank, world, int(spec["reduce_port"]),
             timeout=float(spec.get("group_timeout_s", 30.0)),
+            io_timeout=float(spec.get("io_timeout_s",
+                                      spec.get("group_timeout_s", 30.0))),
+            member=member,
+            members=[int(m) for m in spec["members"]],
+            chaos=chaos,
+            slow_peer_s=spec.get("slow_peer_s"),
         )
     if use_jax:
         from mmlspark_tpu.parallel.mesh import distributed_shutdown
@@ -502,12 +658,16 @@ def worker_main(workdir: str, member: int, start_epoch: int = 0) -> int:
                 if group is not None:
                     group.barrier()  # commit: the whole gang finished
                 _write_json(wd / f"done-{epoch}-{member}.json",
-                            {"ok": True, "result": result})
+                            {"ok": True, "result": result,
+                             "collective": dict(group.stats)
+                             if group is not None else {}})
             except GroupRevokedError as e:
                 logger.warning("member %d: epoch %d revoked: %s",
                                member, epoch, e)
                 _write_json(wd / f"revoked-{epoch}-{member}.json",
-                            {"reason": str(e)})
+                            {"reason": str(e),
+                             "suspect": getattr(e, "suspect", None),
+                             "stats": getattr(e, "stats", None) or {}})
             except Exception as e:  # noqa: BLE001 - payload bug: report + die
                 _write_json(wd / f"failed-{epoch}-{member}.json",
                             {"error": f"{type(e).__name__}: {e}",
@@ -604,6 +764,9 @@ class ProcessGroup:
         epoch_timeout_s: float = 300.0,
         rendezvous_timeout_s: float = 60.0,
         group_timeout_s: float = 15.0,
+        io_timeout_s: Optional[float] = None,
+        slow_peer_s: Optional[float] = None,
+        revoke_grace_s: float = 2.0,
         respawn: bool = True,
         max_epochs: int = 8,
         health: Optional[HealthTracker] = None,
@@ -627,6 +790,16 @@ class ProcessGroup:
         self.epoch_timeout_s = float(epoch_timeout_s)
         self.rendezvous_timeout_s = float(rendezvous_timeout_s)
         self.group_timeout_s = float(group_timeout_s)
+        #: per-round collective deadline — the bound on how long a
+        #: partitioned or silent peer can stall the gang before the
+        #: epoch revokes (defaults to the formation timeout)
+        self.io_timeout_s = float(
+            io_timeout_s if io_timeout_s is not None else group_timeout_s
+        )
+        self.slow_peer_s = slow_peer_s
+        #: how long to wait after the first revoked report for the rest
+        #: of the gang to file theirs, so victim selection sees every vote
+        self.revoke_grace_s = float(revoke_grace_s)
         self.respawn = bool(respawn)
         self.max_epochs = int(max_epochs)
         self.faults = faults if faults is not None else current_faults()
@@ -675,6 +848,15 @@ class ProcessGroup:
                 "Member processes lost (exit, signal, or heartbeat silence)"),
             "reforms": reg.counter(
                 "procgroup_reforms_total", "Gang recovery re-formations"),
+            "partitions": reg.counter(
+                "netchaos_partitions_total",
+                "Partition-triggered epoch revocations resolved"),
+            "retransmits": reg.counter(
+                "collective_retransmits_total",
+                "Allreduce frames retransmitted after a CRC rejection"),
+            "slow_peers": reg.counter(
+                "netchaos_slow_peers_total",
+                "Slow-peer detections booked from collective stats"),
         }
 
     def _publish(self, event) -> None:
@@ -774,6 +956,19 @@ class ProcessGroup:
                     pass
         return done
 
+    def _read_revoked(self, epoch: int) -> Dict[int, Any]:
+        """Members that reported epoch ``epoch`` revoked, with their
+        blame (``suspect``) and collective stats."""
+        revoked: Dict[int, Any] = {}
+        for member in self.members:
+            path = self.workdir / f"revoked-{epoch}-{member}.json"
+            if path.exists():
+                try:
+                    revoked[member] = json.loads(path.read_text())
+                except (OSError, json.JSONDecodeError):
+                    pass
+        return revoked
+
     def _write_spec(self, epoch: int) -> None:
         directives: List[dict] = []
         if self.faults is not None:
@@ -789,7 +984,14 @@ class ProcessGroup:
             "rendezvous": self.rendezvous,
             "rendezvous_timeout_s": self.rendezvous_timeout_s,
             "group_timeout_s": self.group_timeout_s,
+            "io_timeout_s": self.io_timeout_s,
+            "slow_peer_s": self.slow_peer_s,
         }
+        if self.faults is not None:
+            net = self.faults.net_directives(epoch)
+            if net:
+                spec["net_faults"] = net
+                spec["net_seed"] = self.faults.seed
         if spec["reduce_port"] == spec["coordinator_port"]:
             spec["reduce_port"] = pick_port(
                 seed=self.seed * 1000 + epoch * 2 + 7,
@@ -837,6 +1039,121 @@ class ProcessGroup:
         )
         return GangFailedError(message)
 
+    def _harvest_collective(
+        self, epoch: int, stats_by_member: Dict[int, dict]
+    ) -> None:
+        """Fold the gang's per-member collective stats into metrics,
+        events, health bookings, and fault-plan acknowledgements:
+        retransmits count toward ``collective_retransmits_total`` (and
+        consume a ``net_corrupt`` directive — the corruption fired and
+        was absorbed); slow peers become ``PeerSlow`` events plus health
+        straggle bookings (and consume a ``net_delay`` directive)."""
+        from mmlspark_tpu.observability import PeerSlow
+
+        for member in sorted(stats_by_member):
+            stats = stats_by_member[member] or {}
+            retrans = int(stats.get("retransmits", 0))
+            if retrans > 0:
+                self._metrics["retransmits"].inc(retrans)
+                if self.faults is not None:
+                    self.faults.mark_net_fired("corrupt", member, epoch)
+                logger.warning(
+                    "member %d absorbed %d retransmit(s) in epoch %d",
+                    member, retrans, epoch,
+                )
+            for peer, wait in sorted(
+                (stats.get("slow_peers") or {}).items()
+            ):
+                peer = int(peer)
+                self._metrics["slow_peers"].inc()
+                self.health.note_straggle(peer)
+                if self.faults is not None:
+                    self.faults.mark_net_fired("delay", peer, epoch)
+                self._publish(PeerSlow(
+                    member=peer, epoch=epoch, wait_s=float(wait),
+                ))
+                logger.warning(
+                    "member %d observed peer %d slow (%.3fs) in epoch %d",
+                    member, peer, float(wait), epoch,
+                )
+
+    def _pick_victim(self, epoch: int, revoked: Dict[int, Any]) -> int:
+        """Deterministic blame resolution for a no-corpse revocation:
+        every reporter names the peer its collective suspected (non-roots
+        always blame the star center, rank 0 blames the member on the
+        failed link); members that filed nothing within the grace window
+        are suspects by silence. Most votes loses; ties go to the
+        highest member id, so the coordinator survives a symmetric
+        two-member partition and the journal-holding rank 0 is kept."""
+        votes: Dict[int, int] = {}
+        for reporter, info in revoked.items():
+            suspect = info.get("suspect")
+            if suspect is None or int(suspect) == int(reporter):
+                continue
+            if int(suspect) in self.members:
+                votes[int(suspect)] = votes.get(int(suspect), 0) + 1
+        silent = [
+            m for m in self.members
+            if m not in revoked and m not in self._read_done(epoch)
+        ]
+        for m in silent:  # said nothing while the gang revoked around it
+            votes[m] = votes.get(m, 0) + 1
+        if not votes:
+            return max(self.members)
+        top = max(votes.values())
+        return max(m for m, n in votes.items() if n == top)
+
+    def _resolve_revocation(
+        self, epoch: int, revoked: Dict[int, Any]
+    ) -> List[ExitStatus]:
+        """Turn a partition-style revocation (every process alive, the
+        collective dead) into the loss the existing recovery path knows
+        how to handle: pick the blamed member, kill it, and book the
+        death with reason ``"partition"``. When a real corpse already
+        exists (the revocation was a peer noticing a SIGKILL) the corpse
+        is the loss and no extra member is killed."""
+        from mmlspark_tpu.observability import NetworkPartitioned
+        from mmlspark_tpu.observability.incidents import maybe_record
+        from mmlspark_tpu.observability.tracing import get_tracer
+
+        self._harvest_collective(epoch, {
+            m: info.get("stats") or {} for m, info in revoked.items()
+        })
+        losses = self._check_losses(epoch, self._read_done(epoch))
+        if losses:
+            return losses
+        victim = self._pick_victim(epoch, revoked)
+        handle = self._procs.get(victim)
+        pid, rc = -1, None
+        if handle is not None:
+            if handle.proc.poll() is None:
+                handle.proc.kill()
+                handle.proc.wait(timeout=10)
+            pid, rc = handle.pid, handle.proc.returncode
+        reasons = "; ".join(
+            f"m{m}: {info.get('reason', '?')}"
+            for m, info in sorted(revoked.items())
+        )
+        self._metrics["partitions"].inc()
+        if self.faults is not None:
+            for kind in ("partition", "drop"):
+                if self.faults.mark_net_fired(kind, victim, epoch):
+                    break
+        self._publish(NetworkPartitioned(
+            member=victim, epoch=epoch, reason=reasons,
+        ))
+        span = get_tracer().current()
+        maybe_record(
+            "network_partitioned",
+            trace_id=span.trace_id if span is not None else "",
+            detail=f"epoch {epoch} victim {victim}: {reasons}",
+        )
+        logger.warning(
+            "epoch %d revoked without a corpse; victim member %d "
+            "(votes from %s)", epoch, victim, sorted(revoked),
+        )
+        return [ExitStatus(victim, pid, rc, "partition", epoch)]
+
     def _run_epochs(self, poll: float) -> Dict[int, Any]:
         from mmlspark_tpu.observability import GroupReformed, ProcessLost
 
@@ -856,7 +1173,11 @@ class ProcessGroup:
                 return detail
             if outcome == "failed":
                 raise RuntimeError(detail)
-            # outcome == "lost": book the dead, decide membership, re-form
+            if outcome == "revoked":
+                # partition/slow-peer: resolve blame into a loss, then
+                # recover exactly as for a corpse
+                detail = self._resolve_revocation(epoch, detail)
+            # book the dead, decide membership, re-form
             losses: List[ExitStatus] = detail
             survivors = list(self.members)
             for loss in losses:
@@ -906,6 +1227,9 @@ class ProcessGroup:
                 bad = {m: d for m, d in done.items() if not d.get("ok")}
                 if bad:
                     return "failed", f"payload reported failure: {bad}"
+                self._harvest_collective(epoch, {
+                    m: d.get("collective") or {} for m, d in done.items()
+                })
                 return "ok", {m: d.get("result") for m, d in done.items()}
             for member in self.members:
                 path = self.workdir / f"failed-{epoch}-{member}.json"
@@ -926,6 +1250,24 @@ class ProcessGroup:
                 losses = self._check_losses(epoch, self._read_done(epoch))
                 if losses:
                     return "lost", losses
+            revoked = self._read_revoked(epoch)
+            if revoked:
+                # a partition/slow-peer revocation with every process
+                # still alive: give the rest of the gang a grace window
+                # to file their reports so victim selection sees all votes
+                grace = min(deadline, time.monotonic() + self.revoke_grace_s)
+                while time.monotonic() < grace:
+                    done = self._read_done(epoch)
+                    revoked = self._read_revoked(epoch)
+                    if all(
+                        m in done or m in revoked
+                        or (self._procs.get(m) is not None
+                            and self._procs[m].proc.poll() is not None)
+                        for m in self.members
+                    ):
+                        break
+                    time.sleep(poll)
+                return "revoked", self._read_revoked(epoch)
             if time.monotonic() >= deadline:
                 stuck = [m for m in self.members if m not in done]
                 losses = []

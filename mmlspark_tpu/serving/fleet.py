@@ -37,7 +37,11 @@ import urllib.request
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from mmlspark_tpu.core.profiling import get_logger
-from mmlspark_tpu.observability.events import FleetScaled, get_bus
+from mmlspark_tpu.observability.events import (
+    FleetScaled,
+    RegistryUnavailable,
+    get_bus,
+)
 from mmlspark_tpu.observability.registry import get_registry
 from mmlspark_tpu.serving.replicas import ReplicaSupervisor
 from mmlspark_tpu.serving.router import _parse_services
@@ -96,6 +100,11 @@ class FleetController:
         #: (total shed counter, at) from the previous pass — the shed RATE
         #: is a delta, cumulative counters never come back down
         self._last_shed: Optional[Tuple[int, float]] = None
+        #: last-known-good ``/services`` snapshot, used (stamped stale)
+        #: while the registry is unreachable so the control loop keeps
+        #: supervising instead of going blind
+        self._last_services: List[ServiceInfo] = []
+        self._stale = False
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -116,10 +125,18 @@ class FleetController:
     def _services(self) -> List[ServiceInfo]:
         if self._registry is not None:
             return list(self._registry.services)
-        with urllib.request.urlopen(
-            self._registry_url + "/services", timeout=5
-        ) as resp:
-            return _parse_services(json.loads(resp.read()))
+        url = self._registry_url + "/services"
+        # same net-chaos edge as the router's discovery fetch
+        from mmlspark_tpu.runtime.faults import check_net
+
+        net = check_net(url)
+        with urllib.request.urlopen(url, timeout=5) as resp:
+            raw = resp.read()
+        if net is not None and net.get("kind") == "corrupt":
+            from mmlspark_tpu.runtime.netchaos import corrupt_bytes
+
+            raw = corrupt_bytes(raw)
+        return _parse_services(json.loads(raw))
 
     def _federated(self, services: List[ServiceInfo]) -> List[ServiceInfo]:
         """Swap heartbeat load metadata for scraped signals where the
@@ -241,14 +258,40 @@ class FleetController:
         """One control pass: supervise (respawn the dead), read the
         registry, maybe scale. Returns the action taken, if any."""
         self.supervisor.poll()
+        stale = False
         try:
             services = self._services()
-        except Exception as e:  # noqa: BLE001 - registry briefly down
-            logger.warning("fleet controller lost the registry: %s", e)
-            return None
+            self._last_services = services
+            if self._stale:
+                self._stale = False
+                logger.info("fleet controller regained the registry")
+        except Exception as e:  # noqa: BLE001 - registry down/unreachable
+            # registry outage tolerance: keep steering on the last-known-
+            # good snapshot (stamped stale) — supervision and below-min
+            # respawn must not stop because discovery did
+            stale = True
+            if not self._stale:
+                self._stale = True
+                bus = get_bus()
+                if bus.active:
+                    bus.publish(RegistryUnavailable(
+                        source="controller",
+                        error=f"{type(e).__name__}: {e}",
+                        stale_replicas=len(self._last_services),
+                    ))
+            logger.warning(
+                "fleet controller lost the registry (%s); using stale "
+                "snapshot of %d lease(s)", e, len(self._last_services),
+            )
+            services = self._last_services
         if self.federator is not None:
             services = self._federated(services)
         decision = self.decide(services)
+        if stale and decision is not None and decision[0] == "down":
+            # stale load metadata can only look idle (nobody refreshed
+            # it); never retire live capacity on an outage artifact
+            logger.info("suppressing scale-down on stale registry snapshot")
+            decision = None
         if decision is None:
             self._m_replicas.set(self.supervisor.live_count)
             return None

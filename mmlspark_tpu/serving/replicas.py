@@ -25,12 +25,14 @@ from __future__ import annotations
 
 import json
 import os
+import random
 import subprocess
 import sys
 import threading
 import time
 import urllib.error
 import urllib.request
+import zlib
 from pathlib import Path
 from typing import Any, Dict, List, Optional
 
@@ -66,8 +68,14 @@ def demo_model_factory(spec: Dict[str, Any]):
 
 def _registry_post(base: str, path: str, payload: Dict[str, Any]) -> None:
     """One POST to the registration service (raises on HTTP error)."""
+    url = base.rstrip("/") + path
+    # net chaos on the replica->registry edge: a partition raises
+    # EHOSTUNREACH, a drop times out — the reporter's backoff path
+    from mmlspark_tpu.runtime.faults import check_net
+
+    check_net(url)
     req = urllib.request.Request(
-        base.rstrip("/") + path,
+        url,
         data=json.dumps(payload).encode(),
         method="POST",
         headers={"Content-Type": "application/json"},
@@ -75,28 +83,68 @@ def _registry_post(base: str, path: str, payload: Dict[str, Any]) -> None:
     urllib.request.urlopen(req, timeout=5).read()
 
 
+#: backoff ceiling, as a multiple of the heartbeat interval — a long
+#: registry outage settles into a slow, jittered probe, not a tight loop
+_BACKOFF_CAP_INTERVALS = 10.0
+
+
 def _registry_reporter(server, registry_url: str, interval_s: float,
                        stop_evt: threading.Event) -> None:
     """Replica-side lease loop: register once, then heartbeat the live
     load metadata (``heartbeat_stats``) every ``interval_s``. A 404 means
-    the lease expired (registry restart / TTL lapse while this process was
-    stalled) — re-register from scratch. A down registry never stops the
-    replica serving; the loop just retries next tick."""
+    the lease expired (registry restart without a lease journal / TTL
+    lapse while this process was stalled) — re-register from scratch. A
+    down registry never stops the replica serving; the loop retries with
+    seeded, jittered exponential backoff so a restarted registry gets the
+    fleet's re-registrations spread out, not as one burst, and publishes
+    :class:`~mmlspark_tpu.observability.events.RegistryUnavailable` once
+    per outage onset. Heartbeat periods themselves carry seeded ±20%
+    jitter (``MMLSPARK_TPU_FAULT_SEED`` + the replica name), so the fleet
+    never phase-locks."""
+    from mmlspark_tpu.observability.events import RegistryUnavailable, get_bus
+
+    seed = int(os.environ.get("MMLSPARK_TPU_FAULT_SEED", "0") or 0)
+    rng = random.Random(
+        seed * 1_000_003 + zlib.crc32(server.info.name.encode())
+    )
     registered = False
+    down = False
+    backoff = interval_s
     while not stop_evt.is_set():
         stats = server.heartbeat_stats()
+        wait = interval_s * (1.0 + 0.2 * (2.0 * rng.random() - 1.0))
         try:
             if not registered:
                 _registry_post(registry_url, "/register", stats)
                 registered = True
             else:
                 _registry_post(registry_url, "/heartbeat", stats)
+            if down:
+                down = False
+                logger.info("replica %s regained the registry",
+                            server.info.name)
+            backoff = interval_s
         except urllib.error.HTTPError as e:
             if e.code == 404:
+                # expired lease: re-register next tick, after a jittered
+                # backoff (the whole fleet may have expired at once)
                 registered = False
-        except Exception:  # noqa: BLE001 - registry down; keep serving
-            pass
-        stop_evt.wait(interval_s)
+                wait = backoff * (0.5 + rng.random())
+                backoff = min(backoff * 2.0,
+                              _BACKOFF_CAP_INTERVALS * interval_s)
+        except Exception as e:  # noqa: BLE001 - registry down; keep serving
+            if not down:
+                down = True
+                bus = get_bus()
+                if bus.active:
+                    bus.publish(RegistryUnavailable(
+                        source="replica", error=f"{type(e).__name__}: {e}",
+                    ))
+                logger.warning("replica %s lost the registry: %s",
+                               server.info.name, e)
+            wait = backoff * (0.5 + rng.random())
+            backoff = min(backoff * 2.0, _BACKOFF_CAP_INTERVALS * interval_s)
+        stop_evt.wait(wait)
 
 
 def _replica_main(workdir: str, index: int) -> int:

@@ -53,7 +53,11 @@ from typing import Any, Dict, List, Optional, Tuple
 from mmlspark_tpu.core.profiling import get_logger
 from mmlspark_tpu.io.http.clients import BREAKER_FAILURE_STATUSES, _do_request
 from mmlspark_tpu.io.http.schema import EntityData, HTTPRequestData
-from mmlspark_tpu.observability.events import RequestRouted, get_bus
+from mmlspark_tpu.observability.events import (
+    RegistryUnavailable,
+    RequestRouted,
+    get_bus,
+)
 from mmlspark_tpu.observability.registry import get_registry
 from mmlspark_tpu.observability.tracing import (
     TRACE_HEADER,
@@ -190,6 +194,14 @@ class FleetRouter:
         self._m_replicas = reg.gauge(
             "router_replicas", "Live replicas in the routing table"
         )
+        self._m_stale = reg.gauge(
+            "router_stale_table",
+            "1 while the routing table is last-known-good because the "
+            "registry is unreachable",
+        )
+        #: registry-outage latch: set on the first failed discovery so the
+        #: RegistryUnavailable event fires once per outage, not per poll
+        self._stale = False
         self._m_latency = reg.histogram(
             "router_latency_seconds", "Router end-to-end request latency"
         )
@@ -204,18 +216,58 @@ class FleetRouter:
 
     def refresh(self) -> List[ServiceInfo]:
         """Re-read ``/services`` into the routing table (also called by
-        the background discovery thread). Returns the new table."""
+        the background discovery thread). Returns the new table.
+
+        Registry-outage tolerant: any discovery failure — connection
+        refused, timeout, malformed or truncated ``/services`` JSON —
+        keeps the last-known-good table and stamps it stale
+        (``router_stale_table`` gauge, one
+        :class:`~mmlspark_tpu.observability.events.RegistryUnavailable`
+        event per outage onset). The discovery thread never crashes; the
+        router keeps answering from the stale table until the registry
+        comes back."""
         try:
             if self._registry is not None:
                 replicas = list(self._registry.services)
             else:
-                with urllib.request.urlopen(
-                    self._registry_url + "/services", timeout=5
-                ) as resp:
-                    replicas = _parse_services(json.loads(resp.read()))
+                url = self._registry_url + "/services"
+                # net chaos on the discovery edge: partitions/drops raise
+                # here (caught below -> stale table), corrupt garbles the
+                # body so json.loads fails the same way a truncated read
+                # off a dying registry would
+                from mmlspark_tpu.runtime.faults import check_net
+
+                net = check_net(url)
+                with urllib.request.urlopen(url, timeout=5) as resp:
+                    raw = resp.read()
+                if net is not None and net.get("kind") == "corrupt":
+                    from mmlspark_tpu.runtime.netchaos import corrupt_bytes
+
+                    raw = corrupt_bytes(raw)
+                replicas = _parse_services(json.loads(raw))
         except Exception as e:  # noqa: BLE001 - keep the last good table
-            logger.warning("service discovery failed: %s", e)
+            # warn once per outage onset; repeat polls log at DEBUG so a
+            # long outage doesn't flood the log at discovery frequency
+            log = logger.debug if self._stale else logger.warning
+            if not self._stale:
+                self._stale = True
+                self._m_stale.set(1)
+                bus = get_bus()
+                if bus.active:
+                    bus.publish(RegistryUnavailable(
+                        source="router",
+                        error=f"{type(e).__name__}: {e}",
+                        stale_replicas=len(self._replicas),
+                    ))
+            log(
+                "service discovery failed (%s); serving from stale table "
+                "of %d replica(s)", e, len(self._replicas),
+            )
             return self._replicas
+        if self._stale:
+            self._stale = False
+            self._m_stale.set(0)
+            logger.info("registry reachable again; routing table is fresh")
         # never route to ourselves (a router registered for visibility)
         replicas = [r for r in replicas if r.name != self.name]
         replicas.sort(key=lambda s: s.name)
@@ -225,7 +277,10 @@ class FleetRouter:
 
     def _discover_loop(self) -> None:
         while not self._discover_stop.wait(self.discovery_interval_s):
-            self.refresh()
+            try:
+                self.refresh()
+            except Exception:  # noqa: BLE001 - the poll thread must survive
+                logger.warning("discovery poll failed", exc_info=True)
 
     # -- replica choice ------------------------------------------------------
 

@@ -31,10 +31,13 @@ from __future__ import annotations
 
 import json
 import logging
+import os
 import queue
+import random
 import threading
 import time
 import uuid
+import zlib
 from dataclasses import dataclass, field
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable, Dict, List, Optional
@@ -45,6 +48,7 @@ from mmlspark_tpu.core.pipeline import Transformer
 from mmlspark_tpu.data.table import Table
 from mmlspark_tpu.observability.events import (
     BatchFormed,
+    LeaseRecovered,
     ModelSwapped,
     RequestServed,
     get_bus,
@@ -850,7 +854,18 @@ class RegistrationService:
     and a replica whose lease expires silently drops out of
     :attr:`services` — a crashed worker stops being discoverable without
     anyone deregistering it. ``ttl_s=None`` keeps the old everlasting
-    registrations."""
+    registrations.
+
+    With ``journal_dir`` set, the lease table is journaled to disk
+    (tmp+rename with a CRC sidecar — the
+    :class:`~mmlspark_tpu.runtime.journal.ModelStore` idiom) on every
+    register/deregister, and a restarted registry recovers the journaled
+    leases on construction with a fresh grace period — replicas keep
+    heartbeating as if nothing happened instead of re-registering from
+    scratch. Each recovered lease publishes a
+    :class:`~mmlspark_tpu.observability.events.LeaseRecovered` event."""
+
+    JOURNAL_NAME = "leases.json"
 
     def __init__(
         self,
@@ -858,14 +873,18 @@ class RegistrationService:
         port: int = 0,
         ttl_s: Optional[float] = None,
         clock: Callable[[], float] = time.monotonic,
+        journal_dir: Optional[str] = None,
     ):
         self._services: Dict[str, ServiceInfo] = {}
         #: service name -> last register/heartbeat time (the lease stamp)
         self._last_seen: Dict[str, float] = {}
         self.ttl_s = ttl_s
         self._clock = clock
+        self._journal_dir = journal_dir
         self._lock = threading.Lock()
         self._started_at = time.monotonic()
+        if journal_dir is not None:
+            self._recover_leases()
         registry = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -975,19 +994,99 @@ class RegistrationService:
         if self.ttl_s is None:
             return
         now = self._clock()
+        pruned = False
         for name, seen in list(self._last_seen.items()):
             if now - seen > self.ttl_s:
                 self._services.pop(name, None)
                 del self._last_seen[name]
+                pruned = True
                 logger.warning(
                     "service %r lease expired (no heartbeat for > %.1fs); "
                     "dropped from discovery", name, self.ttl_s,
                 )
+        if pruned:
+            self._journal_leases()
+
+    # -- lease journal (registry restart survival) ---------------------------
+
+    @property
+    def _journal_path(self) -> Optional[str]:
+        if self._journal_dir is None:
+            return None
+        return os.path.join(self._journal_dir, self.JOURNAL_NAME)
+
+    def _journal_leases(self) -> None:
+        """Snapshot the lease table to disk. Caller holds ``self._lock``.
+        Written on register/deregister (membership changes), not on every
+        heartbeat: recovery re-stamps each lease with a fresh grace
+        period anyway, so journaling the refresh times would buy nothing
+        but an fsync per heartbeat."""
+        path = self._journal_path
+        if path is None:
+            return
+        from mmlspark_tpu.runtime.journal import _atomic_write
+
+        payload = json.dumps({
+            "saved_at": time.time(),
+            "leases": [vars(s) for s in self._services.values()],
+        }).encode()
+        try:
+            os.makedirs(self._journal_dir, exist_ok=True)
+            _atomic_write(path, payload)
+            _atomic_write(path + ".crc", f"{zlib.crc32(payload):08x}".encode())
+        except OSError:
+            logger.warning("lease journal write failed", exc_info=True)
+
+    def _recover_leases(self) -> None:
+        """Reload journaled leases after a registry restart. Every
+        recovered lease gets a fresh ``_last_seen`` stamp — the grace
+        period restarts, giving live replicas one full TTL to land their
+        next heartbeat before the lease can expire."""
+        path = self._journal_path
+        if path is None or not os.path.exists(path):
+            return
+        try:
+            with open(path, "rb") as f:
+                payload = f.read()
+            with open(path + ".crc", "rb") as f:
+                want = f.read().decode().strip()
+            if f"{zlib.crc32(payload):08x}" != want:
+                logger.warning(
+                    "lease journal CRC mismatch; discarding %s", path
+                )
+                return
+            doc = json.loads(payload)
+        except (OSError, ValueError) as e:
+            logger.warning("lease journal unreadable (%s); starting empty", e)
+            return
+        age_s = max(0.0, time.time() - float(doc.get("saved_at", 0.0)))
+        bus = get_bus()
+        for rec in doc.get("leases", []):
+            try:
+                svc = ServiceInfo(
+                    str(rec["name"]), str(rec["host"]), int(rec["port"]),
+                    model_version=rec.get("model_version"),
+                    **{k: rec[k] for k in _LOAD_FIELDS if rec.get(k) is not None},
+                )
+            except (KeyError, TypeError, ValueError):
+                continue
+            self._services[svc.name] = svc
+            self._last_seen[svc.name] = self._clock()
+            if bus.active:
+                bus.publish(LeaseRecovered(
+                    name=svc.name, url=svc.url, age_s=age_s,
+                ))
+        if self._services:
+            logger.info(
+                "recovered %d journaled lease(s) (%.1fs old) from %s",
+                len(self._services), age_s, path,
+            )
 
     def register(self, svc: ServiceInfo) -> None:
         with self._lock:
             self._services[svc.name] = svc
             self._last_seen[svc.name] = self._clock()
+            self._journal_leases()
 
     def heartbeat(
         self,
@@ -1026,7 +1125,10 @@ class RegistrationService:
         another request. False when the name was not registered."""
         with self._lock:
             self._last_seen.pop(name, None)
-            return self._services.pop(name, None) is not None
+            dropped = self._services.pop(name, None) is not None
+            if dropped:
+                self._journal_leases()
+            return dropped
 
     def start(self) -> "RegistrationService":
         threading.Thread(target=self._httpd.serve_forever, daemon=True).start()
@@ -1074,6 +1176,7 @@ class DistributedServingServer:
         **kwargs,
     ):
         self.drain_timeout_s = float(drain_timeout_s)
+        self._name = name
         #: lease-refresh cadence against a TTL'd RegistrationService;
         #: None disables the heartbeat thread
         self.registry_heartbeat_s = registry_heartbeat_s
@@ -1179,7 +1282,17 @@ class DistributedServingServer:
                     return
 
     def _heartbeat_loop(self) -> None:
-        while not self._hb_stop.wait(self.registry_heartbeat_s):
+        # seeded per-replica jitter (±20% of the period) de-synchronizes a
+        # fleet's lease refreshes: after a registry restart every replica
+        # would otherwise heartbeat in the same instant, and the recovered
+        # registry would eat the whole fleet's refresh as one burst
+        seed = int(os.environ.get("MMLSPARK_TPU_FAULT_SEED", "0") or 0)
+        rng = random.Random(seed * 1_000_003 + zlib.crc32(self._name.encode()))
+        while True:
+            period = self.registry_heartbeat_s
+            wait = period * (1.0 + 0.2 * (2.0 * rng.random() - 1.0))
+            if self._hb_stop.wait(wait):
+                return
             try:
                 self._heartbeat_once()
             except Exception:

@@ -374,18 +374,100 @@ class TestBareExceptPolicy:
 
 
 # ---------------------------------------------------------------------------
+# socket-deadline-policy
+# ---------------------------------------------------------------------------
+
+
+class TestSocketDeadlinePolicy:
+    PATH = "mmlspark_tpu/serving/fake.py"  # rule only applies there
+
+    def test_flags_urlopen_without_timeout(self):
+        src = (
+            "import urllib.request\n"
+            "def f(url):\n"
+            "    return urllib.request.urlopen(url).read()\n"
+        )
+        assert rules_of(
+            lint_source(src, path=self.PATH,
+                        select=["socket-deadline-policy"])
+        ) == ["socket-deadline-policy"]
+
+    def test_urlopen_with_timeout_ok(self):
+        src = (
+            "import urllib.request\n"
+            "def f(url):\n"
+            "    return urllib.request.urlopen(url, timeout=5).read()\n"
+        )
+        assert lint_source(
+            src, path=self.PATH, select=["socket-deadline-policy"]
+        ) == []
+
+    def test_flags_create_connection_without_timeout(self):
+        src = (
+            "import socket\n"
+            "def f(port):\n"
+            "    return socket.create_connection(('127.0.0.1', port))\n"
+        )
+        assert rules_of(
+            lint_source(src, path=self.PATH,
+                        select=["socket-deadline-policy"])
+        ) == ["socket-deadline-policy"]
+
+    def test_create_connection_with_timeout_ok(self):
+        src = (
+            "import socket\n"
+            "def f(port):\n"
+            "    return socket.create_connection(('x', port), timeout=1.0)\n"
+        )
+        assert lint_source(
+            src, path=self.PATH, select=["socket-deadline-policy"]
+        ) == []
+
+    def test_flags_settimeout_none(self):
+        src = (
+            "def f(conn):\n"
+            "    conn.settimeout(None)\n"
+        )
+        assert rules_of(
+            lint_source(src, path="mmlspark_tpu/runtime/fake.py",
+                        select=["socket-deadline-policy"])
+        ) == ["socket-deadline-policy"]
+
+    def test_settimeout_value_ok(self):
+        src = (
+            "def f(conn):\n"
+            "    conn.settimeout(30.0)\n"
+        )
+        assert lint_source(
+            src, path=self.PATH, select=["socket-deadline-policy"]
+        ) == []
+
+    def test_outside_runtime_serving_not_flagged(self):
+        src = (
+            "import urllib.request\n"
+            "def f(url):\n"
+            "    return urllib.request.urlopen(url).read()\n"
+        )
+        assert lint_source(
+            src, path="mmlspark_tpu/ops/fake.py",
+            select=["socket-deadline-policy"],
+        ) == []
+
+
+# ---------------------------------------------------------------------------
 # driver / registry / CLI
 # ---------------------------------------------------------------------------
 
 
 class TestDriver:
-    def test_all_five_rules_registered(self):
+    def test_all_builtin_rules_registered(self):
         assert set(all_rules()) == {
             "jit-purity",
             "numpy-in-traced-code",
             "pallas-tile-alignment",
             "lock-discipline",
             "bare-except-policy",
+            "socket-deadline-policy",
         }
 
     def test_bare_disable_silences_all(self):

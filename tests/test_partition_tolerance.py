@@ -1,0 +1,474 @@
+"""Partition-tolerant coordination tests (PR: network chaos + collective
+timeouts + registry-outage-tolerant serving).
+
+In-process coverage of the three planes:
+
+- **network chaos plane**: ``FaultPlan.net_*`` directive registration,
+  epoch scoping, driver-side ``mark_net_fired`` acknowledgement, the
+  HTTP-edge ``check_net`` enactment, and :class:`NetChaos` seeded
+  determinism;
+- **collective plane**: the CRC-framed, acknowledged allreduce — injected
+  wire corruption is absorbed by a bounded retransmit with byte-identical
+  results, and a partition surfaces as :class:`GroupRevokedError` with
+  blame within the io deadline on BOTH sides (threads standing in for
+  processes, as in ``test_procgroup.py``);
+- **registry plane**: lease journaling + recovery across a registry
+  restart (``LeaseRecovered`` events, CRC-guarded journal), FakeClock
+  lease expiry, and the router's stale-table behavior under connection
+  refusal, malformed/truncated ``/services`` JSON, and corrupted bodies.
+"""
+
+import json
+import socket
+import threading
+import zlib
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.observability.events import (
+    LeaseRecovered,
+    RegistryUnavailable,
+    get_bus,
+)
+from mmlspark_tpu.runtime.faults import FaultPlan, check_net, inject_faults
+from mmlspark_tpu.runtime.netchaos import NetChaos, corrupt_bytes
+from mmlspark_tpu.runtime.procgroup import (
+    AllreduceGroup,
+    GroupRevokedError,
+    pick_port,
+)
+from mmlspark_tpu.serving.router import FleetRouter
+from mmlspark_tpu.serving.server import RegistrationService, ServiceInfo
+
+
+class _Capture:
+    """Event-bus listener collecting events by type name."""
+
+    def __init__(self, *types):
+        self.types = types
+        self.events = []
+
+    def __call__(self, event):
+        if not self.types or isinstance(event, self.types):
+            self.events.append(event)
+
+    def __enter__(self):
+        get_bus().add_listener(self)
+        return self
+
+    def __exit__(self, *exc):
+        get_bus().remove_listener(self)
+
+
+# ---------------------------------------------------------------------------
+# network chaos plane
+# ---------------------------------------------------------------------------
+
+
+class TestNetDirectives:
+    def test_gang_directives_are_epoch_scoped(self):
+        plan = (
+            FaultPlan(seed=1)
+            .net_partition(0, 1, epoch=0, after_round=2)
+            .net_corrupt(1, n=3, epoch=1)
+        )
+        assert plan.net_directives(0) == [{
+            "target": "gang", "kind": "partition", "a": 0, "b": 1,
+            "epoch": 0, "after_round": 2,
+        }]
+        assert [d["kind"] for d in plan.net_directives(1)] == ["corrupt"]
+        assert len(plan.net_directives()) == 2
+        assert plan.pending == 2
+
+    def test_mark_net_fired_pops_and_books(self):
+        plan = FaultPlan(seed=1).net_partition(0, 1, epoch=0)
+        # either involved member acknowledges the partition
+        assert plan.mark_net_fired("partition", member=1, epoch=0)
+        assert not plan.mark_net_fired("partition", member=1, epoch=0)
+        assert plan.fired == [("net_partition", 1, 0)]
+        assert plan.pending == 0
+
+    def test_mark_net_fired_respects_kind_and_epoch(self):
+        plan = FaultPlan(seed=1).net_delay(1, ms=50.0, epoch=2)
+        assert not plan.mark_net_fired("partition", member=1, epoch=2)
+        assert not plan.mark_net_fired("delay", member=1, epoch=0)
+        assert plan.mark_net_fired("delay", member=1, epoch=2)
+
+    def test_http_partition_raises_unreachable(self):
+        plan = FaultPlan(seed=1).net_partition("registry:1234")
+        with inject_faults(plan):
+            with pytest.raises(OSError, match="partition"):
+                check_net("http://registry:1234/services")
+            # consumed: the next call passes clean
+            assert check_net("http://registry:1234/services") is None
+        assert plan.fired == [("net_partition", 0, 0)]
+
+    def test_http_drop_times_out_and_corrupt_passes_through(self):
+        plan = (
+            FaultPlan(seed=1)
+            .net_drop("svc-a", p=1.0)
+            .net_corrupt("svc-b", n=1)
+        )
+        with inject_faults(plan):
+            with pytest.raises(socket.timeout):
+                check_net("http://svc-a/predict")
+            directive = check_net("http://svc-b/predict")
+            assert directive["kind"] == "corrupt"
+            assert check_net("http://unrelated/") is None
+        assert [f[0] for f in plan.fired] == ["net_drop", "net_corrupt"]
+
+    def test_unmatched_url_untouched(self):
+        plan = FaultPlan(seed=1).net_partition("registry")
+        with inject_faults(plan):
+            assert check_net("http://replica-0:9/predict") is None
+        assert plan.pending == 1
+
+
+class TestNetChaos:
+    def test_corrupt_bytes_preserves_length_and_differs(self):
+        data = b"\x00\x01\x02payload"
+        garbled = corrupt_bytes(data)
+        assert len(garbled) == len(data)
+        assert garbled != data
+        assert corrupt_bytes(b"") == b""
+
+    def test_partition_applies_after_round(self):
+        directives = FaultPlan(seed=0).net_partition(
+            0, 1, epoch=0, after_round=1
+        ).net_directives(0)
+        chaos = NetChaos(directives, member=0, epoch=0, seed=7)
+        assert chaos.active
+        assert not chaos.partitioned(1, 0)
+        assert chaos.partitioned(1, 1)
+        assert chaos.on_send(1, 0, b"x") == b"x"
+        assert chaos.on_send(1, 1, b"x") is None
+
+    def test_partition_is_symmetric_and_scoped(self):
+        directives = FaultPlan(seed=0).net_partition(0, 1).net_directives(0)
+        for member, peer in ((0, 1), (1, 0)):
+            chaos = NetChaos(directives, member=member, epoch=0, seed=7)
+            assert chaos.on_send(peer, 0, b"x") is None
+        # a third member is unaffected
+        chaos2 = NetChaos(directives, member=2, epoch=0, seed=7)
+        assert not chaos2.active
+        assert chaos2.on_send(0, 0, b"x") == b"x"
+
+    def test_corrupt_budget_is_bounded(self):
+        directives = FaultPlan(seed=0).net_corrupt(1, n=1).net_directives(0)
+        chaos = NetChaos(directives, member=1, epoch=0, seed=3)
+        first = chaos.on_send(0, 0, b"payload!")
+        second = chaos.on_send(0, 0, b"payload!")
+        assert first != b"payload!"
+        assert second == b"payload!"
+
+    def test_drop_is_seed_deterministic(self):
+        directives = FaultPlan(seed=0).net_drop(0, p=0.5).net_directives(0)
+
+        def outcomes(seed):
+            chaos = NetChaos(directives, member=0, epoch=0, seed=seed)
+            return [chaos.on_send(1, r, b"f") is None for r in range(32)]
+
+        assert outcomes(5) == outcomes(5)
+        assert any(outcomes(5))
+        assert not all(outcomes(5))
+
+    def test_wrong_epoch_or_member_is_inert(self):
+        directives = FaultPlan(seed=0).net_delay(
+            1, ms=5.0, epoch=3
+        ).net_directives()
+        assert not NetChaos(directives, member=1, epoch=0, seed=1).active
+        assert not NetChaos(directives, member=0, epoch=3, seed=1).active
+        assert NetChaos(directives, member=1, epoch=3, seed=1).active
+
+
+# ---------------------------------------------------------------------------
+# collective plane
+# ---------------------------------------------------------------------------
+
+
+class TestCollectiveRobustness:
+    def _run_pair(self, port, chaos_by_member, io_timeout=5.0, rounds=2,
+                  max_retransmits=2):
+        """Two members (threads), optional per-member NetChaos. Returns
+        (results, errors, groups)."""
+        results = {}
+        errors = {}
+        groups = {}
+
+        def member(rank):
+            g = AllreduceGroup(
+                rank, 2, port, timeout=15.0, io_timeout=io_timeout,
+                member=rank, members=[0, 1],
+                chaos=chaos_by_member.get(rank),
+                max_retransmits=max_retransmits,
+            )
+            groups[rank] = g
+            try:
+                out = []
+                for _ in range(rounds):
+                    out.append(np.asarray(
+                        g.allreduce(np.full(8, float(rank + 1), np.float32))
+                    ))
+                results[rank] = out
+            except GroupRevokedError as e:
+                errors[rank] = e
+            finally:
+                g.close()
+
+        threads = [threading.Thread(target=member, args=(r,)) for r in (0, 1)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60.0)
+        assert not any(t.is_alive() for t in threads), "collective hung"
+        return results, errors, groups
+
+    def test_corrupt_frame_absorbed_by_retransmit(self):
+        directives = FaultPlan(seed=0).net_corrupt(1, n=1).net_directives(0)
+        chaos = NetChaos(directives, member=1, epoch=0, seed=11)
+        port = pick_port(seed=210)
+        results, errors, groups = self._run_pair(port, {1: chaos})
+        assert not errors
+        for rank in (0, 1):
+            for arr in results[rank]:
+                np.testing.assert_array_equal(arr, np.full(8, 3.0, np.float32))
+        # sender books the retransmit, receiver the CRC drop
+        assert groups[1].stats["retransmits"] == 1
+        assert groups[0].stats["crc_drops"] == 1
+
+    def test_retransmit_exhaustion_revokes(self):
+        # infinite corruption budget: every send garbled, NAKs exhaust
+        directives = FaultPlan(seed=0).net_corrupt(
+            1, n=1000
+        ).net_directives(0)
+        chaos = NetChaos(directives, member=1, epoch=0, seed=11)
+        port = pick_port(seed=211)
+        results, errors, groups = self._run_pair(
+            port, {1: chaos}, io_timeout=3.0, rounds=1, max_retransmits=1
+        )
+        assert 1 in errors  # the corrupting sender runs out of retries
+        assert not any(t for t in results.get(1, []))
+
+    def test_partition_revokes_both_sides_with_blame(self):
+        plan = FaultPlan(seed=0).net_partition(0, 1, epoch=0, after_round=1)
+        directives = plan.net_directives(0)
+        chaos = {
+            r: NetChaos(directives, member=r, epoch=0, seed=13)
+            for r in (0, 1)
+        }
+        port = pick_port(seed=212)
+        results, errors, groups = self._run_pair(
+            port, chaos, io_timeout=1.0, rounds=2
+        )
+        # round 0 completed, round 1 partitioned: no results, both revoked
+        assert set(errors) == {0, 1}
+        assert errors[0].suspect == 1  # rank 0 blames its silent peer
+        assert errors[1].suspect == 0  # non-root blames the coordinator
+        assert errors[1].stats is not None
+
+    def test_formation_timeout_blames_coordinator(self):
+        port = pick_port(seed=213)
+        with pytest.raises(GroupRevokedError) as exc_info:
+            AllreduceGroup(
+                1, 2, port, timeout=1.0, member=1, members=[0, 1]
+            )
+        assert exc_info.value.suspect == 0
+
+
+# ---------------------------------------------------------------------------
+# registry plane
+# ---------------------------------------------------------------------------
+
+
+class FakeClock:
+    def __init__(self, t=100.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+class TestLeaseExpiryFakeClock:
+    def test_lease_expires_without_heartbeat(self):
+        clock = FakeClock()
+        reg = RegistrationService(ttl_s=5.0, clock=clock).start()
+        reg.register(ServiceInfo("r-0", "127.0.0.1", 9000))
+        assert [s.name for s in reg.services] == ["r-0"]
+        clock.advance(5.1)
+        assert reg.services == []
+        # an expired lease's heartbeat is rejected: re-register required
+        assert not reg.heartbeat("r-0")
+        reg.stop()
+
+    def test_heartbeat_extends_lease(self):
+        clock = FakeClock()
+        reg = RegistrationService(ttl_s=5.0, clock=clock).start()
+        reg.register(ServiceInfo("r-0", "127.0.0.1", 9000))
+        clock.advance(4.0)
+        assert reg.heartbeat("r-0", inflight=3)
+        clock.advance(4.0)  # 8s after register, 4s after heartbeat
+        assert [s.name for s in reg.services] == ["r-0"]
+        assert reg.services[0].inflight == 3
+        reg.stop()
+
+
+class TestLeaseJournal:
+    def test_restart_recovers_leases_with_events(self, tmp_path):
+        jd = str(tmp_path / "registry")
+        first = RegistrationService(ttl_s=30.0, journal_dir=jd).start()
+        first.register(ServiceInfo(
+            "r-0", "127.0.0.1", 9100, model_version=3, inflight=2,
+        ))
+        first.register(ServiceInfo("r-1", "127.0.0.1", 9101))
+        first.stop()
+
+        with _Capture(LeaseRecovered) as cap:
+            clock = FakeClock()
+            second = RegistrationService(
+                ttl_s=30.0, clock=clock, journal_dir=jd
+            ).start()
+        names = sorted(s.name for s in second.services)
+        assert names == ["r-0", "r-1"]
+        svc = {s.name: s for s in second.services}["r-0"]
+        assert svc.model_version == 3 and svc.inflight == 2
+        assert sorted(e.name for e in cap.events) == ["r-0", "r-1"]
+        assert all(e.age_s >= 0.0 for e in cap.events)
+        # the recovered lease got a FRESH grace period, so a replica that
+        # keeps heartbeating never has to re-register from scratch
+        clock.advance(29.0)
+        assert second.heartbeat("r-0")
+        second.stop()
+
+    def test_deregister_drops_from_journal(self, tmp_path):
+        jd = str(tmp_path / "registry")
+        first = RegistrationService(journal_dir=jd).start()
+        first.register(ServiceInfo("r-0", "127.0.0.1", 9100))
+        first.register(ServiceInfo("r-1", "127.0.0.1", 9101))
+        first.deregister("r-0")
+        first.stop()
+        second = RegistrationService(journal_dir=jd).start()
+        assert [s.name for s in second.services] == ["r-1"]
+        second.stop()
+
+    def test_corrupt_journal_discarded(self, tmp_path):
+        jd = tmp_path / "registry"
+        first = RegistrationService(journal_dir=str(jd)).start()
+        first.register(ServiceInfo("r-0", "127.0.0.1", 9100))
+        first.stop()
+        path = jd / RegistrationService.JOURNAL_NAME
+        payload = path.read_bytes()
+        path.write_bytes(payload[:-4] + b"!!!!")  # torn write
+        assert f"{zlib.crc32(path.read_bytes()):08x}" != \
+            (jd / (RegistrationService.JOURNAL_NAME + ".crc")).read_text()
+        second = RegistrationService(journal_dir=str(jd)).start()
+        assert second.services == []  # discarded, started empty
+        second.stop()
+
+    def test_no_journal_dir_keeps_old_behavior(self, tmp_path):
+        reg = RegistrationService()
+        reg.register(ServiceInfo("r-0", "127.0.0.1", 9100))
+        assert reg._journal_path is None
+        reg._httpd.server_close()
+
+
+class _RawServer:
+    """HTTP server answering GET /services with fixed raw bytes."""
+
+    def __init__(self, raw: bytes):
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(outer.raw)))
+                self.end_headers()
+                self.wfile.write(outer.raw)
+
+            def log_message(self, *args):
+                pass
+
+        self.raw = raw
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.url = f"http://127.0.0.1:{self._httpd.server_address[1]}"
+
+    def __enter__(self):
+        threading.Thread(
+            target=self._httpd.serve_forever, daemon=True
+        ).start()
+        return self
+
+    def __exit__(self, *exc):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+class TestRouterRegistryOutage:
+    def _table(self):
+        return [{"name": "r-0", "host": "127.0.0.1", "port": 9200}]
+
+    def test_connection_refused_keeps_stale_table(self):
+        with socket.socket() as probe:
+            probe.bind(("127.0.0.1", 0))
+            dead_port = probe.getsockname()[1]
+        router = FleetRouter(registry_url=f"http://127.0.0.1:{dead_port}")
+        router._replicas = [ServiceInfo("r-0", "127.0.0.1", 9200)]
+        with _Capture(RegistryUnavailable) as cap:
+            table = router.refresh()
+            router.refresh()  # second failure: same outage, no new event
+        assert [s.name for s in table] == ["r-0"]
+        assert router._stale
+        assert len(cap.events) == 1
+        assert cap.events[0].source == "router"
+        assert cap.events[0].stale_replicas == 1
+        router._httpd.server_close()
+
+    def test_malformed_json_keeps_stale_table(self):
+        with _RawServer(b'[{"name": "r-1", truncated') as srv:
+            router = FleetRouter(registry_url=srv.url)
+            router._replicas = [ServiceInfo("r-0", "127.0.0.1", 9200)]
+            assert [s.name for s in router.refresh()] == ["r-0"]
+            assert router._stale
+            router._httpd.server_close()
+
+    def test_corrupted_body_via_net_chaos_keeps_stale_table(self):
+        with _RawServer(json.dumps(self._table()).encode()) as srv:
+            router = FleetRouter(registry_url=srv.url)
+            plan = FaultPlan(seed=1).net_corrupt(srv.url, n=1)
+            with inject_faults(plan):
+                router._replicas = [ServiceInfo("r-9", "127.0.0.1", 9300)]
+                assert [s.name for s in router.refresh()] == ["r-9"]
+                assert router._stale
+                # chaos budget spent: next poll recovers the real table
+                assert [s.name for s in router.refresh()] == ["r-0"]
+                assert not router._stale
+            assert plan.fired == [("net_corrupt", 0, 0)]
+            router._httpd.server_close()
+
+    def test_discovery_thread_survives_outage(self):
+        with _RawServer(b"not json at all") as srv:
+            router = FleetRouter(
+                registry_url=srv.url, discovery_interval_s=0.02
+            )
+            router.start()
+            try:
+                import time
+
+                time.sleep(0.2)  # many failing polls
+                assert router._discover_thread.is_alive()
+                assert router._stale
+            finally:
+                router.stop()
+
+    def test_recovery_clears_stale_flag(self):
+        with _RawServer(json.dumps(self._table()).encode()) as srv:
+            router = FleetRouter(registry_url=srv.url)
+            router._stale = True
+            router._m_stale.set(1)
+            assert [s.name for s in router.refresh()] == ["r-0"]
+            assert not router._stale
+            router._httpd.server_close()

@@ -30,6 +30,12 @@ followed by a degradation event (``HistogramDegraded``, a
 ``memory_pressure`` ``RequestShed``, an ``oom`` ``TaskRetried``) or the
 matching recovery record (same event type, level == "ok").
 
+``--partition`` additionally asserts the partition-recovery contract:
+every ``NetworkPartitioned`` onset must be followed by a
+``GroupReformed`` (the gang revoked the partitioned member and re-formed
+without it) — a partition that never re-forms is a hang the collective
+deadline failed to break.
+
 Exit status 0 with a one-line summary when the log is clean; 1 with one
 diagnostic per bad line otherwise (CI gates on this; see the
 ``observability`` and ``fleet-chaos`` jobs in .github/workflows/ci.yml).
@@ -199,6 +205,37 @@ def check_pressure_pairing(
     return problems, summary
 
 
+def check_partition_pairing(
+    records: typing.List[dict],
+) -> typing.Tuple[typing.List[str], str]:
+    """(problems, summary) for the partition-recovery contract over a
+    decoded record stream: every NetworkPartitioned onset must be
+    followed by a GroupReformed — the driver revoked the partitioned
+    member and the surviving gang re-formed. An onset with no subsequent
+    re-formation means the fit hung or died inside the partition."""
+    onsets: typing.List[typing.Tuple[int, dict]] = []
+    reformed: typing.List[int] = []
+    for i, rec in enumerate(records):
+        kind = rec.get("event")
+        if kind == "NetworkPartitioned":
+            onsets.append((i, rec))
+        elif kind == "GroupReformed":
+            reformed.append(i)
+    problems = []
+    paired = 0
+    for idx, rec in onsets:
+        if any(j > idx for j in reformed):
+            paired += 1
+        else:
+            problems.append(
+                f"NetworkPartitioned onset (member={rec.get('member')}, "
+                f"epoch={rec.get('epoch')}) has no subsequent GroupReformed "
+                f"— the gang never recovered from the partition"
+            )
+    summary = f"partition pairing: {paired}/{len(onsets)} onsets paired"
+    return problems, summary
+
+
 def main(argv: typing.Optional[typing.List[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="tools/check_eventlog.py",
@@ -216,6 +253,11 @@ def main(argv: typing.Optional[typing.List[str]] = None) -> int:
         "--pressure", action="store_true",
         help="also assert every MemoryPressure/DiskPressure onset pairs "
              "with a later degradation or recovery event",
+    )
+    parser.add_argument(
+        "--partition", action="store_true",
+        help="also assert every NetworkPartitioned onset pairs with a "
+             "later GroupReformed (the gang recovered)",
     )
     args = parser.parse_args(argv)
     path = args.eventlog
@@ -261,6 +303,12 @@ def main(argv: typing.Optional[typing.List[str]] = None) -> int:
         summaries.append(summary)
     if args.pressure:
         problems, summary = check_pressure_pairing(valid_records)
+        for p in problems:
+            print(f"{path}: {p}", file=sys.stderr)
+        bad += len(problems)
+        summaries.append(summary)
+    if args.partition:
+        problems, summary = check_partition_pairing(valid_records)
         for p in problems:
             print(f"{path}: {p}", file=sys.stderr)
         bad += len(problems)

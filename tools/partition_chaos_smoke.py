@@ -1,0 +1,279 @@
+#!/usr/bin/env python
+"""Network-partition chaos smoke (CI: partition-chaos).
+
+Runs the partition-tolerance tentpole end to end with REAL processes,
+driven by the seeded fault plan (``MMLSPARK_TPU_FAULT_SEED`` pins every
+chaos decision):
+
+  1. a clean 2-process histogram-allreduce fit — the baseline model;
+  2. the same fit with a ``net_corrupt`` directive: one garbled collective
+     frame is caught by the CRC framing and absorbed by a bounded
+     retransmit — same epoch count, model BITWISE identical;
+  3. the same fit with a ``net_partition`` directive under the default
+     health policy: both sides hit the collective io deadline (no hang),
+     the driver collects the revoked reports, votes the partitioned
+     member off, quarantines it (partition weight >= threshold), the
+     gang SHRINKS and the fit resumes from the shared journal;
+  4. the partition again under a lenient health tracker: the victim is
+     respawned instead of dropped, the re-formed gang has the original
+     membership, and the resumed model is BITWISE identical to baseline;
+  5. a serving fleet under a registry OUTAGE: router + replicas keep
+     serving from the last-known-good table (zero non-shed 5xx for the
+     whole window), and a restarted registry recovers the journaled
+     leases (``LeaseRecovered``) without any replica re-registering from
+     scratch.
+
+The driver event log is validated with ``tools/check_eventlog.py
+--partition`` (every ``NetworkPartitioned`` onset must pair with a later
+``GroupReformed``).
+
+Exit code 0 + "partition chaos smoke OK" on success.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.error
+import urllib.request
+
+# runnable both installed (CI) and straight from a checkout
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+NUM_PROCESSES = 2
+NUM_ITERATIONS = 6
+PARTITION_AFTER_ROUND = 2
+OUTAGE_WINDOW_S = 2.0
+
+
+def _post(url, payload, timeout=10):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(), method="POST",
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        body = e.read()
+        return e.code, (json.loads(body) if body else None)
+
+
+def chaos_fit(event_log: str) -> None:
+    import numpy as np
+
+    from mmlspark_tpu.lightgbm.procfit import (
+        fit_process_group,
+        model_texts_close,
+    )
+    from mmlspark_tpu.lightgbm.train import TrainOptions
+    from mmlspark_tpu.runtime.faults import FaultPlan
+    from mmlspark_tpu.runtime.health import HealthTracker
+
+    seed = int(os.environ.get("MMLSPARK_TPU_FAULT_SEED", "11"))
+    rng = np.random.default_rng(7)
+    n = 400
+    X = rng.normal(size=(n, 5)).astype(np.float32)
+    y = (X[:, 0] - X[:, 1] + rng.normal(scale=0.4, size=n) > 0).astype(
+        np.float32
+    )
+    opts = TrainOptions(
+        objective="binary", num_iterations=NUM_ITERATIONS, num_leaves=7,
+        max_bin=32, min_data_in_leaf=5, seed=2,
+    )
+    gopts = {"epoch_timeout_s": 180.0, "io_timeout_s": 5.0}
+
+    baseline = fit_process_group(
+        X, y, opts, num_processes=NUM_PROCESSES, group_options=dict(gopts),
+    )
+    assert baseline.epochs == 1, baseline.epochs
+    print(f"baseline fit: {baseline.iterations} iterations, 1 epoch")
+
+    # -- scenario A: corrupt frame absorbed by the CRC retransmit ------------
+    plan = FaultPlan(seed=seed).net_corrupt(1, n=1, epoch=0)
+    absorbed = fit_process_group(
+        X, y, opts, num_processes=NUM_PROCESSES,
+        group_options={**gopts, "faults": plan},
+    )
+    assert absorbed.model_text == baseline.model_text, (
+        "corrupt-absorbed fit diverged from the undisturbed fit"
+    )
+    assert absorbed.epochs == 1, absorbed.epochs
+    assert [f[0] for f in plan.fired] == ["net_corrupt"], plan.fired
+    print("scenario A: one garbled collective frame absorbed by CRC "
+          "retransmit, model bitwise-identical, no re-formation")
+
+    # -- scenario B: partition -> revoke -> quarantine -> gang shrink --------
+    plan = FaultPlan(seed=seed).net_partition(
+        0, 1, epoch=0, after_round=PARTITION_AFTER_ROUND
+    )
+    shrunk = fit_process_group(
+        X, y, opts, num_processes=NUM_PROCESSES,
+        group_options={**gopts, "faults": plan},
+    )
+    assert shrunk.epochs == 2, shrunk.epochs
+    assert model_texts_close(shrunk.model_text, baseline.model_text), (
+        "shrunken-gang fit drifted beyond histogram-resharding tolerance"
+    )
+    assert [f[0] for f in plan.fired] == ["net_partition"], plan.fired
+    partitioned = [s for s in shrunk.exit_statuses if s.reason == "partition"]
+    assert len(partitioned) == 1, shrunk.exit_statuses
+    victim = partitioned[0].member
+    print(f"scenario B: partition revoked both sides within the io "
+          f"deadline, member {victim} voted off + quarantined, gang shrank "
+          f"to {NUM_PROCESSES - 1}, fit resumed from the journal")
+
+    # -- scenario C: partition with a lenient tracker -> respawn -------------
+    plan = FaultPlan(seed=seed).net_partition(
+        0, 1, epoch=0, after_round=PARTITION_AFTER_ROUND
+    )
+    lenient = HealthTracker(threshold=10.0, window_s=600.0, parole_s=600.0)
+    respawned = fit_process_group(
+        X, y, opts, num_processes=NUM_PROCESSES,
+        group_options={**gopts, "faults": plan, "health": lenient},
+    )
+    assert respawned.epochs == 2, respawned.epochs
+    assert respawned.model_text == baseline.model_text, (
+        "respawned-gang fit diverged from the undisturbed fit"
+    )
+    assert [f[0] for f in plan.fired] == ["net_partition"], plan.fired
+    print("scenario C: same partition under a lenient health tracker — "
+          "victim respawned, membership restored, model bitwise-identical")
+
+    from mmlspark_tpu import observability as obs
+
+    events = obs.replay(event_log)
+    names = [type(e).__name__ for e in events]
+    assert names.count("NetworkPartitioned") == 2, names
+    assert names.count("GroupReformed") == 2, names
+    print("event log: NetworkPartitioned=2 GroupReformed=2")
+
+
+def chaos_registry_outage(event_log: str) -> None:
+    from mmlspark_tpu.serving.replicas import ReplicaSupervisor
+    from mmlspark_tpu.serving.router import FleetRouter
+    from mmlspark_tpu.serving.server import RegistrationService
+
+    journal_dir = tempfile.mkdtemp(prefix="chaos-registry-")
+    registry = RegistrationService(
+        ttl_s=30.0, journal_dir=journal_dir
+    ).start()
+    port = registry.info.port
+    registry_url = f"http://127.0.0.1:{port}"
+
+    with ReplicaSupervisor(
+        "mmlspark_tpu.serving.replicas:demo_model_factory",
+        num_replicas=2, registry_url=registry_url,
+        registry_heartbeat_s=0.2, heartbeat_timeout_s=10.0,
+    ) as sup:
+        sup.wait_ready(30.0)
+        deadline = time.monotonic() + 30.0
+        while len(registry.services) < 2 and time.monotonic() < deadline:
+            time.sleep(0.1)
+        lease_names = sorted(s.name for s in registry.services)
+        assert len(lease_names) == 2, lease_names
+
+        router = FleetRouter(
+            registry_url=registry_url, discovery_interval_s=0.1,
+        ).start()
+        try:
+            deadline = time.monotonic() + 10.0
+            while len(router.refresh()) < 2 and time.monotonic() < deadline:
+                time.sleep(0.1)
+            status, out = _post(router.url, {"input": 21.0})
+            assert status == 200 and out["prediction"] == 42.0, (status, out)
+            print(f"fleet up: 2 replicas registered ({lease_names}), "
+                  f"router serving")
+
+            # -- the outage: kill the registry mid-flight --------------------
+            registry.stop()
+            served = shed = 0
+            t_end = time.monotonic() + OUTAGE_WINDOW_S
+            while time.monotonic() < t_end:
+                status, out = _post(router.url, {"input": 21.0})
+                if status == 200:
+                    assert out["prediction"] == 42.0, out
+                    served += 1
+                elif status == 429:
+                    shed += 1  # admission shed is load policy, not outage
+                else:
+                    raise AssertionError(
+                        f"non-shed {status} during the registry outage: {out}"
+                    )
+                time.sleep(0.05)
+            assert served > 0, "no requests served during the outage window"
+            assert router._stale, "router never noticed the outage"
+            print(f"registry outage: {served} served + {shed} shed from the "
+                  f"stale table, zero non-shed 5xx")
+
+            # -- restart on the SAME port: journaled leases come back --------
+            restarted = RegistrationService(
+                ttl_s=30.0, port=port, journal_dir=journal_dir
+            ).start()
+            try:
+                recovered = sorted(s.name for s in restarted.services)
+                assert recovered == lease_names, (
+                    f"journal recovery mismatch: {recovered} != {lease_names}"
+                )
+                # replicas keep heartbeating the recovered leases — no 404,
+                # no re-register; the lease table must stay intact for a
+                # full heartbeat cycle
+                time.sleep(1.0)
+                assert sorted(s.name for s in restarted.services) == \
+                    lease_names
+                deadline = time.monotonic() + 10.0
+                while router._stale and time.monotonic() < deadline:
+                    time.sleep(0.1)
+                assert not router._stale, "router still stale after restart"
+                status, out = _post(router.url, {"input": 5.0})
+                assert status == 200 and out["prediction"] == 10.0
+                print("registry restarted: journaled leases recovered, "
+                      "heartbeats resumed against them, router table fresh")
+            finally:
+                restarted.stop()
+        finally:
+            router.stop()
+
+    from mmlspark_tpu import observability as obs
+
+    events = obs.replay(event_log)
+    names = [type(e).__name__ for e in events]
+    assert names.count("LeaseRecovered") == 2, names
+    assert "RegistryUnavailable" in names, names
+    print(f"event log: LeaseRecovered=2 "
+          f"RegistryUnavailable={names.count('RegistryUnavailable')}")
+
+
+def main() -> int:
+    os.environ.setdefault("MMLSPARK_TPU_FAULT_SEED", "11")
+    fit_log = tempfile.mktemp(prefix="partition-events-", suffix=".jsonl")
+    os.environ["MMLSPARK_TPU_EVENT_LOG"] = fit_log
+    chaos_fit(fit_log)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = _ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    check = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "tools", "check_eventlog.py"),
+         "--partition", fit_log],
+        capture_output=True, text=True, env=env,
+    )
+    sys.stdout.write(check.stdout)
+    sys.stderr.write(check.stderr)
+    assert check.returncode == 0, "check_eventlog --partition failed"
+
+    # get_bus() re-syncs the env-driven sink on every call, so pointing
+    # the env var at a fresh path re-homes the driver sink for part two
+    serve_log = tempfile.mktemp(prefix="registry-events-", suffix=".jsonl")
+    os.environ["MMLSPARK_TPU_EVENT_LOG"] = serve_log
+    chaos_registry_outage(serve_log)
+    os.environ.pop("MMLSPARK_TPU_EVENT_LOG", None)
+    print("partition chaos smoke OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
