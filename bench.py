@@ -194,6 +194,39 @@ def _chunked_u_evidence():
     return out
 
 
+def _hist_bytes_evidence(leaf_batch=8):
+    """Analytic bytes-per-build roofline for the 255-bin continuous
+    headline shape (deviceless, like the chunked-U selection trace): the
+    row-proportional HBM bytes ONE histogram pass over a ``leaf_batch``
+    split frontier must stream, per variant. "r05_u_path" is the previous
+    round's hot path (resident U, both children built, f32 panel);
+    "subtraction" keys only the smaller children (panel width halves,
+    siblings derive from the leaf cache); "subtraction_packed" rides the
+    quantized int8 panel; "fused_subtraction_packed" is the Pallas
+    bin+scatter-add kernel, which reads the raw binned rows once (int32
+    lanes + an 8-row f32 aux block) instead of re-streaming the K_pad-byte
+    one-hot row."""
+    from mmlspark_tpu.ops.u_histogram import make_u_spec
+
+    spec = make_u_spec(MAX_BIN + 1, N_FEATURES, None)
+    k = leaf_batch
+    per_row = {
+        "r05_u_path": spec.k_pad + 3 * 2 * k * 4,
+        "subtraction": spec.k_pad + 3 * k * 4,
+        "subtraction_packed": spec.k_pad + 3 * k * 1,
+        "fused_subtraction_packed": 4 * N_FEATURES + 32 + 3 * k * 1,
+    }
+    before = per_row["r05_u_path"]
+    return {
+        "shape": f"{N_FEATURES}cont x {MAX_BIN + 1}bins, leaf_batch={k}",
+        "k_packed": int(spec.k_pad),
+        "bytes_per_row_per_build": per_row,
+        "reduction_vs_r05": {
+            name: round(before / b, 3) for name, b in per_row.items()
+        },
+    }
+
+
 def _auc(y, score):
     from mmlspark_tpu.lightgbm.objectives import auc
 
@@ -355,13 +388,14 @@ def main():
     from mmlspark_tpu.observability import (
         FeatureBundled,
         HistogramChunked,
+        HistogramSubtracted,
         get_bus,
     )
 
     captured = []
     get_bus().add_listener(
         lambda e: captured.append(e)
-        if isinstance(e, (FeatureBundled, HistogramChunked))
+        if isinstance(e, (FeatureBundled, HistogramChunked, HistogramSubtracted))
         else None
     )
 
@@ -466,6 +500,78 @@ def main():
         quant["gbdt_quant_vs_baseline_device_resident"] = round(
             cpu_secs / q_resident, 3
         )
+
+    # Sibling-subtraction A/B on the headline shape: the headline and
+    # quant fits above already run subtraction (the default); this block
+    # re-fits both with histogram_subtraction=False so the artifact
+    # carries the measured on/off delta AND the parity clause — the
+    # default-config dAUC is the CI regression guard (<= 2e-5).
+    (
+        _so_secs, so_resident, _sob, _sowr, _sorr, so_margins, _,
+    ) = _fit_tpu(
+        Xtr, ytr, Xte, extra_opts={"histogram_subtraction": False},
+    )
+    so_auc = float(_auc(yte, so_margins))
+    (
+        _qo_secs, qo_resident, _qob, _qowr, _qorr, qo_margins, _,
+    ) = _fit_tpu(
+        Xtr, ytr, Xte,
+        extra_opts={
+            "use_quantized_grad": True, "leaf_batch": 16,
+            "histogram_subtraction": False,
+        },
+    )
+    # Quant-path byte-identity, measured live: subtraction is an integer
+    # subtraction of integer partial sums, so the model text must be
+    # byte-identical on/off. The quant preset above auto-selects the U
+    # path only on TPU backends, so this check FORCES histogram_method='u'
+    # (runs everywhere, CPU smoke included) at a declared reduced scale.
+    import dataclasses as _dc
+
+    from mmlspark_tpu.lightgbm.binning import bin_dataset as _bin
+    from mmlspark_tpu.lightgbm.train import TrainOptions as _TO
+    from mmlspark_tpu.lightgbm.train import train as _train
+
+    qi_rows = min(N_ROWS, 50_000)
+    qi_iters = min(N_ITERS, 20)
+    qi_opts = _TO(
+        objective="binary", num_iterations=qi_iters, num_leaves=NUM_LEAVES,
+        learning_rate=LEARNING_RATE, max_bin=MAX_BIN, growth="leafwise",
+        histogram_method="u", use_quantized_grad=True,
+    )
+    qi_bins, qi_mapper = _bin(Xtr[:qi_rows], max_bin=MAX_BIN)
+    qi_on = _train(qi_bins, ytr[:qi_rows], qi_opts, mapper=qi_mapper)
+    qi_off = _train(
+        qi_bins, ytr[:qi_rows],
+        _dc.replace(qi_opts, histogram_subtraction=False),
+        mapper=qi_mapper,
+    )
+    sub = {
+        "gbdt_sub_config": "histogram_subtraction A/B, headline shape",
+        "gbdt_sub_on_fit_secs_device_resident": round(resident_secs, 3),
+        "gbdt_sub_off_fit_secs_device_resident": round(so_resident, 3),
+        "gbdt_sub_speedup_device_resident": round(
+            so_resident / resident_secs, 3
+        ),
+        "gbdt_sub_dauc": round(abs(float(auc_tpu) - so_auc), 7),
+        "gbdt_quant_sub_off_fit_secs_device_resident": round(qo_resident, 3),
+        "gbdt_quant_sub_speedup_device_resident": round(
+            qo_resident / q_resident, 3
+        ),
+        # the quant preset's own margins on/off — informational; identical
+        # only where the preset actually rides the quantized U path (TPU)
+        "gbdt_quant_sub_max_abs_margin_delta": float(
+            np.max(np.abs(np.asarray(q_margins) - np.asarray(qo_margins)))
+        ),
+        "gbdt_quant_sub_byte_identical": bool(
+            qi_on.booster.model_to_string()
+            == qi_off.booster.model_to_string()
+        ),
+        "gbdt_quant_sub_byte_identity_config": (
+            f"histogram_method='u', use_quantized_grad=True,"
+            f" rows={qi_rows}, iterations={qi_iters}"
+        ),
+    }
 
     # Sparse one-hot workload: the Exclusive Feature Bundling regime.
     # Same fit bundled and unbundled; the block reports the measured K
@@ -574,9 +680,24 @@ def main():
             "chunk_rows": e.chunk_rows,
             "num_chunks": e.num_chunks,
             "budget_bytes": e.budget_bytes,
+            "acc_dtype": e.acc_dtype,
+            "bytes_saved": e.bytes_saved,
         }
         for e in captured
         if isinstance(e, HistogramChunked)
+    ]
+    sub_events = [
+        {
+            "rows": e.rows,
+            "num_leaves": e.num_leaves,
+            "packed_columns": e.packed_columns,
+            "packed_bins": e.packed_bins,
+            "acc_dtype": e.acc_dtype,
+            "cache_bytes": e.cache_bytes,
+            "bytes_saved_per_tree": e.bytes_saved_per_tree,
+        }
+        for e in captured
+        if isinstance(e, HistogramSubtracted)
     ]
     bundle_events = [
         {
@@ -622,7 +743,7 @@ def main():
                 # otherwise; the 9.6x-class throughput preset is opt-in.
                 "gbdt_default_config": (
                     "exact bf16 histograms: use_quantized_grad=False,"
-                    " leaf_batch=8"
+                    " leaf_batch=8, histogram_subtraction=True"
                 ),
                 "gbdt_fast_preset": (
                     "use_quantized_grad=True, leaf_batch=16 (opt-in;"
@@ -630,6 +751,7 @@ def main():
                 ),
                 **mixed,
                 **quant,
+                **sub,
                 **sparse,
                 **real,
                 # Chunked-U evidence: the static 4M-row selection trace
@@ -638,8 +760,14 @@ def main():
                 # published — live at BENCH_ROWS large enough to exceed
                 # MMLSPARK_TPU_U_BUDGET.
                 "u_chunking_4m_selection": _chunked_u_evidence(),
+                # Bytes-per-build roofline for the 255-bin continuous
+                # shape: the byte reduction subtraction + packed panels +
+                # the fused bin+scatter kernel buy per histogram pass.
+                "hist_bytes_per_build_255bin": _hist_bytes_evidence(),
                 "histogram_chunked_events": chunk_events[:8],
                 "histogram_chunked_event_count": len(chunk_events),
+                "histogram_subtracted_events": sub_events[:8],
+                "histogram_subtracted_event_count": len(sub_events),
                 "feature_bundled_events": bundle_events[:8],
                 "profiler": prof.snapshot(),
             }

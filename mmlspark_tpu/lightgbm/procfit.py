@@ -250,7 +250,12 @@ def worker_fit(ctx) -> Dict[str, Any]:
     def hist_reduce(h):
         # first collective of iteration `it`: the designated death point
         # for kill_process chaos — peers are already blocked in this same
-        # allreduce when the victim goes down
+        # allreduce when the victim goes down. Under sibling subtraction
+        # (the default) `h` holds only the SMALLER child of each frontier
+        # split — members derive the sibling from the cached parent AFTER
+        # this reduce, so the wire payload per pass is halved. Alignment
+        # holds because every member picks the smaller child from the
+        # same GLOBAL (already-reduced) parent stats.
         ctx.maybe_die(state["it"])
         t0 = time.perf_counter()
         out = ctx.allreduce(h)

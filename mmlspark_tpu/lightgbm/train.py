@@ -105,6 +105,13 @@ class TrainOptions:
     # (the f32 integer-exactness limit; the row gate enforces it). Only
     # affects fits on the precomputed-U path; off = bit-exact bf16 stats.
     use_quantized_grad: bool = False
+    # Sibling histogram subtraction (native LightGBM's always-on trick,
+    # exposed as a knob for A/B measurement): build only the SMALLER child
+    # of each split and derive the sibling as parent - smaller, in packed
+    # (pre-EFB-expansion) space — integer-exact on the quantized path, so
+    # subtraction on/off grows byte-identical trees there. Off = build
+    # both children directly (the measurement baseline).
+    histogram_subtraction: bool = True
     # only batch leaves with gain >= ratio * pass-best (0 = off): tightens
     # multi-leaf passes toward best-first; 1.0 reproduces leaf_batch=1
     leaf_batch_ratio: float = 0.0
@@ -567,8 +574,14 @@ def _hist_fn(opts: TrainOptions, mesh=None, u_spec=None, hist_reduce=None,
         # plain-XLA formulations do, so the mesh path sticks to those.
         method = "onehot" if jax.default_backend() in ("tpu", "axon") else "segment"
 
-    def full(bins, grad, hess, count, node, num_nodes, num_bins,
-             feature_mask=None, u=None, stats=None):
+    def packed(bins, grad, hess, count, node, num_nodes, num_bins,
+               feature_mask=None, u=None, stats=None):
+        """SPEC-space histogram (k, C, B_b, 3) + per-node totals — the
+        pass BEFORE dequantization and bundle expansion. This is the
+        representation the sibling-subtraction cache lives in: packed
+        columns (C <= F under EFB) and, on the quantized U path, the
+        narrow integer accumulator dtype — so parent - child is an exact
+        integer subtraction and the allreduce payload stays minimal."""
         if u is not None and u_spec is not None and 3 * num_nodes <= 128:
             if u_spec.chunk_rows:
                 from mmlspark_tpu.ops.u_histogram import (
@@ -576,13 +589,15 @@ def _hist_fn(opts: TrainOptions, mesh=None, u_spec=None, hist_reduce=None,
                 )
 
                 h = build_histograms_u_chunked(
-                    u, grad, hess, count, node, num_nodes, u_spec, stats=stats,
+                    u, grad, hess, count, node, num_nodes, u_spec,
+                    stats=stats, dequant=False,
                 )
             else:
                 from mmlspark_tpu.ops.u_histogram import build_histograms_u
 
                 h = build_histograms_u(
-                    u, grad, hess, count, node, num_nodes, u_spec, stats=stats,
+                    u, grad, hess, count, node, num_nodes, u_spec,
+                    stats=stats, dequant=False,
                 )
         else:
             h = build_histograms(
@@ -593,16 +608,42 @@ def _hist_fn(opts: TrainOptions, mesh=None, u_spec=None, hist_reduce=None,
         if hist_reduce is not None:
             # host round-trip per histogram pass; "expand_dims" keeps one
             # callback call under the per-class vmap so gang members make
-            # identical, aligned allreduce sequences
+            # identical, aligned allreduce sequences. Runs in the packed
+            # space, so under sibling subtraction the gang allreduces only
+            # the smaller child's histograms (the quant path never reaches
+            # here — procfit rejects it — so the payload is always f32).
             h = jax.pure_callback(
                 hist_reduce, jax.ShapeDtypeStruct(h.shape, h.dtype), h,
                 vmap_method="expand_dims",
             )
         totals = h[:, 0, :, :].sum(axis=1)  # feature/column 0 covers all rows
+        return h, totals
+
+    def expand(h, totals, num_bins, stats=None):
+        """Finish a ``packed`` result for the split search: apply the
+        deferred quant scales (exactly once, AFTER any subtraction), then
+        expand EFB's packed columns back to original feature space
+        (``num_bins`` = the ORIGINAL bin width the search expects)."""
+        if jnp.issubdtype(h.dtype, jnp.integer):
+            from mmlspark_tpu.ops.u_histogram import dequant_hist
+
+            scales = stats[1]
+            h = dequant_hist(h, scales)
+            totals = dequant_hist(totals, scales)
         if bundle is not None:
             h = _expand_bundled(h, totals, bundle, num_bins)
         return h, totals
 
+    def full(bins, grad, hess, count, node, num_nodes, num_bins,
+             feature_mask=None, u=None, stats=None):
+        h, totals = packed(
+            bins, grad, hess, count, node, num_nodes, num_bins,
+            feature_mask=feature_mask, u=u, stats=stats,
+        )
+        return expand(h, totals, num_bins, stats=stats)
+
+    full.packed = packed
+    full.expand = expand
     return full
 
 
@@ -786,15 +827,29 @@ def _build_tree_leafwise(
     max_depth = opts.max_depth if (opts.max_depth and opts.max_depth > 0) else m
 
     # Histogram subtraction (LightGBM's core trick): cache every frontier
-    # leaf's (F, B, 3) histogram, build only the LEFT children per pass, and
-    # derive each right child as parent - left — halving the node count of
-    # the hot pass from 2k to k. Gated by a memory budget on the (M, F, B, 3)
-    # cache — which the boosting step vmaps over num_class, so the budget
-    # multiplies by the class count — and off under voting-parallel (its
-    # histograms only carry the top-K winner features, so parent - left is
-    # garbage elsewhere).
+    # leaf's histogram, build only the SMALLER child of each split per
+    # pass, and derive the sibling as parent - smaller — halving the node
+    # count of the hot pass from 2k to k AND keying the pass on the child
+    # with fewer rows. The cache lives in PACKED space — (M, C, B_b, 3)
+    # where C is the EFB-packed column count and, on the quantized U path,
+    # the narrow integer accumulator dtype — so subtraction is an exact
+    # integer op before dequantization/expansion and the cache shrinks
+    # with the K-reduction. Gated by a memory budget on that cache — which
+    # the boosting step vmaps over num_class, so the budget multiplies by
+    # the class count — and off under voting-parallel (its histograms only
+    # carry the top-K winner features, so parent - smaller is garbage
+    # elsewhere).
+    c_cols = bins.shape[1]  # packed column count (== f without bundling)
+    b_pack = bundle.num_bins if bundle is not None else b
+    quant = u is not None and qkey is not None
+    from mmlspark_tpu.ops.u_histogram import histogram_acc_dtype
+
+    acc_dtype = histogram_acc_dtype(n, quant)
+    acc_bytes = jnp.dtype(acc_dtype).itemsize
     use_sub = (
-        max(1, opts.num_class) * m * f * b * 3 * 4 <= (256 << 20)
+        opts.histogram_subtraction
+        and max(1, opts.num_class) * m * c_cols * b_pack * 3 * acc_bytes
+        <= (256 << 20)
         and opts.tree_learner != "voting_parallel"
     )
     # Panel-pass node budget: 3 stats x nodes must fit one 128-lane group
@@ -816,11 +871,20 @@ def _build_tree_leafwise(
     # independent, so they upload to the panel layout once per tree.
     stats = _tree_stats(grad, hess, count, qkey) if u is not None else None
 
-    # Root: one-node histogram over all rows.
-    root_hist, root_tot = histf(
-        bins, grad, hess, count, jnp.zeros(n, jnp.int32), 1, b,
-        feature_mask=feature_mask, u=u, stats=stats,
-    )
+    # Root: one-node histogram over all rows. Under subtraction the packed
+    # (pre-expansion) result seeds the cache and is expanded separately
+    # for the search.
+    if use_sub:
+        root_p, root_tp = histf.packed(
+            bins, grad, hess, count, jnp.zeros(n, jnp.int32), 1, b,
+            feature_mask=feature_mask, u=u, stats=stats,
+        )
+        root_hist, root_tot = histf.expand(root_p, root_tp, b, stats=stats)
+    else:
+        root_hist, root_tot = histf(
+            bins, grad, hess, count, jnp.zeros(n, jnp.int32), 1, b,
+            feature_mask=feature_mask, u=u, stats=stats,
+        )
     root = _split_search(root_hist, root_tot, edges, feature_mask, opts, lr=lr)
 
     def at0(template, s_):
@@ -884,10 +948,21 @@ def _build_tree_leafwise(
             c_catmask=zmb.at[0].set(root.cat_mask[0]),
         )
     if use_sub:
+        # Packed-space cache: C columns x bundle-bin width in the pass's
+        # accumulator dtype (narrow int on the quantized U path) — the
+        # subtraction happens here, BEFORE dequant/EFB expansion.
         state["leaf_hist"] = (
-            jnp.zeros((m, f, b, 3), jnp.float32).at[0].set(root_hist[0])
+            jnp.zeros((m, c_cols, b_pack, 3), root_p.dtype).at[0].set(root_p[0])
         )
-        state["leaf_tot"] = jnp.zeros((m, 3), jnp.float32).at[0].set(root_tot[0])
+        state["leaf_tot"] = (
+            jnp.zeros((m, 3), root_tp.dtype).at[0].set(root_tp[0])
+        )
+        # Which child of each cached candidate split is SMALLER (by row
+        # count): the pass builds that child and derives the other. False
+        # (left) for non-candidates — harmless, their gain is -inf.
+        state["c_subR"] = jnp.zeros(m, bool).at[0].set(
+            root.rcov[0] < root.lcov[0]
+        )
 
     def cond(st):
         # c_gain is NaN-free by construction; -inf marks non-frontier and
@@ -922,6 +997,8 @@ def _build_tree_leafwise(
         sf = st["c_feat"][top_l]  # (k,) split feature / bin / threshold
         sb = st["c_bin"][top_l]
         sthr = st["c_thr"][top_l]
+        if use_sub:
+            small_r = st["c_subR"][top_l]  # (k,) smaller child is RIGHT
         if has_cat:
             sic = st["c_iscat"][top_l]  # (k,)
             scm = st["c_catmask"][top_l]  # (k, B)
@@ -970,17 +1047,37 @@ def _build_tree_leafwise(
                 in_j, jnp.where(right_j, rslot[jj], lslot[jj]), new_node
             )
             if use_sub:
-                key = jnp.where(in_j & ~right_j, jj, key)
+                # key rows landing in the SMALLER child (right when
+                # small_r, else left) — the built child of split jj
+                key = jnp.where(
+                    in_j & (right_j == small_r[jj]), jj, key
+                )
             else:
                 key = jnp.where(in_j, 2 * jj + right_j.astype(jnp.int32), key)
 
         if use_sub:
-            histL, totL = histf(
+            # Build the smaller child in PACKED space, derive the sibling
+            # as parent - smaller (exact integer subtraction on the quant
+            # path — the derived sibling is bit-identical to a direct
+            # build), then assign built/derived back to left/right.
+            histS, totS = histf.packed(
                 bins, grad, hess, count, key, k, b, feature_mask=feature_mask,
                 u=u, stats=stats,
-            )  # (k, F, B, 3)
-            histR = st["leaf_hist"][top_l] - histL
-            totR = st["leaf_tot"][top_l] - totL
+            )  # (k, C, B_b, 3)
+            histO = st["leaf_hist"][top_l] - histS
+            totO = st["leaf_tot"][top_l] - totS
+            sel = small_r[:, None, None, None]
+            histL_p = jnp.where(sel, histO, histS)
+            histR_p = jnp.where(sel, histS, histO)
+            totL_p = jnp.where(small_r[:, None], totO, totS)
+            totR_p = jnp.where(small_r[:, None], totS, totO)
+            hlr, tlr = histf.expand(
+                jnp.concatenate([histL_p, histR_p]),
+                jnp.concatenate([totL_p, totR_p]),
+                b, stats=stats,
+            )
+            histL, histR = hlr[:k], hlr[k:]
+            totL, totR = tlr[:k], tlr[k:]
         else:
             h2, t2 = histf(
                 bins, grad, hess, count, key, 2 * k, b, feature_mask=feature_mask,
@@ -1001,12 +1098,17 @@ def _build_tree_leafwise(
         st = dict(st)
         if use_sub:
             st["leaf_hist"] = (
-                st["leaf_hist"].at[glslot].set(histL, mode="drop")
-                .at[grslot].set(histR, mode="drop")
+                st["leaf_hist"].at[glslot].set(histL_p, mode="drop")
+                .at[grslot].set(histR_p, mode="drop")
             )
             st["leaf_tot"] = (
-                st["leaf_tot"].at[glslot].set(totL, mode="drop")
-                .at[grslot].set(totR, mode="drop")
+                st["leaf_tot"].at[glslot].set(totL_p, mode="drop")
+                .at[grslot].set(totR_p, mode="drop")
+            )
+            sub_r = cs.rcov < cs.lcov  # (2k,) per fresh candidate
+            st["c_subR"] = (
+                st["c_subR"].at[glslot].set(sub_r[:k], mode="drop")
+                .at[grslot].set(sub_r[k:], mode="drop")
             )
         st["node"] = new_node
         st["feat"] = st["feat"].at[gparent].set(sf, mode="drop")
@@ -1721,10 +1823,22 @@ def train(
 
             bus = get_bus()
             if bus.active:
+                from mmlspark_tpu.ops.u_histogram import histogram_acc_dtype
+
+                # quant may still fall back below (row cap); mirror that
+                # predicate so the event records the dtype actually used
+                _ck_quant = opts.use_quantized_grad and (
+                    n + pad <= min((1 << 31) // 127, 1 << 24)
+                )
+                _ck_dt = jnp.dtype(histogram_acc_dtype(n + pad, _ck_quant))
+                _ck_3k = 3 * max(1, min(opts.leaf_batch, opts.num_leaves - 1))
                 bus.publish(HistogramChunked(
                     rows=n + pad, k_packed=u_spec.k_pad,
                     chunk_rows=u_spec.chunk_rows, num_chunks=chunks,
                     budget_bytes=budget,
+                    acc_dtype=_ck_dt.name,
+                    bytes_saved=u_spec.k_pad * _ck_3k
+                    * (4 - _ck_dt.itemsize),
                 ))
 
     if opts.use_quantized_grad:
@@ -1775,6 +1889,38 @@ def train(
             "exact (non-quantized) histograms per level",
             opts.depth,
         )
+
+    if opts.growth == "leafwise" and opts.histogram_subtraction:
+        # Mirror _build_tree_leafwise's use_sub gate so the event reports
+        # the path the trace will actually take (static predicate).
+        from mmlspark_tpu.observability.events import (
+            HistogramSubtracted,
+            get_bus,
+        )
+        from mmlspark_tpu.ops.u_histogram import histogram_acc_dtype
+
+        _sb_cols = len(bundle.widths) if bundle is not None else f
+        _sb_bins = bundle.num_bins if bundle is not None else num_bins
+        _sb_quant = opts.use_quantized_grad and u_spec is not None
+        _sb_dt = jnp.dtype(histogram_acc_dtype(n + pad, _sb_quant))
+        _sb_m = 2 * opts.num_leaves - 1
+        _sb_cache = (
+            max(1, opts.num_class) * _sb_m * _sb_cols * _sb_bins * 3
+            * _sb_dt.itemsize
+        )
+        bus = get_bus()
+        if (
+            bus.active
+            and _sb_cache <= (256 << 20)
+            and opts.tree_learner != "voting_parallel"
+        ):
+            bus.publish(HistogramSubtracted(
+                rows=n + pad, num_leaves=opts.num_leaves,
+                packed_columns=_sb_cols, packed_bins=_sb_bins,
+                acc_dtype=_sb_dt.name, cache_bytes=_sb_cache,
+                bytes_saved_per_tree=(opts.num_leaves - 1) * _sb_cols
+                * _sb_bins * 3 * _sb_dt.itemsize,
+            ))
 
     okey = (_opts_key(opts), num_bins, mesh, u_spec, bundle, objective.cache_token)
     if opts.boosting_type == "goss":

@@ -48,6 +48,7 @@ from mmlspark_tpu.observability.events import (
     FleetScaled,
     GroupReformed,
     HistogramChunked,
+    HistogramSubtracted,
     IncidentRecorded,
     LeaseRecovered,
     ModelCommitted,
@@ -149,6 +150,7 @@ __all__ = [
     "GroupReformed",
     "Histogram",
     "HistogramChunked",
+    "HistogramSubtracted",
     "IncidentRecorded",
     "LeaseRecovered",
     "MetricsFederator",

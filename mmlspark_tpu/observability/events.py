@@ -403,13 +403,42 @@ class HistogramChunked(Event):
     and the histogram pass was row-chunked instead of abandoning the MXU
     path (``lightgbm/train.py``): each pass streams ``num_chunks`` chunks
     of ``chunk_rows`` rows, rebuilding the chunk's one-hot in-trace and
-    accumulating partial histograms."""
+    accumulating partial histograms. ``acc_dtype`` is the scan carry's
+    accumulator dtype (narrow int on the quantized path) and
+    ``bytes_saved`` the carry bytes that narrowing saved vs f32 — both
+    recorded so incident bundles can tell this PLANNED optimization apart
+    from the ``runtime/pressure.py`` degradation ladder's emergency
+    re-chunking (``HistogramDegraded``)."""
 
     rows: int
     k_packed: int
     chunk_rows: int
     num_chunks: int
     budget_bytes: int
+    acc_dtype: str = "float32"
+    bytes_saved: int = 0
+
+
+@_event
+class HistogramSubtracted(Event):
+    """A GBDT fit selected sibling histogram subtraction
+    (``lightgbm/train.py``): each split's histogram pass builds only the
+    SMALLER child and derives the sibling as parent - smaller, in packed
+    (pre-EFB-expansion) space. ``children_per_split`` is 1 (vs 2 without
+    subtraction), ``acc_dtype`` the cache/pass accumulator dtype (narrow
+    int on the quantized path, where subtraction is integer-exact),
+    ``cache_bytes`` the resident per-class leaf-histogram cache, and
+    ``bytes_saved_per_tree`` the histogram-build bytes one tree avoids —
+    the planned-optimization counterpart of ``HistogramDegraded``."""
+
+    rows: int
+    num_leaves: int
+    packed_columns: int
+    packed_bins: int
+    acc_dtype: str
+    cache_bytes: int
+    bytes_saved_per_tree: int
+    children_per_split: int = 1
 
 
 @_event
@@ -962,6 +991,10 @@ def timeline(events: Iterable[Event]) -> Dict[str, Any]:
     incidents_skipped = 0
     pressure: List[Dict[str, Any]] = []
     degradations: List[Dict[str, Any]] = []
+    #: PLANNED histogram-engine optimizations (subtraction / chunking) —
+    #: kept separate from `degradations` so incident bundles distinguish
+    #: a configured byte-saving path from an emergency pressure response
+    hist_optimizations: List[Dict[str, Any]] = []
     #: events per federation process label ("" = untagged single-process log)
     by_process: Dict[str, int] = {}
     for ev in events:
@@ -1066,6 +1099,19 @@ def timeline(events: Iterable[Event]) -> Dict[str, Any]:
                 "budget_bytes": ev.budget_bytes, "chunk_rows": ev.chunk_rows,
                 "retries": ev.retries,
             })
+        elif isinstance(ev, HistogramSubtracted):
+            hist_optimizations.append({
+                "kind": "subtraction", "rows": ev.rows,
+                "num_leaves": ev.num_leaves, "acc_dtype": ev.acc_dtype,
+                "cache_bytes": ev.cache_bytes,
+                "bytes_saved_per_tree": ev.bytes_saved_per_tree,
+            })
+        elif isinstance(ev, HistogramChunked):
+            hist_optimizations.append({
+                "kind": "chunked", "rows": ev.rows,
+                "chunk_rows": ev.chunk_rows, "num_chunks": ev.num_chunks,
+                "acc_dtype": ev.acc_dtype, "bytes_saved": ev.bytes_saved,
+            })
         elif isinstance(ev, (ProfileCompiled, ProfileExecuted)):
             rec = profiler.setdefault(ev.name, {
                 "compiles": 0, "compile_seconds": 0.0,
@@ -1110,6 +1156,7 @@ def timeline(events: Iterable[Event]) -> Dict[str, Any]:
         "incidents_skipped": incidents_skipped,
         "pressure": pressure,
         "degradations": degradations,
+        "hist_optimizations": hist_optimizations,
         "by_process": by_process,
     }
 
@@ -1228,6 +1275,23 @@ def format_timeline(summary: Dict[str, Any]) -> str:
                 f"budget={d['budget_bytes']} chunk_rows={d['chunk_rows']} "
                 f"retry {d['retries']}"
             )
+    hist_opts = summary.get("hist_optimizations") or []
+    if hist_opts:
+        # planned byte-saving paths — NOT the pressure ladder above
+        lines.append("== histogram optimizations ==")
+        for o in hist_opts:
+            if o["kind"] == "subtraction":
+                lines.append(
+                    f"   subtraction: leaves={o['num_leaves']} "
+                    f"acc={o['acc_dtype']} cache={o['cache_bytes']}B "
+                    f"saves={o['bytes_saved_per_tree']}B/tree"
+                )
+            else:
+                lines.append(
+                    f"   chunked: chunks={o['num_chunks']}x"
+                    f"{o['chunk_rows']} acc={o['acc_dtype']} "
+                    f"saves={o['bytes_saved']}B"
+                )
     by_process = summary.get("by_process") or {}
     if by_process:
         lines.append("== fleet log == " + ", ".join(

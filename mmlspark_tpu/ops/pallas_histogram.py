@@ -41,6 +41,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 # One-hot VMEM budget. 6 MiB leaves room for the id/data tiles, the (K, 3)
 # accumulator, and double buffering within ~16 MiB of VMEM.
@@ -235,3 +236,164 @@ def build_histograms_pallas(
         interpret=interpret,
     )(ids3, data3)
     return out.reshape(f, num_nodes, num_bins, 3).transpose(1, 0, 2, 3)
+
+
+# ---------------------------------------------------------------------------
+# Fused bin + scatter-add pass: the U contraction without the U.
+# ---------------------------------------------------------------------------
+
+_SCATTER_TN = 512  # rows per N-tile (lane-dim block of the bins stream)
+_SCATTER_VMEM = 24 << 20
+
+
+def bin_scatter_fits_vmem(k_pad: int, num_features: int, tn: int = _SCATTER_TN) -> bool:
+    """VMEM gate for the fused bin+scatter pass: the per-tile one-hot
+    scratch (k_pad x tn s8), the resident accumulator block (k_pad x 128,
+    <= 4 B), the double-buffered bins tiles (F x tn s32) and the panel all
+    have to sit inside the ~24 MB working budget."""
+    f_pad = -(-max(num_features, 1) // _SUBLANES) * _SUBLANES
+    return (
+        k_pad * (tn + 4 * 128) + 2 * f_pad * tn * 4 + 8 * tn * 4
+    ) <= _SCATTER_VMEM
+
+
+def _bin_scatter_kernel(
+    ids_ref, aux_ref, out_ref, u_scr, *, k: int, spec, quant: bool, tn: int
+):
+    """One N-tile of the fused pass. Reads the raw binned rows (F x tn s32
+    — F bytes-per-row-class traffic instead of the K_pad-byte one-hot
+    re-stream of the resident-U pass), rebuilds the packed one-hot tile in
+    a VMEM scratch (per-feature iota compare at each feature's static
+    packed offset — the "bin" half), and scatter-adds it into the
+    VMEM-resident accumulator block through one MXU contraction against
+    the node-keyed stat panel (the "scatter-add" half: on TPU a keyed
+    scatter IS a one-hot matmul). The accumulator block never leaves VMEM
+    until the last tile, and on the quantized path it carries the narrow
+    integer dtype picked by ``histogram_acc_dtype``."""
+    j2 = lax.broadcasted_iota(jnp.int32, (128, tn), 0)
+    leaf = (j2 % k).astype(jnp.float32)
+    sidx = j2 // k
+    g, h, c = aux_ref[0:1, :], aux_ref[1:2, :], aux_ref[2:3, :]
+    nodev = aux_ref[3:4, :]
+    val = jnp.where(sidx == 0, g, jnp.where(sidx == 1, h, c))
+    panel = jnp.where((nodev == leaf) & (j2 < 3 * k), val, 0.0)  # (128, tn)
+
+    # Bin: packed one-hot tile, one static-offset compare block per
+    # feature (row ranges are the USpec layout, so bins >= width match
+    # nothing — identical semantics to build_u's local-id compare).
+    for j, (off, w) in enumerate(zip(spec.offsets, spec.widths)):
+        local = lax.broadcasted_iota(jnp.int32, (w, tn), 0)
+        u_scr[off : off + w, :] = (ids_ref[j : j + 1, :] == local).astype(  # graftlint: disable=pallas-tile-alignment
+            jnp.int8
+        )
+    if spec.k < spec.k_pad:
+        u_scr[spec.k :, :] = jnp.zeros((spec.k_pad - spec.k, tn), jnp.int8)  # graftlint: disable=pallas-tile-alignment
+
+    if quant:
+        acc = lax.dot_general(
+            u_scr[...], panel.astype(jnp.int8),
+            (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.int32,
+        )
+    else:
+        acc = lax.dot_general(
+            u_scr[...].astype(jnp.bfloat16), panel.astype(jnp.bfloat16),
+            (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(pl.program_id(0) == 0)
+    def _init():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    out_ref[...] += acc.astype(out_ref.dtype)
+
+
+def build_histograms_bin_scatter(
+    bins: jax.Array,  # (N, F) integer bin indices (ORIGINAL layout, no U)
+    grad: jax.Array,  # (N,) — ignored when stats is given
+    hess: jax.Array,
+    count: jax.Array,
+    node: jax.Array,  # (N,) int32; out-of-range => row contributes nothing
+    num_nodes: int,
+    spec,  # ops.u_histogram.USpec (packed row layout)
+    *,
+    stats=None,  # (3, N) bf16 stat rows, or (stats_i8, scales) quant tuple
+    dequant: bool = True,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Fused bin+scatter-add histogram pass — same contract as
+    ``ops.u_histogram.build_histograms_u`` but fed by the RAW binned rows:
+    per row the pass streams 4F bytes of bins + 32 bytes of stats instead
+    of the K_pad-byte one-hot column of the resident-U formulation (at the
+    bench hot shape: 144 B/row vs ~7 KB/row), trading that HBM saving for
+    the in-VMEM one-hot rebuild each tile. The A/B against the MXU U-path
+    (``benchmarks/hist_u_ab.py``) decides which side of that trade the
+    current chip lands on; the pass exists so the answer is measurable.
+
+    Quant path: s8 x s8 MXU scatter into a VMEM accumulator of the narrow
+    ``histogram_acc_dtype`` width (int16 when the whole-pass 127 * N bound
+    proves it overflow-free, int32 otherwise — deterministic promotion,
+    never a runtime saturation). ``dequant=False`` returns the spec-space
+    integer histogram for exact sibling subtraction, as in
+    ``build_histograms_u``."""
+    from mmlspark_tpu.ops.u_histogram import (
+        _expand_packed,
+        histogram_acc_dtype,
+        stat_rows,
+    )
+
+    scales = None
+    if isinstance(stats, tuple):
+        stats, scales = stats
+    if 3 * num_nodes > 128:
+        raise ValueError(f"panel width 3*{num_nodes} exceeds one lane group")
+    k = num_nodes
+    n, f = bins.shape
+    if not bin_scatter_fits_vmem(spec.k_pad, f):
+        raise ValueError(
+            f"bin+scatter tile k_pad={spec.k_pad} too large for the VMEM "
+            "budget; use the U or compare-built paths"
+        )
+    if interpret is None:
+        interpret = jax.default_backend() not in ("tpu", "axon")
+    if stats is None:
+        stats = stat_rows(grad, hess, count)
+    quant = scales is not None
+
+    tn = _SCATTER_TN
+    pad = (-n) % tn
+    f_pad = -(-f // _SUBLANES) * _SUBLANES
+    ids_t = bins.astype(jnp.int32).T  # (F, N)
+    ids_t = jnp.pad(ids_t, ((0, f_pad - f), (0, pad)), constant_values=-1)
+    aux = jnp.concatenate(
+        [
+            stats.astype(jnp.float32),  # quantized values are small ints
+            node.astype(jnp.float32)[None, :],
+            jnp.zeros((4, n), jnp.float32),
+        ]
+    )
+    if pad:
+        aux = jnp.pad(aux, ((0, 0), (0, pad)))
+        aux = aux.at[3, n:].set(-1.0)  # pad rows match no leaf
+    n_pad = n + pad
+
+    acc_dtype = histogram_acc_dtype(n, quant)
+    packed = pl.pallas_call(
+        functools.partial(
+            _bin_scatter_kernel, k=k, spec=spec, quant=quant, tn=tn
+        ),
+        grid=(n_pad // tn,),
+        in_specs=[
+            pl.BlockSpec((f_pad, tn), lambda i: (0, i)),
+            pl.BlockSpec((8, tn), lambda i: (0, i)),
+        ],
+        out_specs=pl.BlockSpec((spec.k_pad, 128), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((spec.k_pad, 128), acc_dtype),
+        scratch_shapes=[pltpu.VMEM((spec.k_pad, tn), jnp.int8)],
+        interpret=interpret,
+    )(ids_t, aux)
+    packed = packed[:, : 3 * k]
+    if quant and dequant:
+        packed = packed.astype(jnp.int32)
+    return _expand_packed(packed, scales, spec, k, dequant=dequant)

@@ -332,6 +332,26 @@ def stat_rows_quant(
     return stats, scales
 
 
+def histogram_acc_dtype(n_rows: int, quant: bool):
+    """Narrowest histogram-accumulator dtype that is provably overflow-free
+    for ``n_rows`` — the deterministic promotion rule of the quantized
+    path's packed accumulators (LightGBM's quantized training picks
+    per-leaf hist bit widths the same way, from a bound on rows x grad
+    range; here the bound is static per fit so the choice is part of the
+    compiled program, never a runtime saturation check).
+
+    Quantized stats are 127-level ints, so any per-bin partial sum is
+    bounded by ``127 * n_rows`` (counts are 0/1 and bounded by ``n_rows``
+    alone): int16 when that fits, else int32 — still exact integer sums
+    either way, just wider. The f32 path keeps f32 (its sums are not
+    integer, so narrowing would change results)."""
+    if not quant:
+        return jnp.float32
+    if 127 * n_rows <= np.iinfo(np.int16).max:
+        return jnp.int16
+    return jnp.int32
+
+
 def k_pad_fits_vmem(k_pad: int) -> bool:
     """Fused-pass VMEM gate: 2 U blocks (k_pad x 512 s8) + accumulator
     (k_pad x 128 s32) must sit comfortably in VMEM (~24 MB budget)."""
@@ -418,6 +438,7 @@ def build_histograms_u(
     spec: USpec,
     *,
     stats=None,  # (3, N) bf16 from stat_rows(), or (stats_i8, scales) quant
+    dequant: bool = True,
 ) -> jax.Array:
     """(num_nodes, F, B, 3) float32 — same contract as
     ``ops.histogram.build_histograms`` but with the one-hot precomputed.
@@ -430,7 +451,10 @@ def build_histograms_u(
     When ``stats`` is a ``stat_rows_quant`` tuple the pass runs entirely in
     int8 (s8 x s8 MXU, s32 accumulation — exact integer sums of the
     quantized per-row values) and the packed result is dequantized by the
-    per-stat scales; counts stay bit-exact either way."""
+    per-stat scales; counts stay bit-exact either way. ``dequant=False``
+    keeps the quant result in the narrowest provably overflow-free integer
+    dtype (:func:`histogram_acc_dtype`) so the caller can do exact integer
+    sibling subtraction before applying the scales (:func:`dequant_hist`)."""
     scales = None
     if isinstance(stats, tuple):
         stats, scales = stats
@@ -479,7 +503,12 @@ def build_histograms_u(
                 (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32,
             )  # (K_pad, 3k)
 
-    return _expand_packed(packed, scales, spec, k)
+    if scales is not None and not dequant:
+        # narrow to the statically overflow-free accumulator width (exact:
+        # MXU accumulation is s32; the downcast is lossless under the
+        # 127 * n_rows bound histogram_acc_dtype derives from)
+        packed = packed.astype(histogram_acc_dtype(n, quant=True))
+    return _expand_packed(packed, scales, spec, k, dequant=dequant)
 
 
 def _stat_panel_t(
@@ -504,12 +533,21 @@ def _stat_panel_t(
     return lax.optimization_barrier(panel_t)
 
 
-def _expand_packed(packed: jax.Array, scales, spec: USpec, k: int) -> jax.Array:
+def _expand_packed(
+    packed: jax.Array, scales, spec: USpec, k: int, dequant: bool = True
+) -> jax.Array:
     """Shared pass tail: dequantize (quant path — row s*k+j carries stat
     s, so the (3, k) reshape broadcasts each stat's scale over its k node
     columns) and expand the packed (K_pad, 3k) result to the dense
-    (k, F, B, 3) histogram via the static gather maps."""
-    if scales is not None:
+    (k, F, B, 3) histogram via the static gather maps.
+
+    ``dequant=False`` DEFERS the scale multiply: the gather expansion runs
+    in the packed integer domain and the result keeps the accumulator
+    dtype, so callers (the sibling-subtraction cache in the leafwise
+    grower) can subtract parent - child as exact integer sums and apply
+    the scales once, after subtraction — the subtracted sibling is then
+    bit-identical to a directly built one."""
+    if scales is not None and dequant:
         packed = (
             packed.reshape(-1, 3, k).astype(jnp.float32)
             * scales[None, :, None]
@@ -517,8 +555,15 @@ def _expand_packed(packed: jax.Array, scales, spec: USpec, k: int) -> jax.Array:
     f, b = spec.num_features, spec.num_bins
     idx, mask = _dense_maps_cached(spec)
     dense = packed[idx.reshape(-1)].reshape(f, b, 3 * k)
-    dense = dense * mask[:, :, None]
+    dense = dense * jnp.asarray(mask).astype(dense.dtype)[:, :, None]
     return dense.reshape(f, b, 3, k).transpose(3, 0, 1, 2)
+
+
+def dequant_hist(h: jax.Array, scales: jax.Array) -> jax.Array:
+    """Apply the deferred per-stat dequant scales to a spec-space histogram
+    built with ``dequant=False`` (last axis = [g, h, c] — matches the (3,)
+    scale stack from :func:`stat_rows_quant`)."""
+    return h.astype(jnp.float32) * scales
 
 
 def build_histograms_u_chunked(
@@ -531,6 +576,7 @@ def build_histograms_u_chunked(
     spec: USpec,  # chunked (spec.chunk_rows > 0)
     *,
     stats=None,  # (3, N) bf16 from stat_rows(), or (stats_i8, scales) quant
+    dequant: bool = True,
 ) -> jax.Array:
     """Row-chunked variant of :func:`build_histograms_u` — same contract,
     same precision model, but NO fit-resident U: a ``lax.scan`` walks the
@@ -594,10 +640,12 @@ def build_histograms_u_chunked(
                 u_c.astype(jnp.bfloat16), panel_t,
                 (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32,
             )
-        return acc + part, None
+        return acc + part.astype(acc.dtype), None
 
-    acc0 = jnp.zeros(
-        (spec.k_pad, 3 * k), jnp.int32 if quant else jnp.float32
-    )
+    # The scan CARRY is the pass's HBM-resident accumulator — on the quant
+    # path it narrows to the statically overflow-free integer width (the
+    # per-chunk MXU partial is s32, downcast exact under the whole-pass
+    # 127 * n_rows bound, which dominates every chunk partial).
+    acc0 = jnp.zeros((spec.k_pad, 3 * k), histogram_acc_dtype(n, quant))
     packed, _ = lax.scan(chunk_step, acc0, (bins_chunks, node_c, stats_c))
-    return _expand_packed(packed, scales, spec, k)
+    return _expand_packed(packed, scales, spec, k, dequant=dequant)

@@ -7,6 +7,8 @@ agreement with the bf16-input reference model, (b) exact counts, (c) the
 packed per-feature-width layout, and (d) end-to-end training parity when
 the path is forced on CPU (``histogram_method='u'``)."""
 
+import os
+
 import numpy as np
 import jax.numpy as jnp
 import pytest
@@ -488,3 +490,265 @@ class TestFusedPanelDot:
                 (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32))
             np.testing.assert_allclose(fused, ref, rtol=1e-5, atol=1e-3)
+
+
+class TestAccumulatorDtype:
+    """Deterministic overflow promotion for narrow histogram accumulators:
+    f32 on the exact path; on the quant path the narrowest signed int whose
+    range provably holds 127 * n_rows (each quantized stat is in [-127,
+    127], so a bin's partial sum is bounded by 127 * members)."""
+
+    def test_promotion_ladder(self):
+        from mmlspark_tpu.ops.u_histogram import histogram_acc_dtype
+
+        assert histogram_acc_dtype(10**9, False) == jnp.float32
+        assert histogram_acc_dtype(258, True) == jnp.int16  # 127*258 = 32766
+        assert histogram_acc_dtype(259, True) == jnp.int32
+        assert histogram_acc_dtype(1 << 24, True) == jnp.int32
+
+    def test_int16_tier_is_exact(self):
+        import jax
+
+        from mmlspark_tpu.ops.u_histogram import (
+            histogram_acc_dtype,
+            stat_rows_quant,
+        )
+
+        widths, f, b, bins, g, h, c, node = _mixed_case(seed=7, n=200)
+        k = 3
+        spec = make_u_spec(b, f, per_feature=widths)
+        u = build_u(jnp.asarray(bins[:200]), spec)
+        stats = stat_rows_quant(
+            jnp.asarray(g), jnp.asarray(h), jnp.asarray(c),
+            jax.random.PRNGKey(9),
+        )
+        assert histogram_acc_dtype(200, True) == jnp.int16
+        packed16 = build_histograms_u(
+            u, jnp.asarray(g), jnp.asarray(h), jnp.asarray(c),
+            jnp.asarray(node), k, spec, stats=stats, dequant=False,
+        )
+        assert packed16.dtype == jnp.int16
+        full = build_histograms_u(
+            u, jnp.asarray(g), jnp.asarray(h), jnp.asarray(c),
+            jnp.asarray(node), k, spec, stats=stats,
+        )
+        from mmlspark_tpu.ops.u_histogram import dequant_hist
+
+        np.testing.assert_array_equal(
+            np.asarray(dequant_hist(packed16, stats[1])), np.asarray(full)
+        )
+
+
+class TestSiblingSubtraction:
+    """Sibling histogram subtraction (native LightGBM's always-on trick):
+    build only the smaller child, derive the sibling as parent - smaller in
+    PACKED space. On the quant path both orders are exact integer sums, so
+    subtraction-on model text is byte-identical to subtraction-off; on the
+    f32 path they differ only at rounding level."""
+
+    def _fit_case(self, seed=11, n=1400):
+        rng = np.random.default_rng(seed)
+        X = rng.normal(size=(n, 8))
+        y = ((X[:, 0] + X[:, 1] * X[:, 2]) > 0).astype(np.float64)
+        bins, mp = bin_dataset(X, max_bin=63)
+        return X, y, bins, mp
+
+    def _ab(self, bins, y, opts, mp):
+        import dataclasses
+
+        r_on = train(bins, y, opts, mapper=mp)
+        r_off = train(
+            bins, y,
+            dataclasses.replace(opts, histogram_subtraction=False),
+            mapper=mp,
+        )
+        return r_on, r_off
+
+    # Byte-identity is a QUANT-U-PATH property: integer subtraction in
+    # spec space is exact, so the trained model text is identical with
+    # subtraction on or off. Under MMLSPARK_TPU_NO_U=1 the quant request
+    # falls back to f32 compare-built histograms (documented warning),
+    # where parent - smaller rounds differently and a tipped split is
+    # legitimate — the quant tests are skipped there and the f32 contract
+    # (dAUC <= 2e-5) is pinned by test_f32_u_path_parity /
+    # test_compare_built_path_parity instead.
+    _quant_path = pytest.mark.skipif(
+        os.environ.get("MMLSPARK_TPU_NO_U") == "1",
+        reason="quant U path inactive under MMLSPARK_TPU_NO_U=1; f32 "
+               "subtraction parity covered by the dAUC tests",
+    )
+
+    def _assert_model_parity(self, r_on, r_off):
+        assert r_on.booster.model_to_string() == r_off.booster.model_to_string()
+
+    def test_packed_space_subtraction_is_integer_exact(self):
+        import jax
+
+        from mmlspark_tpu.ops.u_histogram import stat_rows_quant
+
+        widths, f, b, bins, g, h, c, _ = _mixed_case(seed=19)
+        n = len(bins)
+        # rows split 2 ways under one parent: node 0 = left, 1 = right
+        child = (np.arange(n) % 3 == 0).astype(np.int32)
+        spec = make_u_spec(b, f, per_feature=widths)
+        u = build_u(jnp.asarray(bins), spec)
+        stats = stat_rows_quant(
+            jnp.asarray(g), jnp.asarray(h), jnp.asarray(c),
+            jax.random.PRNGKey(7),
+        )
+        parent = build_histograms_u(
+            u, jnp.asarray(g), jnp.asarray(h), jnp.asarray(c),
+            jnp.zeros(n, jnp.int32), 1, spec, stats=stats, dequant=False,
+        )
+        both = build_histograms_u(
+            u, jnp.asarray(g), jnp.asarray(h), jnp.asarray(c),
+            jnp.asarray(child), 2, spec, stats=stats, dequant=False,
+        )
+        # parent - directly-built child == directly-built sibling, bit-exact
+        np.testing.assert_array_equal(
+            np.asarray(parent[0] - both[1]), np.asarray(both[0])
+        )
+        np.testing.assert_array_equal(
+            np.asarray(parent[0] - both[0]), np.asarray(both[1])
+        )
+
+    @_quant_path
+    def test_quant_model_text_byte_identical(self):
+        X, y, bins, mp = self._fit_case()
+        opts = TrainOptions(
+            objective="binary", num_iterations=6, num_leaves=15,
+            max_bin=63, histogram_method="u", use_quantized_grad=True,
+        )
+        r_on, r_off = self._ab(bins, y, opts, mp)
+        self._assert_model_parity(r_on, r_off)
+
+    def test_f32_u_path_parity(self):
+        X, y, bins, mp = self._fit_case(seed=13)
+        opts = TrainOptions(
+            objective="binary", num_iterations=6, num_leaves=15,
+            max_bin=63, histogram_method="u",
+        )
+        r_on, r_off = self._ab(bins, y, opts, mp)
+        n = len(y)
+        a_on = auc(y, r_on.booster.raw_margin(X)[:, 0], np.ones(n))
+        a_off = auc(y, r_off.booster.raw_margin(X)[:, 0], np.ones(n))
+        assert abs(a_on - a_off) <= 2e-5, (a_on, a_off)
+
+    @_quant_path
+    def test_bundled_quant_byte_identical(self):
+        # EFB: subtraction must happen in PACKED space (before expansion);
+        # _expand_bundled is linear, so the orders agree — and on the quant
+        # path exactly.
+        rng = np.random.default_rng(31)
+        n = 1400
+        blocks, card = 6, 5
+        X = np.zeros((n, blocks * card))
+        for bl in range(blocks):
+            hot = rng.integers(0, card, n)
+            X[np.arange(n), bl * card + hot] = rng.uniform(0.5, 2.0, n)
+        X = np.hstack([X, rng.normal(size=(n, 3))])
+        y = (X[:, 0] + 2 * X[:, card + 2] + X[:, -1] > 1.2).astype(np.float64)
+        bins, mp = bin_dataset(X, max_bin=63, feature_bundling=True)
+        assert mp.bundles is not None
+        opts = TrainOptions(
+            objective="binary", num_iterations=8, num_leaves=15,
+            max_bin=63, histogram_method="u", use_quantized_grad=True,
+        )
+        r_on, r_off = self._ab(bins, y, opts, mp)
+        self._assert_model_parity(r_on, r_off)
+
+    @_quant_path
+    def test_chunked_quant_byte_identical(self, monkeypatch):
+        X, y, bins, mp = self._fit_case(seed=17)
+        opts = TrainOptions(
+            objective="binary", num_iterations=8, num_leaves=15,
+            max_bin=63, histogram_method="u", use_quantized_grad=True,
+        )
+        monkeypatch.setenv("MMLSPARK_TPU_U_BUDGET", "120000")
+        r_on, r_off = self._ab(bins, y, opts, mp)
+        self._assert_model_parity(r_on, r_off)
+
+    def test_compare_built_path_parity(self, monkeypatch):
+        # MMLSPARK_TPU_NO_U=1: subtraction on the compare-built (non-U)
+        # builders — the packed()/expand() split must hold there too
+        monkeypatch.setenv("MMLSPARK_TPU_NO_U", "1")
+        X, y, bins, mp = self._fit_case(seed=23)
+        opts = TrainOptions(
+            objective="binary", num_iterations=8, num_leaves=15, max_bin=63,
+        )
+        r_on, r_off = self._ab(bins, y, opts, mp)
+        n = len(y)
+        a_on = auc(y, r_on.booster.raw_margin(X)[:, 0], np.ones(n))
+        a_off = auc(y, r_off.booster.raw_margin(X)[:, 0], np.ones(n))
+        assert abs(a_on - a_off) <= 2e-5, (a_on, a_off)
+
+    @_quant_path
+    def test_multiclass_quant_byte_identical(self):
+        X, y, bins, mp = self._fit_case(seed=37)
+        y3 = (X[:, 0] > 0.5).astype(int) + (X[:, 1] > 0).astype(int)
+        opts = TrainOptions(
+            objective="multiclass", num_class=3, num_iterations=4,
+            num_leaves=7, max_bin=63, histogram_method="u",
+            use_quantized_grad=True,
+        )
+        r_on, r_off = self._ab(bins, y3.astype(np.float64), opts, mp)
+        self._assert_model_parity(r_on, r_off)
+
+    def test_event_published(self):
+        from mmlspark_tpu.observability import HistogramSubtracted, get_bus
+
+        X, y, bins, mp = self._fit_case(seed=41)
+        opts = TrainOptions(
+            objective="binary", num_iterations=4, num_leaves=15,
+            max_bin=63, histogram_method="u", use_quantized_grad=True,
+        )
+        seen = []
+        bus = get_bus()
+        bus.add_listener(seen.append)
+        try:
+            train(bins, y, opts, mapper=mp)
+        finally:
+            bus.remove_listener(seen.append)
+        ev = [e for e in seen if isinstance(e, HistogramSubtracted)]
+        assert ev, "subtraction fit must publish HistogramSubtracted"
+        # quant at n > 258 rows -> int32 cache; under NO_U the quant
+        # request falls back to f32 and the event reports that honestly
+        exp = "float32" if os.environ.get("MMLSPARK_TPU_NO_U") == "1" else "int32"
+        assert ev[0].acc_dtype == exp
+        assert ev[0].children_per_split == 1
+        assert ev[0].cache_bytes > 0 and ev[0].bytes_saved_per_tree > 0
+
+    @pytest.mark.slow
+    def test_procfit_two_process_parity(self):
+        # procfit rejects the quant path, so the gang runs f32 histograms:
+        # byte-identity is NOT a property there (parent - smaller rounds
+        # differently than a direct build); the contract is structural
+        # parity (model_texts_close) between subtraction on/off AND between
+        # the 2-process gang and the serial fit, with the gang allreducing
+        # only the smaller child per split.
+        import dataclasses
+
+        from mmlspark_tpu.lightgbm.procfit import (
+            fit_process_group,
+            model_texts_close,
+        )
+
+        X, y, _, _ = self._fit_case(seed=43, n=800)
+        opts = TrainOptions(
+            objective="binary", num_iterations=6, num_leaves=7,
+            max_bin=32, min_data_in_leaf=5, seed=2,
+        )
+        r_on = fit_process_group(
+            X, y, opts, num_processes=2,
+            group_options={"epoch_timeout_s": 180.0},
+        )
+        r_off = fit_process_group(
+            X, y, dataclasses.replace(opts, histogram_subtraction=False),
+            num_processes=2, group_options={"epoch_timeout_s": 180.0},
+        )
+        assert model_texts_close(r_on.model_text, r_off.model_text)
+        bins, mp = bin_dataset(X, max_bin=32)
+        serial = train(bins, y, opts, mapper=mp)
+        assert model_texts_close(
+            r_on.model_text, serial.booster.model_to_string()
+        )
