@@ -375,6 +375,115 @@ def _fit_cpu(X, y, Xt, max_bin=MAX_BIN, cat_idx=None):
     return float(np.median(times)), margins, clf
 
 
+def sweep_guard(block):
+    """Regression guard for the many-models sweep plane (shared with
+    tests/test_sweep.py): batched fitting must beat the candidate-at-a-
+    time baseline on models/sec AND actually amortize compilation — at
+    least one shape-bucket holds >1 candidate, and the batched run
+    compiles strictly fewer programs than it has candidates."""
+    cand = block["sweep_candidates"]
+    assert cand >= 12, block
+    assert max(block["sweep_bucket_sizes"]) > 1, block
+    assert block["sweep_batched_compiles"] < cand, block
+    assert (
+        block["sweep_models_per_sec_batched"]
+        > block["sweep_models_per_sec_sequential"]
+    ), block
+    return block
+
+
+def _sweep_block():
+    """Many-models sweep evidence (docs/automl_sweep.md): a >=12-candidate
+    GBDT grid fit through the batched ``TrainValidSweep`` plane vs the
+    same candidates fit one at a time, with ``ProfileCompiled`` counts as
+    the compile-amortization proof (buckets, not candidates, compile)."""
+    from mmlspark_tpu.automl.hyperparam import GridSpace
+    from mmlspark_tpu.automl.tune import _evaluate
+    from mmlspark_tpu.data.table import Table
+    from mmlspark_tpu.lightgbm import LightGBMClassifier
+    from mmlspark_tpu.observability import ProfileCompiled, get_bus
+    from mmlspark_tpu.sweep import TrainValidSweep, bucket_candidates
+
+    rows = min(N_ROWS, int(os.environ.get("BENCH_SWEEP_ROWS", 20_000)))
+    iters = min(N_ITERS, int(os.environ.get("BENCH_SWEEP_ITERS", 10)))
+    n_cand = max(12, int(os.environ.get("BENCH_SWEEP_CANDIDATES", 12)))
+    # off-grid learning rates (no collision with the headline fits) x two
+    # numLeaves values -> exactly two shape-buckets of n_cand/2 candidates
+    lrs = [
+        round(float(v), 4)
+        for v in np.linspace(0.055, 0.295, -(-n_cand // 2))
+    ]
+    space = GridSpace({"learningRate": lrs, "numLeaves": [15, 31]})
+    maps = list(space.param_maps())
+
+    X, y = _make_data(rows, N_FEATURES, seed=9)
+    tbl = Table({"features": X, "label": y.astype(np.float64)})
+    est = LightGBMClassifier(
+        labelCol="label", featuresCol="features", numIterations=iters,
+    )
+    buckets = bucket_candidates([(est, m) for m in maps])
+
+    bus = get_bus()
+    compiles = []
+    listener = (
+        lambda e: compiles.append(e.name)
+        if isinstance(e, ProfileCompiled) else None
+    )
+
+    sweep = TrainValidSweep(
+        estimator=est, paramSpace=space, labelCol="label",
+        evaluationMetric="AUC", seed=3, commitModel=False,
+    )
+    bus.add_listener(listener)
+    try:
+        t0 = time.perf_counter()
+        swept = sweep.fit(tbl)
+        batched_secs = time.perf_counter() - t0
+    finally:
+        bus.remove_listener(listener)
+    batched_compiles = sum(1 for n in compiles if n == "gbdt.scan_many")
+
+    # candidate-at-a-time baseline on the SAME split/candidates/metric:
+    # each distinct learningRate bakes into its own program, so the
+    # sequential pass pays one compile per candidate
+    mask = sweep._split(tbl.num_rows)
+    train, valid = tbl.filter(mask), tbl.filter(~mask)
+    compiles.clear()
+    bus.add_listener(listener)
+    try:
+        t0 = time.perf_counter()
+        seq_scores = []
+        for m in maps:
+            fitted = est.copy(m).fit(train)
+            seq_scores.append(
+                _evaluate(fitted.transform(valid), "label", "AUC")
+            )
+        seq_secs = time.perf_counter() - t0
+    finally:
+        bus.remove_listener(listener)
+    # the single-model fit compiles as "gbdt.scan" (fused scan path) or
+    # "gbdt.step" (per-iteration path on a device mesh) depending on
+    # dispatch — either way it is one program per distinct learningRate
+    seq_compiles = sum(1 for n in compiles if n in ("gbdt.scan", "gbdt.step"))
+
+    return sweep_guard({
+        "sweep_candidates": len(maps),
+        "sweep_buckets": len(buckets),
+        "sweep_bucket_sizes": [b.size for b in buckets],
+        "sweep_rows": rows,
+        "sweep_iterations": iters,
+        "sweep_batched_secs": round(batched_secs, 3),
+        "sweep_sequential_secs": round(seq_secs, 3),
+        "sweep_models_per_sec_batched": round(len(maps) / batched_secs, 3),
+        "sweep_models_per_sec_sequential": round(len(maps) / seq_secs, 3),
+        "sweep_batched_vs_sequential": round(seq_secs / batched_secs, 3),
+        "sweep_batched_compiles": batched_compiles,
+        "sweep_sequential_compiles": seq_compiles,
+        "sweep_best_params": swept.getBestParams(),
+        "sweep_best_auc": round(float(swept.getBestMetric()), 5),
+    })
+
+
 def main():
     # the BENCH artifact carries its own attribution: per-program
     # compile/execute timing and the roofline section ride in "profiler"
@@ -673,6 +782,11 @@ def main():
     except Exception as e:  # pragma: no cover
         print(f"real cpu baseline failed: {e}", file=sys.stderr)
 
+    # Many-models sweep: >=12-candidate grid, batched vs sequential
+    # models/sec, ProfileCompiled amortization proof. sweep_guard raises
+    # inside — a regression here fails the bench job, not just a number.
+    sweep = _sweep_block()
+
     chunk_events = [
         {
             "rows": e.rows,
@@ -754,6 +868,7 @@ def main():
                 **sub,
                 **sparse,
                 **real,
+                **sweep,
                 # Chunked-U evidence: the static 4M-row selection trace
                 # (proof the >1M shape compiles to the streamed MXU path)
                 # plus any HistogramChunked events the fits above actually

@@ -112,8 +112,24 @@ class DefaultHyperparams:
 
     @staticmethod
     def sgd() -> Dict[str, Dist]:
+        # param names match the VW estimator surface (``l2``, not the
+        # reference's ``l2Regularization`` — that drift made the space
+        # unusable against the real estimators)
         return {
             "learningRate": DoubleRangeHyperParam(0.005, 0.5),
-            "l2Regularization": DoubleRangeHyperParam(1e-8, 1e-2),
+            "l2": DoubleRangeHyperParam(1e-8, 1e-2),
+            "numPasses": DiscreteHyperParam([1, 3, 5]),
+        }
+
+    @staticmethod
+    def vw() -> Dict[str, Dist]:
+        """Text-learner space for the VW estimators: the vmapped lanes
+        (``learningRate``/``powerT``/``l1``/``l2``) plus ``numPasses``,
+        so a random draw shape-buckets into few compiled programs."""
+        return {
+            "learningRate": DoubleRangeHyperParam(0.05, 1.0),
+            "powerT": DiscreteHyperParam([0.0, 0.5]),
+            "l1": DiscreteHyperParam([0.0, 1e-6, 1e-4]),
+            "l2": DoubleRangeHyperParam(1e-8, 1e-3),
             "numPasses": DiscreteHyperParam([1, 3, 5]),
         }

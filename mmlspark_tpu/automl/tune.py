@@ -67,6 +67,15 @@ class TuneHyperparameters(HasLabelCol, Estimator):
     parallelism = Param("Concurrent candidate fits", default=1, converter=to_int,
                         validator=gt(0))
     seed = Param("RNG seed (sampling + fold split)", default=0, converter=to_int)
+    sweepMode = Param(
+        "Candidate execution plane: 'auto' routes batchable candidates "
+        "through the many-models sweep (shape-bucketed vmapped fits, "
+        "mmlspark_tpu.sweep) and falls back to the thread pool on any "
+        "error; 'batched' requires the sweep plane; 'threadpool' forces "
+        "the sequential candidate-at-a-time baseline",
+        default="auto", converter=to_str,
+        validator=lambda v: v in ("auto", "batched", "threadpool"),
+    )
 
     def _folds(self, n: int) -> List[np.ndarray]:
         rng = np.random.default_rng(self.getSeed())
@@ -113,11 +122,30 @@ class TuneHyperparameters(HasLabelCol, Estimator):
             est, params = cand
             return self._cv_metric(est, params, table, folds)
 
-        if self.getParallelism() > 1:
-            with ThreadPoolExecutor(max_workers=self.getParallelism()) as pool:
-                metrics = list(pool.map(run, candidates))
-        else:
-            metrics = [run(c) for c in candidates]
+        metrics: Optional[List[float]] = None
+        mode = self.getSweepMode()
+        if mode in ("auto", "batched"):
+            # many-models plane: per fold, candidates sharing a shape-
+            # bucket fit K-at-once in one compiled program instead of
+            # candidate-at-a-time (singleton buckets degrade to the same
+            # per-candidate fit the thread pool would run)
+            try:
+                from mmlspark_tpu.sweep.batched import cv_metrics_batched
+
+                metrics = cv_metrics_batched(
+                    candidates, table, folds, self.getLabelCol(),
+                    self.getEvaluationMetric(),
+                )
+            except Exception:
+                if mode == "batched":
+                    raise
+                metrics = None  # auto: the thread-pool baseline still works
+        if metrics is None:
+            if self.getParallelism() > 1:
+                with ThreadPoolExecutor(max_workers=self.getParallelism()) as pool:
+                    metrics = list(pool.map(run, candidates))
+            else:
+                metrics = [run(c) for c in candidates]
 
         higher = _is_larger_better(self.getEvaluationMetric())
         # NaN metrics (single-class CV fold, constant labels) rank as worst,

@@ -1390,24 +1390,52 @@ def _opts_key(opts: "TrainOptions"):
     return dataclasses.astuple(opts)
 
 
-def _make_scan_steps(step, per_iter_bag: bool, per_iter_lr: bool = False,
-                     with_u: bool = False):
-    """All boosting iterations in ONE device program: ``lax.scan`` over the
-    per-tree step, per-iteration bagging/feature masks as scanned inputs,
-    stacked tree arrays as the scan output. One dispatch and one bulk fetch
-    replace per-iteration round-trips — on remote-attached chips (axon
-    tunnel) dispatch latency otherwise dominates the entire fit.
+#: TrainOptions fields the many-models plane threads through the compiled
+#: program as TRACED per-candidate data instead of baked constants:
+#: learning_rate rides the scanned (K, iterations) lr stack, and the
+#: bagging/feature-fraction knobs only shape the host-side _mask_schedule
+#: draws (the program consumes the resulting mask stacks, never the
+#: fractions themselves). Everything else — num_leaves, num_iterations,
+#: regularization, objective, seed (GOSS/quantized bake PRNGKey(seed)
+#: statically) — changes the traced program and therefore the bucket.
+MANY_VMAPPED_FIELDS = (
+    "learning_rate",
+    "feature_fraction",
+    "bagging_fraction",
+    "bagging_freq",
+    "pos_bagging_fraction",
+    "neg_bagging_fraction",
+)
 
-    When bagging never resamples (``per_iter_bag=False``) the single (N,)
-    mask is closed over inside the program rather than scanned, so no
-    (iterations, N) buffer is ever materialized. A dynamic learning-rate
-    schedule (``per_iter_lr``) rides as one more scanned (iterations,)
-    input — schedule callbacks keep the one-dispatch fast path.
 
-    ``with_u`` (U histogram path): the caller builds the fit-resident
-    one-hot ONCE per fit and passes it in — building it inside this program
-    would redo the multi-GB materialization once per SEGMENT when the fit
-    is split for the dispatch watchdog."""
+def normalize_many_opts(opts: "TrainOptions") -> "TrainOptions":
+    """Canonical representative of ``opts``' shape-bucket: the vmapped
+    fields pinned to fixed values. Two candidates batch into one compiled
+    program iff their normalized options (plus mapper/objective context)
+    agree — the shape-bucketing rule documented in docs/automl_sweep.md."""
+    return dataclasses.replace(
+        opts,
+        learning_rate=0.0,
+        feature_fraction=1.0,
+        bagging_fraction=1.0,
+        bagging_freq=0,
+        pos_bagging_fraction=1.0,
+        neg_bagging_fraction=1.0,
+    )
+
+
+def many_bucket_key(opts: "TrainOptions"):
+    """Hashable shape-bucket key for the many-models plane."""
+    return _opts_key(normalize_many_opts(opts))
+
+
+def _scan_steps_run(step, per_iter_bag: bool, per_iter_lr: bool = False,
+                    with_u: bool = False):
+    """The UNJITTED scan-over-iterations program body shared by the
+    single-fit fast path (:func:`_make_scan_steps` jits it directly) and
+    the many-models plane (:func:`_make_scan_steps_many` vmaps it over a
+    stacked candidate axis before jitting). Factored so both paths trace
+    the identical per-iteration semantics."""
 
     def run(bins, y, w, margins, edges, bag, fm_all, lr_all, it0, u_arg):
         iters = fm_all.shape[0]
@@ -1435,7 +1463,54 @@ def _make_scan_steps(step, per_iter_bag: bool, per_iter_lr: bool = False,
         margins_out, trees = lax.scan(body, margins, tuple(xs))
         return margins_out, trees
 
+    return run
+
+
+def _make_scan_steps(step, per_iter_bag: bool, per_iter_lr: bool = False,
+                     with_u: bool = False):
+    """All boosting iterations in ONE device program: ``lax.scan`` over the
+    per-tree step, per-iteration bagging/feature masks as scanned inputs,
+    stacked tree arrays as the scan output. One dispatch and one bulk fetch
+    replace per-iteration round-trips — on remote-attached chips (axon
+    tunnel) dispatch latency otherwise dominates the entire fit.
+
+    When bagging never resamples (``per_iter_bag=False``) the single (N,)
+    mask is closed over inside the program rather than scanned, so no
+    (iterations, N) buffer is ever materialized. A dynamic learning-rate
+    schedule (``per_iter_lr``) rides as one more scanned (iterations,)
+    input — schedule callbacks keep the one-dispatch fast path.
+
+    ``with_u`` (U histogram path): the caller builds the fit-resident
+    one-hot ONCE per fit and passes it in — building it inside this program
+    would redo the multi-GB materialization once per SEGMENT when the fit
+    is split for the dispatch watchdog."""
+    run = _scan_steps_run(
+        step, per_iter_bag, per_iter_lr=per_iter_lr, with_u=with_u
+    )
     return jax.jit(run, donate_argnums=(3,))
+
+
+def _make_scan_steps_many(step, per_iter_bag: bool):
+    """The many-models program: vmap the scan body over a leading candidate
+    axis so K same-shaped fits train in ONE compiled dispatch. Data (bins,
+    y, w, edges) is SHARED across candidates (in_axes=None — XLA keeps one
+    copy); margins, per-iteration bagging/feature masks, and the
+    per-iteration learning-rate stack carry the candidate axis. lr is
+    always scanned here: it is the vmapped hyperparameter, and a traced f32
+    scalar is bit-identical to the baked Python float the sequential path
+    closes over (weak f32 typing), so batched and sequential fits agree.
+
+    When no candidate in the bucket bags (``per_iter_bag=False``) the
+    shared (N,) presence mask broadcasts (in_axes=None) and no
+    (K, iterations, N) mask stack ever materializes."""
+    run = _scan_steps_run(
+        step, per_iter_bag=per_iter_bag, per_iter_lr=True, with_u=False
+    )
+    in_axes = (
+        None, None, None, 0, None, 0 if per_iter_bag else None, 0, 0,
+        None, None,
+    )
+    return jax.jit(jax.vmap(run, in_axes=in_axes), donate_argnums=(3,))
 
 
 def _bagging_active(opts: "TrainOptions") -> bool:
@@ -2497,6 +2572,226 @@ def train(
         if (valid_state and opts.early_stopping_round > 0) else -1,
     )
     return TrainResult(booster=booster, evals=evals, best_iteration=best_iter)
+
+
+def train_many(
+    bins: np.ndarray,  # (N, F) uint8 — SHARED by every candidate
+    y: np.ndarray,
+    opts_list: Sequence[TrainOptions],
+    w: Optional[np.ndarray] = None,
+    mapper: Optional[BinMapper] = None,
+    feature_names: Optional[List[str]] = None,
+) -> List[TrainResult]:
+    """Train K candidates of ONE shape-bucket in a single compiled program.
+
+    The many-models plane: every candidate must share
+    :func:`many_bucket_key` (callers bucket heterogeneous grids first and
+    call once per bucket). The per-iteration step is vmapped over a leading
+    candidate axis (:func:`_make_scan_steps_many`), so the whole sweep
+    bucket is one dispatch and one compile — the per-candidate
+    hyperparameters ride as traced data: learning_rate as a scanned
+    (K, iterations) stack, bagging/feature-fraction as host-drawn mask
+    stacks from the same :func:`_mask_schedule` the sequential path uses
+    (identical rng stream per candidate seed, so a batched fit matches the
+    equivalent :func:`train` call).
+
+    Scope (ValueError outside it): single-device (no mesh), gbdt/goss
+    boosting, no validation sets / callbacks / warm start. The U histogram
+    path is bypassed — candidates share the compare-built kernels, which
+    vmap over the candidate axis safely.
+    """
+    opts_list = list(opts_list)
+    if not opts_list:
+        raise ValueError("train_many requires at least one candidate")
+    base_key = many_bucket_key(opts_list[0])
+    for o in opts_list[1:]:
+        if many_bucket_key(o) != base_key:
+            raise ValueError(
+                "train_many candidates must share one shape-bucket "
+                "(many_bucket_key agreement) — partition heterogeneous "
+                "grids into buckets first"
+            )
+    if opts_list[0].boosting_type not in ("gbdt", "goss"):
+        raise ValueError(
+            "train_many supports boosting_type 'gbdt' or 'goss' (dart "
+            "drops trees per host decision; rf averages at the end) — got "
+            f"{opts_list[0].boosting_type!r}"
+        )
+    if opts_list[0].num_iterations <= 0:
+        raise ValueError("train_many requires num_iterations > 0")
+    for o in opts_list:
+        if o.boosting_type == "goss" and o.bagging_fraction < 1.0:
+            raise ValueError(
+                "boosting_type='goss' cannot be combined with bagging"
+            )
+        if o.boosting_type == "goss" and o.top_rate + o.other_rate > 1.0:
+            raise ValueError(
+                "goss requires top_rate + other_rate <= 1 "
+                f"(got {o.top_rate} + {o.other_rate})"
+            )
+        if (
+            o.pos_bagging_fraction < 1.0 or o.neg_bagging_fraction < 1.0
+        ) and o.objective != "binary":
+            raise ValueError(
+                "posBaggingFraction/negBaggingFraction require the binary "
+                f"objective (got {o.objective!r})"
+            )
+
+    objective = get_objective(opts_list[0].objective)
+    num_classes = objective.num_outputs_fn(opts_list[0].num_class)
+    n, f = bins.shape
+    num_bins = opts_list[0].max_bin + 1
+    bundle = getattr(mapper, "bundles", None) if mapper is not None else None
+    if bundle is not None and f != bundle.num_columns:
+        raise ValueError(
+            f"bundled mapper expects packed bins with {bundle.num_columns} "
+            f"columns, got {f}"
+        )
+    f_feat = bundle.num_features if bundle is not None else f
+    if mapper is not None and mapper.cat_values:
+        # same mapper → same slot resolution for every candidate (the
+        # bucket key already agrees on categorical/onehot slots)
+        cat_kw = dict(
+            categorical_slots=tuple(sorted(mapper.cat_values)),
+            onehot_slots=tuple(
+                f_
+                for f_ in sorted(mapper.cat_values)
+                if len(mapper.cat_values[f_])
+                <= opts_list[0].max_cat_to_onehot
+            ),
+        )
+        opts_list = [dataclasses.replace(o, **cat_kw) for o in opts_list]
+    base = normalize_many_opts(opts_list[0])
+    K = len(opts_list)
+    iters = base.num_iterations
+
+    w_is_default = w is None
+    w = (
+        np.ones(n, dtype=np.float32)
+        if w is None
+        else np.asarray(w, dtype=np.float32)
+    )
+    y_np = np.asarray(y, dtype=np.float32)
+    # boost_from_average is static (outside MANY_VMAPPED_FIELDS), so one
+    # init_score serves the whole bucket
+    if base.boost_from_average:
+        init_score = objective.init_score(y_np, num_classes, w)
+    else:
+        init_score = np.zeros(num_classes, dtype=np.float32)
+    margins0 = np.broadcast_to(init_score[None, :], (n, num_classes)).copy()
+    presence = np.ones(n, dtype=np.float32)
+
+    if mapper is not None:
+        edges = np.where(
+            np.isfinite(mapper.edges), mapper.edges,
+            np.float32(np.finfo(np.float32).max),
+        )
+    else:
+        edges = np.zeros((f, 1))
+    edges_dev = jnp.asarray(edges.astype(np.float32))
+    if num_bins <= 256:
+        b8 = np.asarray(bins)
+        b8 = b8 if b8.dtype == np.uint8 else b8.astype(np.uint8)
+        bins_dev = jnp.asarray(np.ascontiguousarray(b8))
+    else:
+        bins_dev = jnp.asarray(np.asarray(bins, dtype=np.int32))
+    if (
+        y_np.size
+        and np.all(np.mod(y_np, 1) == 0)
+        and np.all((y_np >= 0) & (y_np <= 255))
+    ):
+        y_dev = jnp.asarray(y_np.astype(np.uint8)).astype(jnp.float32)
+    else:
+        y_dev = jnp.asarray(y_np)
+    w_dev = jnp.ones(n, jnp.float32) if w_is_default else jnp.asarray(w)
+
+    # Per-candidate host-side schedules: each candidate draws its own
+    # bagging/feature masks from ITS seed and fractions — the exact
+    # sequential-path stream — and its constant learning rate becomes an
+    # (iterations,) lane of the scanned lr stack.
+    any_bag = any(_bagging_active(o) for o in opts_list)
+    bag_stacks: List[np.ndarray] = []
+    fm_stacks: List[np.ndarray] = []
+    lr_stacks: List[np.ndarray] = []
+    for o in opts_list:
+        rng = np.random.default_rng(o.seed)
+        num_bag = max(1, int(round(n * o.bagging_fraction)))
+        num_feat = max(1, int(round(f_feat * o.feature_fraction)))
+        bag_l, fm_l = [], []
+        for bag_np, _, fm_np in _mask_schedule(
+            o, rng, n, 0, num_bag, num_feat, f_feat, presence, y=y_np
+        ):
+            bag_l.append(bag_np)
+            fm_l.append(
+                fm_np if fm_np is not None else np.ones(f_feat, np.float32)
+            )
+        if any_bag:
+            bag_stacks.append(np.stack(bag_l).astype(np.uint8))
+        fm_stacks.append(np.stack(fm_l))
+        lr_stacks.append(np.full(iters, o.learning_rate, dtype=np.float32))
+    margins_many = jnp.asarray(
+        np.broadcast_to(margins0[None], (K, n, num_classes)).copy()
+    )
+    fm_all = jnp.asarray(np.stack(fm_stacks))  # (K, iters, F)
+    lr_all = jnp.asarray(np.stack(lr_stacks))  # (K, iters)
+    bag_arg = (
+        jnp.asarray(np.stack(bag_stacks))  # (K, iters, N) uint8
+        if any_bag
+        else jnp.ones(n, jnp.float32)  # shared presence, broadcast
+    )
+
+    okey = (many_bucket_key(opts_list[0]), num_bins, None, None, bundle,
+            objective.cache_token)
+    if base.boosting_type == "goss":
+        okey = okey + (n,)  # GOSS bakes the unpadded row count
+    step_raw = _cached_program(
+        ("step_raw_many", okey),
+        lambda: _make_step(
+            base, objective, num_bins, None, n_real=n, u_spec=None,
+            bundle=bundle,
+        ),
+    )
+    runner = _cached_program(
+        ("scan_many", okey, any_bag),
+        lambda: _make_scan_steps_many(step_raw, per_iter_bag=any_bag),
+    )
+
+    _prof = get_profiler()
+    _prof_on = _prof.active
+    t0 = time.perf_counter() if _prof_on else 0.0
+    cache_before = (
+        runner._cache_size()
+        if _prof_on and hasattr(runner, "_cache_size") else None
+    )
+    margins_out, stacked = runner(
+        bins_dev, y_dev, w_dev, margins_many, edges_dev, bag_arg, fm_all,
+        lr_all, jnp.int32(0), jnp.int32(0),
+    )
+    if _prof_on:
+        jax.block_until_ready((margins_out, stacked))
+        dt = time.perf_counter() - t0
+        compiled = (
+            cache_before is not None
+            and hasattr(runner, "_cache_size")
+            and runner._cache_size() > cache_before
+        )
+        if compiled:
+            _prof.note_compile("gbdt.scan_many", dt)
+        else:
+            _prof.note_cache_hit("gbdt.scan_many")
+        _prof.note_execute("gbdt.scan_many", dt)
+
+    results: List[TrainResult] = []
+    for ki, o in enumerate(opts_list):
+        cand = jax.tree.map(lambda x, _ki=ki: x[_ki], stacked)
+        booster = _pack_booster(
+            None, cand, o, num_classes, init_score, mapper, feature_names,
+            best_iteration=-1,
+        )
+        results.append(
+            TrainResult(booster=booster, evals={}, best_iteration=0)
+        )
+    return results
 
 
 def _pack_booster(

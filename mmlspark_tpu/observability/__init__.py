@@ -41,6 +41,7 @@ Quick start::
 from mmlspark_tpu.observability.events import (
     BatchFormed,
     BreakerTripped,
+    CandidateBatchFitted,
     Event,
     EventBus,
     EventLogSink,
@@ -69,6 +70,8 @@ from mmlspark_tpu.observability.events import (
     StreamEpochCommitted,
     StreamEpochStarted,
     StreamSourceAdvanced,
+    SweepCompleted,
+    SweepStarted,
     TaskDispatched,
     TaskFailed,
     TaskRecovered,
@@ -135,6 +138,7 @@ def __getattr__(name):
 __all__ = [
     "BatchFormed",
     "BreakerTripped",
+    "CandidateBatchFitted",
     "Counter",
     "DEFAULT_BUCKETS",
     "DeviceProfiler",
@@ -177,6 +181,8 @@ __all__ = [
     "StreamEpochCommitted",
     "StreamEpochStarted",
     "StreamSourceAdvanced",
+    "SweepCompleted",
+    "SweepStarted",
     "TRACE_HEADER",
     "TaskDispatched",
     "TaskFailed",

@@ -260,6 +260,47 @@ class ModelCommitted(Event):
     detail: str = ""
 
 
+# -- many-models sweep plane -------------------------------------------------
+
+
+@_event
+class SweepStarted(Event):
+    """A hyperparameter sweep began: ``candidates`` param maps partitioned
+    into ``buckets`` shape-buckets (each bucket = one compiled program).
+    ``mode`` is "inline" or "gang" (ProcessGroup-sharded buckets)."""
+
+    candidates: int
+    buckets: int
+    estimator: str = ""
+    mode: str = "inline"
+
+
+@_event
+class CandidateBatchFitted(Event):
+    """One shape-bucket finished fitting: ``size`` candidates trained in
+    one vmapped program when ``batched`` (a singleton / non-batchable
+    bucket fell back to the sequential fit)."""
+
+    bucket: int
+    size: int
+    kind: str = ""
+    batched: bool = True
+    seconds: float = 0.0
+
+
+@_event
+class SweepCompleted(Event):
+    """The sweep selected its best candidate (``best_index`` into the
+    candidate list) and, when a checkpoint dir is configured, committed
+    the refit best model as ModelStore ``version``."""
+
+    candidates: int
+    best_index: int
+    best_metric: float
+    version: int = -1
+    seconds: float = 0.0
+
+
 # -- serving fleet -----------------------------------------------------------
 
 

@@ -1,0 +1,29 @@
+"""mmlspark_tpu.sweep — the many-models training plane.
+
+Where Spark parallelizes model search across executors, XLA can train
+many small models in ONE compiled program: candidates that share static
+shapes batch over a vmapped candidate axis, heterogeneous grids partition
+into shape-buckets, and each bucket amortizes a single compile. See
+``docs/automl_sweep.md`` for the bucketing rules and
+:class:`TrainValidSweep` for the estimator surface.
+"""
+
+from mmlspark_tpu.sweep.batched import cv_metrics_batched, fit_bucket
+from mmlspark_tpu.sweep.bucketing import (
+    GBDT_VMAPPED,
+    VW_VMAPPED,
+    CandidateBucket,
+    bucket_candidates,
+)
+from mmlspark_tpu.sweep.estimator import TrainValidSweep, TrainValidSweepModel
+
+__all__ = [
+    "CandidateBucket",
+    "GBDT_VMAPPED",
+    "TrainValidSweep",
+    "TrainValidSweepModel",
+    "VW_VMAPPED",
+    "bucket_candidates",
+    "cv_metrics_batched",
+    "fit_bucket",
+]

@@ -1,0 +1,209 @@
+"""Gang-sharded sweeps: candidate buckets across a supervised ProcessGroup.
+
+When a sweep outgrows one chip, :class:`TrainValidSweep` (``numProcesses``
+> 1) hands its shape-buckets to a real worker gang — the same
+:class:`~mmlspark_tpu.runtime.procgroup.ProcessGroup` machinery procfit
+uses: heartbeats, gang recovery, fault injection. The unit of work is one
+BUCKET (task-per-bucket): worker ``rank`` owns bucket ``bi`` iff
+``bi % world == rank``, and each finished bucket commits its scores to a
+per-bucket :class:`~mmlspark_tpu.runtime.journal.FitJournal` (one journal
+per bucket — single writer, no cross-process append races).
+
+Fault model: a worker SIGKILL'd mid-bucket takes down the epoch; the gang
+re-forms and every already-journaled bucket is SKIPPED (``TaskRecovered``
+per restored bucket, zero re-execution). Selection is driver-side and
+reads ONLY the journals — worker return values never decide the model —
+so the final leaderboard and committed ``ModelStore`` version are
+identical to an undisturbed run.
+"""
+
+from __future__ import annotations
+
+import pickle
+from pathlib import Path
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from mmlspark_tpu.core.profiling import get_logger
+
+logger = get_logger("mmlspark_tpu.sweep.distributed")
+
+
+def _bucket_journal_key(journal_key: str, bi: int) -> str:
+    return f"{journal_key}-bucket{bi}"
+
+
+# -- worker side --------------------------------------------------------------
+
+
+def sweep_worker_entry(ctx) -> Dict[str, Any]:
+    """Per-member sweep entry, invoked by ``procgroup.worker_main`` inside
+    a formed epoch. Walks the bucket list in index order: journaled
+    buckets are skipped (recovery), owned un-journaled buckets are fitted
+    and their scores journaled. Returns a JSON-safe summary; scores ride
+    the journals."""
+    from mmlspark_tpu.observability import TaskRecovered, get_bus
+    from mmlspark_tpu.runtime.journal import FitJournal
+    from mmlspark_tpu.sweep.batched import fit_bucket
+    from mmlspark_tpu.sweep.bucketing import CandidateBucket
+
+    payload = ctx.payload
+    with open(payload["spec"], "rb") as fh:
+        spec = pickle.load(fh)
+    journal_root = payload["journal_root"]
+    journal_key = payload["journal_key"]
+    table = spec["table"]
+    mask = np.asarray(spec["train_mask"], dtype=bool)
+    train, valid = table.filter(mask), table.filter(~mask)
+    kinds = spec["kinds"]
+    bus = get_bus()
+
+    fitted: List[int] = []
+    recovered: List[int] = []
+    for bi in range(len(kinds)):
+        # the designated death point for kill_process chaos: every member
+        # walks every bucket index (rank assignment is rendezvous-order,
+        # so a member-targeted directive must not depend on ownership),
+        # and a directive keyed (member, iteration=bi, epoch) SIGKILLs
+        # here — mid-sweep, with earlier buckets already journaled
+        ctx.maybe_die(bi)
+        owned = bi % ctx.world == ctx.rank
+        journal = FitJournal(
+            journal_root, key=_bucket_journal_key(journal_key, bi),
+            num_tasks=1,
+        )
+        try:
+            if 0 in journal.restore():
+                # committed before this epoch — zero re-execution; the
+                # owner books the scheduler's checkpoint-recovery event
+                if owned:
+                    recovered.append(bi)
+                    if bus.active:
+                        bus.publish(TaskRecovered(job_id=0, task_id=bi))
+                continue
+            if not owned:
+                continue
+            bucket = CandidateBucket(
+                estimator=spec["estimator"], kind=kinds[bi],
+                param_maps=spec["param_maps"][bi],
+                indices=spec["indices"][bi],
+            )
+            scored = fit_bucket(
+                bucket, train, valid, spec["label_col"], spec["metric"],
+                bucket_index=bi,
+            )
+            journal.record(0, {
+                "indices": [int(i) for i in bucket.indices],
+                "scores": [float(s) for s, _ in scored],
+            })
+            fitted.append(bi)
+        finally:
+            journal.close()
+    if fitted or recovered:
+        logger.info(
+            "sweep member %d (rank %d/%d, epoch %d): fit %s, recovered %s",
+            ctx.member, ctx.rank, ctx.world, ctx.epoch, fitted, recovered,
+        )
+    return {
+        "rank": ctx.rank, "world": ctx.world, "epoch": ctx.epoch,
+        "fitted": fitted, "recovered": recovered,
+    }
+
+
+# -- driver side --------------------------------------------------------------
+
+
+def run_sweep_process_group(
+    estimator,
+    buckets,
+    table,
+    train_mask: np.ndarray,
+    label_col: str,
+    metric: str,
+    num_processes: int,
+    *,
+    num_candidates: int,
+    seed: int = 0,
+    workdir: Optional[str] = None,
+    journal_root: Optional[str] = None,
+    journal_key: str = "sweep",
+    group_options: Optional[Dict[str, Any]] = None,
+    owner=None,
+) -> List[float]:
+    """Shard ``buckets`` across ``num_processes`` worker processes and
+    return the per-candidate validation metrics in candidate order.
+
+    The driver parks the candidate spec (estimator + bucket descriptors +
+    table + split mask) in the group workdir, pre-creates every bucket
+    journal (so worker constructors stay read-only), runs the gang, then
+    assembles scores from the journals — never from worker return values,
+    so a chaotic run selects exactly like an undisturbed one.
+    """
+    from mmlspark_tpu.runtime.journal import FitJournal
+    from mmlspark_tpu.runtime.procgroup import ProcessGroup
+
+    if workdir is None:
+        import tempfile
+
+        workdir = tempfile.mkdtemp(prefix="mmlspark-tpu-sweep-")
+    wd = Path(workdir)
+    wd.mkdir(parents=True, exist_ok=True)
+    if journal_root is None:
+        journal_root = str(wd / "journal")
+
+    spec = {
+        "estimator": estimator,
+        "kinds": [b.kind for b in buckets],
+        "param_maps": [b.param_maps for b in buckets],
+        "indices": [b.indices for b in buckets],
+        "table": table,
+        "train_mask": np.asarray(train_mask, dtype=bool),
+        "label_col": label_col,
+        "metric": metric,
+    }
+    spec_path = wd / "spec.pkl"
+    with open(spec_path, "wb") as fh:
+        pickle.dump(spec, fh, protocol=4)
+    for bi in range(len(buckets)):
+        FitJournal(journal_root, key=_bucket_journal_key(journal_key, bi),
+                   num_tasks=1).close()
+
+    payload = {
+        "spec": str(spec_path),
+        "journal_root": journal_root,
+        "journal_key": journal_key,
+    }
+    gkw = dict(group_options or {})
+    gkw.setdefault("seed", seed)
+    pg = ProcessGroup(
+        num_processes, "mmlspark_tpu.sweep.distributed:sweep_worker_entry",
+        payload=payload, workdir=str(wd / "group"), rendezvous="jax", **gkw,
+    )
+    try:
+        worker_results = pg.run()
+    finally:
+        exit_statuses = pg.exit_statuses + pg.shutdown()
+
+    metrics: List[float] = [float("nan")] * num_candidates
+    for bi in range(len(buckets)):
+        journal = FitJournal(
+            journal_root, key=_bucket_journal_key(journal_key, bi),
+            num_tasks=1,
+        )
+        rec = journal.restore().get(0)
+        journal.close()
+        if rec is None:
+            raise RuntimeError(
+                f"sweep bucket {bi} never committed; worker results: "
+                f"{worker_results}"
+            )
+        for idx, score in zip(rec["indices"], rec["scores"]):
+            metrics[int(idx)] = float(score)
+    if owner is not None:
+        owner._process_sweep = {
+            "epochs": pg.epoch + 1,
+            "worker_results": worker_results,
+            "exit_statuses": exit_statuses,
+        }
+    return metrics

@@ -208,7 +208,12 @@ class VowpalWabbitBase(VowpalWabbitBaseParams, Estimator):
         dense = np.asarray(col, dtype=np.float32)
         return dense_to_batch(dense, dense.shape[1] + 1), False
 
-    def _fit(self, table: Table) -> "VowpalWabbitModelBase":
+    def _train_setup(self, table: Table):
+        """Everything ``_fit`` resolves BEFORE the numeric train loop:
+        (args, batch, y, w, const_idx, init). Factored so the many-models
+        plane (``sweep/batched.py``) can prepare rows once per bucket and
+        route K candidates through :func:`train_linear_many` while this
+        estimator's single-fit path stays the reference semantics."""
         args = self._parse_args()
         batch, is_hashed = self._get_batch(table, num_bits=args.get("num_bits"))
         y = self._label_transform(
@@ -237,6 +242,10 @@ class VowpalWabbitBase(VowpalWabbitBaseParams, Estimator):
         init = None
         if self.isSet("initialModel"):
             init = np.asarray(self.getInitialModel(), dtype=np.float32)
+        return args, batch, y, w, const_idx, init
+
+    def _fit(self, table: Table) -> "VowpalWabbitModelBase":
+        args, batch, y, w, const_idx, init = self._train_setup(table)
 
         result = train_linear(
             batch,
@@ -276,6 +285,46 @@ class VowpalWabbitBase(VowpalWabbitBaseParams, Estimator):
         raise NotImplementedError
 
 
+def _prep_rows(
+    batch: SparseBatch,
+    y: np.ndarray,
+    sample_weight: np.ndarray,
+    constant_index: int,
+    batch_size: int,
+    n_shards: int,
+):
+    """Row layout shared by the single-fit and many-models paths: append
+    the constant feature, pad rows to ``n_shards * num_batches *
+    batch_size``. Padding rides with zero value/weight so it never moves
+    the weights. Returns (idx, val, y, sample_weight, k, num_batches)."""
+    n, k = batch.indices.shape
+
+    if constant_index >= 0:
+        # append the constant feature to every row
+        idx = np.concatenate(
+            [batch.indices, np.full((n, 1), constant_index, dtype=np.int32)], axis=1
+        )
+        val = np.concatenate([batch.values, np.ones((n, 1), dtype=np.float32)], axis=1)
+        k += 1
+    else:
+        idx, val = batch.indices, batch.values
+
+    rows_per_shard = -(-n // n_shards)  # ceil
+    num_batches = -(-rows_per_shard // batch_size)
+    padded = n_shards * num_batches * batch_size
+    pad = padded - n
+    if pad:
+        idx = np.concatenate([idx, np.zeros((pad, k), dtype=np.int32)])
+        val = np.concatenate([val, np.zeros((pad, k), dtype=np.float32)])
+        y = np.concatenate([y.astype(np.float32), np.zeros(pad, dtype=np.float32)])
+        sample_weight = np.concatenate(
+            [sample_weight, np.zeros(pad, dtype=np.float32)]
+        )
+    else:
+        y = y.astype(np.float32)
+    return idx, val, y, sample_weight, k, num_batches
+
+
 def train_linear(
     batch: SparseBatch,
     y: np.ndarray,
@@ -304,33 +353,13 @@ def train_linear(
     from jax.sharding import PartitionSpec as P
 
     sw = StopWatch()
-    n, k = batch.indices.shape
     dim = batch.dim
-
-    if constant_index >= 0:
-        # append the constant feature to every row
-        idx = np.concatenate(
-            [batch.indices, np.full((n, 1), constant_index, dtype=np.int32)], axis=1
-        )
-        val = np.concatenate([batch.values, np.ones((n, 1), dtype=np.float32)], axis=1)
-        k += 1
-    else:
-        idx, val = batch.indices, batch.values
+    n = batch.num_rows
 
     n_shards = int(mesh.shape["data"]) if mesh is not None else 1
-    rows_per_shard = -(-n // n_shards)  # ceil
-    num_batches = -(-rows_per_shard // batch_size)
-    padded = n_shards * num_batches * batch_size
-    pad = padded - n
-    if pad:
-        idx = np.concatenate([idx, np.zeros((pad, k), dtype=np.int32)])
-        val = np.concatenate([val, np.zeros((pad, k), dtype=np.float32)])
-        y = np.concatenate([y.astype(np.float32), np.zeros(pad, dtype=np.float32)])
-        sample_weight = np.concatenate(
-            [sample_weight, np.zeros(pad, dtype=np.float32)]
-        )
-    else:
-        y = y.astype(np.float32)
+    idx, val, y, sample_weight, k, num_batches = _prep_rows(
+        batch, y, sample_weight, constant_index, batch_size, n_shards
+    )
 
     w0 = (
         initial_weights.copy()
@@ -450,6 +479,179 @@ def train_linear(
         "ipass_loss": None,
     }
     return VWTrainResult(weights=fitted, stats=stats)
+
+
+#: compiled many-models fit programs, keyed on the trace-shaping statics
+#: (everything else — shapes, lr/power_t/l1/l2 — is traced data)
+_MANY_FIT_CACHE: dict = {}
+
+
+def _make_fit_many(loss, num_passes, optimizer, quantile_tau, ftrl_alpha,
+                   ftrl_beta):
+    """The vmapped VW fit: one candidate's whole SGD run as a function of
+    TRACED (lr, power_t, l1, l2) scalars, vmapped over a leading candidate
+    axis. The minibatch stream (bidx/bval/by/bw) is shared across
+    candidates (in_axes=None — one device copy). The regularization terms
+    are applied UNCONDITIONALLY (the sequential path branches on Python
+    truthiness): at 0.0 each form is the exact identity — ``g + 0*...``,
+    ``lr/(1+t)**0 == lr``, ``sign(w)*max(|w|-0, 0) == w`` — so a batched
+    candidate matches its :func:`train_linear` fit."""
+    import jax
+    import jax.numpy as jnp
+
+    def fit_one(bidx, bval, by, bw, weights, acc, lr, power_t, l1, l2):
+        def step(carry, xs):
+            weights, acc, t = carry
+            bi, bv, yy, ww = xs
+            wi = weights[bi]  # (B, K) gather
+            margin = jnp.sum(wi * bv, axis=1)
+            g_row = _loss_grad(loss, margin, yy, quantile_tau) * ww
+            g = g_row[:, None] * bv  # (B, K)
+            g = g + l2 * wi * (bv != 0)
+            flat_i = bi.reshape(-1)
+            flat_g = g.reshape(-1)
+            acc = acc.at[flat_i].add(flat_g * flat_g)
+            denom = jnp.sqrt(acc[flat_i]) + 1e-6
+            step_t = lr / ((1.0 + t) ** power_t)
+            weights = weights.at[flat_i].add(-step_t * flat_g / denom)
+            return (weights, acc, t + 1.0), None
+
+        def ftrl_w(z, nacc):
+            w = -(z - jnp.sign(z) * l1) / (
+                (ftrl_beta + jnp.sqrt(nacc)) / ftrl_alpha + l2
+            )
+            return jnp.where(jnp.abs(z) > l1, w, 0.0)
+
+        def step_ftrl(carry, xs):
+            z, nacc, t = carry
+            bi, bv, yy, ww = xs
+            zi, ni = z[bi], nacc[bi]
+            wi = ftrl_w(zi, ni)
+            margin = jnp.sum(wi * bv, axis=1)
+            g = (_loss_grad(loss, margin, yy, quantile_tau) * ww)[:, None] * bv
+            sigma = (jnp.sqrt(ni + g * g) - jnp.sqrt(ni)) / ftrl_alpha
+            flat_i = bi.reshape(-1)
+            z = z.at[flat_i].add((g - sigma * wi).reshape(-1))
+            nacc = nacc.at[flat_i].add((g * g).reshape(-1))
+            return (z, nacc, t + 1.0), None
+
+        t = jnp.zeros(())
+        if optimizer == "ftrl":
+            z = -weights * (ftrl_beta / ftrl_alpha + l2)
+            nacc = acc
+            for _ in range(num_passes):
+                (z, nacc, t), _ = jax.lax.scan(
+                    step_ftrl, (z, nacc, t), (bidx, bval, by, bw)
+                )
+            return ftrl_w(z, nacc)
+        for _ in range(num_passes):
+            (weights, acc, t), _ = jax.lax.scan(
+                step, (weights, acc, t), (bidx, bval, by, bw)
+            )
+        return jnp.sign(weights) * jnp.maximum(jnp.abs(weights) - l1, 0.0)
+
+    return jax.jit(jax.vmap(
+        fit_one, in_axes=(None, None, None, None, 0, 0, 0, 0, 0, 0)
+    ))
+
+
+def train_linear_many(
+    batch: SparseBatch,
+    y: np.ndarray,
+    sample_weight: np.ndarray,
+    *,
+    loss: str,
+    num_passes: int,
+    learning_rates,
+    power_ts,
+    l1s,
+    l2s,
+    batch_size: int,
+    constant_index: int,
+    initial_weights: Optional[np.ndarray] = None,
+    quantile_tau: float = 0.5,
+    optimizer: str = "adagrad",
+    ftrl_alpha: float = 0.005,
+    ftrl_beta: float = 0.1,
+) -> "list[VWTrainResult]":
+    """Train K VW candidates in ONE compiled program (the many-models
+    plane). Candidates share the data, loss, pass count, batch size, and
+    optimizer — the shape-bucket statics — and differ only in the traced
+    (learning_rate, power_t, l1, l2) lanes. Single device only (the
+    sweep's gang mode shards BUCKETS across processes instead)."""
+    import jax
+    import jax.numpy as jnp
+
+    from mmlspark_tpu.observability.profiler import get_profiler
+
+    K = len(learning_rates)
+    if not (K == len(power_ts) == len(l1s) == len(l2s)):
+        raise ValueError("per-candidate hyperparameter stacks disagree on K")
+    sw = StopWatch()
+    dim = batch.dim
+    n = batch.num_rows
+    idx, val, y, sample_weight, k, num_batches = _prep_rows(
+        batch, y, sample_weight, constant_index, batch_size, 1
+    )
+    w0 = (
+        initial_weights.copy()
+        if initial_weights is not None
+        else np.zeros(dim, dtype=np.float32)
+    )
+
+    ckey = (loss, int(num_passes), optimizer, float(quantile_tau),
+            float(ftrl_alpha), float(ftrl_beta))
+    fit = _MANY_FIT_CACHE.get(ckey)
+    if fit is None:
+        fit = _MANY_FIT_CACHE[ckey] = _make_fit_many(*ckey)
+
+    bidx = jnp.asarray(idx.reshape(num_batches, batch_size, k))
+    bval = jnp.asarray(val.reshape(num_batches, batch_size, k))
+    by = jnp.asarray(y.reshape(num_batches, batch_size))
+    bw = jnp.asarray(sample_weight.reshape(num_batches, batch_size))
+    weights0 = jnp.asarray(np.broadcast_to(w0[None], (K, dim)).copy())
+    acc0 = jnp.zeros((K, dim), jnp.float32)
+
+    _prof = get_profiler()
+    _prof_on = _prof.active
+    with sw.measure():
+        t0 = time.perf_counter() if _prof_on else 0.0
+        cache_before = (
+            fit._cache_size()
+            if _prof_on and hasattr(fit, "_cache_size") else None
+        )
+        fitted = fit(
+            bidx, bval, by, bw, weights0, acc0,
+            jnp.asarray(np.asarray(learning_rates, np.float32)),
+            jnp.asarray(np.asarray(power_ts, np.float32)),
+            jnp.asarray(np.asarray(l1s, np.float32)),
+            jnp.asarray(np.asarray(l2s, np.float32)),
+        )
+        fitted = np.asarray(jax.block_until_ready(fitted))
+        if _prof_on:
+            dt = time.perf_counter() - t0
+            compiled = (
+                cache_before is not None
+                and hasattr(fit, "_cache_size")
+                and fit._cache_size() > cache_before
+            )
+            if compiled:
+                _prof.note_compile("vw.fit_many", dt)
+            else:
+                _prof.note_cache_hit("vw.fit_many")
+            _prof.note_execute("vw.fit_many", dt)
+
+    results = []
+    for ki in range(K):
+        stats = {
+            "rows": int(n),
+            "passes": int(num_passes),
+            "learn_time_s": sw.elapsed_s,
+            "shards": 1,
+            "ipass_loss": None,
+        }
+        results.append(VWTrainResult(weights=fitted[ki], stats=stats))
+    return results
 
 
 class VowpalWabbitModelBase(HasFeaturesCol, HasPredictionCol, Model):

@@ -888,4 +888,6 @@ EXEMPT: Dict[str, str] = {
     "mmlspark_tpu.automl.tune.TuneHyperparametersModel": "produced by TuneHyperparameters; covered in test_automl",
     "mmlspark_tpu.automl.tune.FindBestModel": "model-selection meta-stage; covered in test_automl",
     "mmlspark_tpu.automl.tune.BestModel": "produced by FindBestModel; covered in test_automl",
+    "mmlspark_tpu.sweep.estimator.TrainValidSweep": "estimator-of-estimators; covered in test_sweep (needs param spaces)",
+    "mmlspark_tpu.sweep.estimator.TrainValidSweepModel": "produced by TrainValidSweep; covered in test_sweep",
 }

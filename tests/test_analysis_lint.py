@@ -275,6 +275,24 @@ class TestLockDiscipline:
             )
         ) == ["lock-discipline"]
 
+    def test_sweep_paths_covered(self):
+        # the many-models plane shares journals and process gangs; a
+        # blocking call under one of its locks would stall every bucket
+        src = (
+            "import threading, time\n"
+            "lock = threading.Lock()\n"
+            "def f():\n"
+            "    with lock:\n"
+            "        time.sleep(1)\n"
+        )
+        assert rules_of(
+            lint_source(
+                src,
+                path="mmlspark_tpu/sweep/fake.py",
+                select=["lock-discipline"],
+            )
+        ) == ["lock-discipline"]
+
     def test_outside_runtime_serving_not_flagged(self):
         src = (
             "import threading, time\n"
