@@ -44,6 +44,14 @@ Subpackages mirror the reference's component inventory (SURVEY.md §2):
 
 __version__ = "0.1.0"
 
+# The runtime lock witness (MMLSPARK_TPU_LOCKCHECK=1) must wrap
+# threading.Lock/RLock before any package module allocates one, so this
+# hook runs ahead of every other package import. No-op unless the env
+# var is set.
+from mmlspark_tpu.analysis.witness import install_from_env as _install_lock_witness
+
+_install_lock_witness()
+
 from mmlspark_tpu.core.params import Param, Params
 from mmlspark_tpu.core.pipeline import (
     Estimator,

@@ -10,6 +10,15 @@ halves:
   traced code, (8, 128) Pallas tile alignment, lock discipline in the
   threaded runtime/serving layers, and the bare-except policy. Run as
   ``python -m mmlspark_tpu.analysis.lint <paths>``.
+- the whole-program concurrency & protocol analyzer
+  (:mod:`mmlspark_tpu.analysis.lockgraph`,
+  :mod:`mmlspark_tpu.analysis.concurrency`): interprocedural lock-order
+  cycles (ABBA deadlocks), blocking calls under locks, collective
+  deadline/rank-uniformity checks, and WAL/journal/tmp+rename protocol
+  ordering — backed by the cross-module reachability index in
+  :mod:`mmlspark_tpu.analysis.traced` and cross-checked at runtime by the
+  lock witness (:mod:`mmlspark_tpu.analysis.witness`,
+  ``MMLSPARK_TPU_LOCKCHECK=1``).
 - the pipeline schema validator: stages declare ``transform_schema`` and
   ``Pipeline.validate()`` propagates column schemas through the stage
   graph at construction time (:mod:`mmlspark_tpu.core.schema`).
@@ -34,6 +43,12 @@ __all__ = [
     "register_rule",
     "lint_paths",
     "lint_source",
+    "ConcurrencyIndex",
+    "LockWitness",
+    "install_from_env",
+    "check_witness",
+    "load_reports",
+    "to_sarif",
 ]
 
 
@@ -44,4 +59,17 @@ def __getattr__(name):
         from mmlspark_tpu.analysis import lint
 
         return getattr(lint, name)
+    if name == "ConcurrencyIndex":
+        from mmlspark_tpu.analysis.lockgraph import ConcurrencyIndex
+
+        return ConcurrencyIndex
+    if name in ("LockWitness", "install_from_env", "check_witness",
+                "load_reports"):
+        from mmlspark_tpu.analysis import witness
+
+        return getattr(witness, name)
+    if name == "to_sarif":
+        from mmlspark_tpu.analysis.sarif import to_sarif
+
+        return to_sarif
     raise AttributeError(name)

@@ -18,7 +18,9 @@ from __future__ import annotations
 
 import ast
 import dataclasses
+import io
 import re
+import tokenize
 from typing import Dict, Iterator, List, Optional, Set, Type
 
 _SUPPRESS_RE = re.compile(r"#\s*graftlint:\s*disable(?:=([\w\-, ]+))?")
@@ -46,16 +48,26 @@ class FileContext:
         self.source = source
         self.lines = source.splitlines()
         self.tree = ast.parse(source, filename=path)
-        self.suppressions = self._parse_suppressions(self.lines)
+        self.suppressions = self._parse_suppressions(source)
 
     @staticmethod
-    def _parse_suppressions(lines: List[str]) -> Dict[int, Set[str]]:
+    def _parse_suppressions(source: str) -> Dict[int, Set[str]]:
+        # Tokenize so only genuine comments count: docstrings or string
+        # literals that merely *mention* the disable syntax must neither
+        # silence findings on their line nor show up as stale suppressions.
         out: Dict[int, Set[str]] = {}
-        for i, line in enumerate(lines, start=1):
-            m = _SUPPRESS_RE.search(line)
+        try:
+            tokens = list(tokenize.generate_tokens(io.StringIO(source).readline))
+        except (tokenize.TokenError, IndentationError):
+            return out
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _SUPPRESS_RE.search(tok.string)
             if not m:
                 continue
             names = m.group(1)
+            i = tok.start[0]
             if names is None:
                 out[i] = {"*"}
             else:
@@ -104,7 +116,8 @@ def register_rule(cls: Type[Rule]) -> Type[Rule]:
 
 
 def all_rules() -> Dict[str, Type[Rule]]:
-    # Import triggers registration of the builtin rule set.
+    # Imports trigger registration of the builtin rule set.
+    from mmlspark_tpu.analysis import concurrency as _concurrency  # noqa: F401
     from mmlspark_tpu.analysis import rules as _rules  # noqa: F401
 
     return dict(_RULE_REGISTRY)

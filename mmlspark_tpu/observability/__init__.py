@@ -60,6 +60,7 @@ from mmlspark_tpu.observability.events import (
     ProcessStarted,
     ProfileCompiled,
     ProfileExecuted,
+    RegistryRecovered,
     RegistryUnavailable,
     RequestRouted,
     RequestServed,
@@ -168,6 +169,7 @@ __all__ = [
     "ProcessStarted",
     "ProfileCompiled",
     "ProfileExecuted",
+    "RegistryRecovered",
     "RegistryUnavailable",
     "RequestRouted",
     "RequestServed",

@@ -349,6 +349,18 @@ class RegistryUnavailable(Event):
 
 
 @_event
+class RegistryRecovered(Event):
+    """The paired recovery for :class:`RegistryUnavailable`: the same
+    consumer (``source``) reached the registry again and its routing
+    table / heartbeat / steering snapshot is fresh. Published once per
+    outage end, so the event log carries both edges of every registry
+    outage and duration can be audited offline."""
+
+    source: str
+    replicas: int = 0
+
+
+@_event
 class LeaseRecovered(Event):
     """A restarted :class:`RegistrationService` recovered one journaled
     replica lease from disk (CRC-verified, ``age_s`` since it was

@@ -283,11 +283,11 @@ def _bin_scatter_kernel(
     # nothing — identical semantics to build_u's local-id compare).
     for j, (off, w) in enumerate(zip(spec.offsets, spec.widths)):
         local = lax.broadcasted_iota(jnp.int32, (w, tn), 0)
-        u_scr[off : off + w, :] = (ids_ref[j : j + 1, :] == local).astype(  # graftlint: disable=pallas-tile-alignment
+        u_scr[off : off + w, :] = (ids_ref[j : j + 1, :] == local).astype(
             jnp.int8
         )
     if spec.k < spec.k_pad:
-        u_scr[spec.k :, :] = jnp.zeros((spec.k_pad - spec.k, tn), jnp.int8)  # graftlint: disable=pallas-tile-alignment
+        u_scr[spec.k :, :] = jnp.zeros((spec.k_pad - spec.k, tn), jnp.int8)
 
     if quant:
         acc = lax.dot_general(

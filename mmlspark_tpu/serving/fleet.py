@@ -39,6 +39,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 from mmlspark_tpu.core.profiling import get_logger
 from mmlspark_tpu.observability.events import (
     FleetScaled,
+    RegistryRecovered,
     RegistryUnavailable,
     get_bus,
 )
@@ -264,6 +265,11 @@ class FleetController:
             self._last_services = services
             if self._stale:
                 self._stale = False
+                bus = get_bus()
+                if bus.active:
+                    bus.publish(RegistryRecovered(
+                        source="controller", replicas=len(services),
+                    ))
                 logger.info("fleet controller regained the registry")
         except Exception as e:  # noqa: BLE001 - registry down/unreachable
             # registry outage tolerance: keep steering on the last-known-

@@ -101,7 +101,11 @@ def _registry_reporter(server, registry_url: str, interval_s: float,
     per outage onset. Heartbeat periods themselves carry seeded ±20%
     jitter (``MMLSPARK_TPU_FAULT_SEED`` + the replica name), so the fleet
     never phase-locks."""
-    from mmlspark_tpu.observability.events import RegistryUnavailable, get_bus
+    from mmlspark_tpu.observability.events import (
+        RegistryRecovered,
+        RegistryUnavailable,
+        get_bus,
+    )
 
     seed = int(os.environ.get("MMLSPARK_TPU_FAULT_SEED", "0") or 0)
     rng = random.Random(
@@ -121,6 +125,9 @@ def _registry_reporter(server, registry_url: str, interval_s: float,
                 _registry_post(registry_url, "/heartbeat", stats)
             if down:
                 down = False
+                bus = get_bus()
+                if bus.active:
+                    bus.publish(RegistryRecovered(source="replica"))
                 logger.info("replica %s regained the registry",
                             server.info.name)
             backoff = interval_s
@@ -505,7 +512,7 @@ class ReplicaSupervisor:
                 proc.wait(timeout=2.0)
             except subprocess.TimeoutExpired:
                 proc.kill()
-                proc.wait()
+                proc.wait(timeout=10.0)
         rc = proc.returncode
         status = ExitStatus(index, proc.pid, rc, "retired",
                             self._generations[index])
@@ -529,7 +536,7 @@ class ReplicaSupervisor:
                     proc.wait(timeout=2.0)
                 except subprocess.TimeoutExpired:
                     proc.kill()
-                    proc.wait()
+                    proc.wait(timeout=10.0)
             rc = proc.returncode
             reason = f"signal:{-rc}" if rc and rc < 0 else f"exit:{rc}"
             final.append(ExitStatus(index, proc.pid, rc, reason,

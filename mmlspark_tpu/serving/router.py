@@ -54,6 +54,7 @@ from mmlspark_tpu.core.profiling import get_logger
 from mmlspark_tpu.io.http.clients import BREAKER_FAILURE_STATUSES, _do_request
 from mmlspark_tpu.io.http.schema import EntityData, HTTPRequestData
 from mmlspark_tpu.observability.events import (
+    RegistryRecovered,
     RegistryUnavailable,
     RequestRouted,
     get_bus,
@@ -267,6 +268,11 @@ class FleetRouter:
         if self._stale:
             self._stale = False
             self._m_stale.set(0)
+            bus = get_bus()
+            if bus.active:
+                bus.publish(RegistryRecovered(
+                    source="router", replicas=len(replicas),
+                ))
             logger.info("registry reachable again; routing table is fresh")
         # never route to ourselves (a router registered for visibility)
         replicas = [r for r in replicas if r.name != self.name]

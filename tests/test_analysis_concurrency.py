@@ -1,0 +1,708 @@
+"""graftlint v2 tests: concurrency & protocol rules, lock graph, witness.
+
+Per rule family a positive fixture (violation), a negative (clean), and
+a suppressed one, plus the whole-program pieces lint_source can't reach:
+cross-module ABBA cycles via lint_contexts, the runtime lock witness
+(install / record / dump), the witness-vs-static cross-check, and the
+self-scan asserting the repo itself is clean under the full rule set.
+"""
+
+import json
+import os
+import threading
+
+import pytest
+
+from mmlspark_tpu.analysis import all_rules
+from mmlspark_tpu.analysis.base import FileContext
+from mmlspark_tpu.analysis.lint import lint_contexts, lint_source
+from mmlspark_tpu.analysis.lockgraph import ConcurrencyIndex, blocking_reason
+from mmlspark_tpu.analysis.witness import (
+    WITNESS_RULE,
+    LockWitness,
+    check_witness,
+    install_from_env,
+    load_reports,
+)
+
+
+def rules_of(violations):
+    return [v.rule for v in violations]
+
+
+def lint_at(path, src, select=None):
+    """lint_source with a path the path-scoped rules recognize."""
+    violations, _ = lint_contexts([FileContext(path, src)], select)
+    return violations
+
+
+# ---------------------------------------------------------------------------
+# Family 1: lock order
+# ---------------------------------------------------------------------------
+
+
+ABBA_SRC = (
+    "import threading\n"
+    "\n"
+    "class A:\n"
+    "    def __init__(self, b):\n"
+    "        self._a_lock = threading.Lock()\n"
+    "        self.b = b\n"
+    "\n"
+    "    def forward(self):\n"
+    "        with self._a_lock:\n"
+    "            with self.b._b_lock:\n"
+    "                pass\n"
+    "\n"
+    "class B:\n"
+    "    def __init__(self, a):\n"
+    "        self._b_lock = threading.Lock()\n"
+    "        self.a = a\n"
+    "\n"
+    "    def backward(self):\n"
+    "        with self._b_lock:\n"
+    "            with self.a._a_lock:\n"
+    "                pass\n"
+)
+
+
+class TestLockOrder:
+    def test_abba_cycle_flagged(self):
+        found = lint_source(ABBA_SRC, select=["lock-order"])
+        assert rules_of(found) == ["lock-order"]
+        assert "ABBA" in found[0].message
+
+    def test_consistent_order_clean(self):
+        src = ABBA_SRC.replace(
+            "        with self._b_lock:\n"
+            "            with self.a._a_lock:\n",
+            "        with self.a._a_lock:\n"
+            "            with self._b_lock:\n",
+        )
+        assert lint_source(src, select=["lock-order"]) == []
+
+    def test_cross_module_cycle(self):
+        # The same ABBA split across two modules: the acquisition graph
+        # is whole-program, so the cycle must still be found, anchored
+        # at exactly one of the two files.
+        mod_a = (
+            "import threading\n"
+            "from mmlspark_tpu.runtime.modb import B\n"
+            "\n"
+            "class A:\n"
+            "    def __init__(self):\n"
+            "        self._a_lock = threading.Lock()\n"
+            "        self.b = B()\n"
+            "\n"
+            "    def forward(self):\n"
+            "        with self._a_lock:\n"
+            "            self.b.poke()\n"
+        )
+        mod_b = (
+            "import threading\n"
+            "\n"
+            "class B:\n"
+            "    def __init__(self):\n"
+            "        self._b_lock = threading.Lock()\n"
+            "        self.a = None\n"
+            "\n"
+            "    def poke(self):\n"
+            "        with self._b_lock:\n"
+            "            pass\n"
+            "\n"
+            "    def backward(self):\n"
+            "        with self._b_lock:\n"
+            "            with self.a._a_lock:\n"
+            "                pass\n"
+        )
+        contexts = [
+            FileContext("mmlspark_tpu/runtime/moda.py", mod_a),
+            FileContext("mmlspark_tpu/runtime/modb.py", mod_b),
+        ]
+        violations, _ = lint_contexts(contexts, select=["lock-order"])
+        assert rules_of(violations) == ["lock-order"]
+
+    def test_suppressed(self):
+        # the cycle anchors at its smallest edge site — the inner
+        # acquisition in forward() — so that line hosts the suppression
+        src = ABBA_SRC.replace(
+            "            with self.b._b_lock:\n",
+            "            with self.b._b_lock:"
+            "  # graftlint: disable=lock-order\n",
+        )
+        assert lint_source(src, select=["lock-order"]) == []
+
+
+class TestLockBlocking:
+    def test_callee_sleep_flagged(self):
+        src = (
+            "import threading, time\n"
+            "\n"
+            "class W:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "\n"
+            "    def slow(self):\n"
+            "        time.sleep(1.0)\n"
+            "\n"
+            "    def work(self):\n"
+            "        with self._lock:\n"
+            "            self.slow()\n"
+        )
+        found = lint_at(
+            "mmlspark_tpu/runtime/w.py", src, select=["lock-blocking"]
+        )
+        assert rules_of(found) == ["lock-blocking"]
+        assert "time.sleep" in found[0].message
+
+    def test_direct_sleep_is_lock_disciplines(self):
+        # direct blocking in the with-body belongs to lock-discipline;
+        # lock-blocking only follows the call graph
+        src = (
+            "import threading, time\n"
+            "\n"
+            "class W:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "\n"
+            "    def work(self):\n"
+            "        with self._lock:\n"
+            "            time.sleep(1.0)\n"
+        )
+        path = "mmlspark_tpu/runtime/w.py"
+        assert lint_at(path, src, select=["lock-blocking"]) == []
+        assert rules_of(
+            lint_at(path, src, select=["lock-discipline"])
+        ) == ["lock-discipline"]
+
+    def test_non_blocking_callee_clean(self):
+        src = (
+            "import threading\n"
+            "\n"
+            "class W:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._n = 0\n"
+            "\n"
+            "    def bump(self):\n"
+            "        self._n += 1\n"
+            "\n"
+            "    def work(self):\n"
+            "        with self._lock:\n"
+            "            self.bump()\n"
+        )
+        assert lint_at(
+            "mmlspark_tpu/runtime/w.py", src, select=["lock-blocking"]
+        ) == []
+
+    def test_outside_concurrent_parts_not_scanned(self):
+        src = (
+            "import threading, time\n"
+            "\n"
+            "class W:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "\n"
+            "    def slow(self):\n"
+            "        time.sleep(1.0)\n"
+            "\n"
+            "    def work(self):\n"
+            "        with self._lock:\n"
+            "            self.slow()\n"
+        )
+        assert lint_at(
+            "mmlspark_tpu/cognitive/w.py", src, select=["lock-blocking"]
+        ) == []
+
+    def test_blocking_reason_catalog(self):
+        import ast as _ast
+
+        def call(src):
+            return _ast.parse(src).body[0].value
+
+        assert blocking_reason(call("time.sleep(1)"))
+        assert blocking_reason(call("sock.recv(1024)"))
+        assert blocking_reason(call("t.join()"))
+        assert blocking_reason(call("q.get()"))
+        assert blocking_reason(call("t.join(timeout=1.0)")) is None
+        assert blocking_reason(call("', '.join(parts)")) is None
+        assert blocking_reason(call("d.get('k')")) is None
+
+
+# ---------------------------------------------------------------------------
+# Family 2: collective consistency
+# ---------------------------------------------------------------------------
+
+
+class TestCollectiveDeadline:
+    PATH = "mmlspark_tpu/runtime/g.py"
+
+    def test_allreduce_group_without_timeout(self):
+        src = (
+            "def form(members, size):\n"
+            "    return AllreduceGroup(members, size)\n"
+        )
+        found = lint_at(self.PATH, src, select=["collective-deadline"])
+        assert rules_of(found) == ["collective-deadline"]
+
+    def test_unbounded_wait_and_join(self):
+        src = (
+            "def f(ev, t):\n"
+            "    ev.wait()\n"
+            "    t.join()\n"
+        )
+        found = lint_at(self.PATH, src, select=["collective-deadline"])
+        assert rules_of(found) == [
+            "collective-deadline", "collective-deadline",
+        ]
+
+    def test_bounded_forms_clean(self):
+        src = (
+            "def f(members, size, ev, t, parts):\n"
+            "    g = AllreduceGroup(members, size, timeout=5.0)\n"
+            "    ev.wait(timeout=2.0)\n"
+            "    t.join(1.0)\n"
+            "    return ', '.join(parts)\n"
+        )
+        assert lint_at(self.PATH, src, select=["collective-deadline"]) == []
+
+    def test_suppressed(self):
+        src = (
+            "def f(ev):\n"
+            "    ev.wait()  # graftlint: disable=collective-deadline\n"
+        )
+        assert lint_at(self.PATH, src, select=["collective-deadline"]) == []
+
+
+class TestCollectiveRankBranch:
+    PATH = "mmlspark_tpu/runtime/c.py"
+
+    def test_rank_guarded_collective(self):
+        src = (
+            "def f(rank, grad):\n"
+            "    if rank == 0:\n"
+            "        return psum(grad)\n"
+            "    return grad\n"
+        )
+        found = lint_at(self.PATH, src, select=["collective-rank-branch"])
+        assert rules_of(found) == ["collective-rank-branch"]
+        assert "'rank'" in found[0].message
+
+    def test_member_attribute_guard(self):
+        src = (
+            "def f(self, grad):\n"
+            "    if self.member_id != 0:\n"
+            "        barrier()\n"
+        )
+        found = lint_at(self.PATH, src, select=["collective-rank-branch"])
+        assert rules_of(found) == ["collective-rank-branch"]
+
+    def test_world_size_guard_is_uniform(self):
+        src = (
+            "def f(world_size, grad):\n"
+            "    if world_size > 1:\n"
+            "        return psum(grad)\n"
+            "    return grad\n"
+        )
+        assert lint_at(
+            self.PATH, src, select=["collective-rank-branch"]
+        ) == []
+
+    def test_nested_function_resets_guard(self):
+        # the callee runs wherever it is called from: defining a helper
+        # inside a rank branch is not itself a guarded collective
+        src = (
+            "def f(rank, grad):\n"
+            "    if rank == 0:\n"
+            "        def helper(g):\n"
+            "            return psum(g)\n"
+            "    return grad\n"
+        )
+        assert lint_at(
+            self.PATH, src, select=["collective-rank-branch"]
+        ) == []
+
+    def test_suppressed(self):
+        src = (
+            "def f(rank, grad):\n"
+            "    if rank == 0:\n"
+            "        return psum(grad)"
+            "  # graftlint: disable=collective-rank-branch\n"
+        )
+        assert lint_at(
+            self.PATH, src, select=["collective-rank-branch"]
+        ) == []
+
+
+# ---------------------------------------------------------------------------
+# Family 3: protocol ordering
+# ---------------------------------------------------------------------------
+
+
+class TestWalBeforeCommit:
+    PATH = "mmlspark_tpu/streaming/q.py"
+
+    def test_commit_without_wal(self):
+        src = (
+            "class Q:\n"
+            "    def step(self, epoch):\n"
+            "        self._write_commit(epoch)\n"
+        )
+        found = lint_at(self.PATH, src, select=["wal-before-commit"])
+        assert rules_of(found) == ["wal-before-commit"]
+
+    def test_commit_before_wal(self):
+        src = (
+            "class Q:\n"
+            "    def step(self, epoch):\n"
+            "        self._write_commit(epoch)\n"
+            "        self._write_wal(epoch)\n"
+        )
+        found = lint_at(self.PATH, src, select=["wal-before-commit"])
+        assert rules_of(found) == ["wal-before-commit"]
+
+    def test_wal_then_commit_clean(self):
+        src = (
+            "class Q:\n"
+            "    def step(self, epoch):\n"
+            "        self._write_wal(epoch)\n"
+            "        self._write_commit(epoch)\n"
+        )
+        assert lint_at(self.PATH, src, select=["wal-before-commit"]) == []
+
+    def test_outside_streaming_not_scanned(self):
+        src = (
+            "class Q:\n"
+            "    def step(self, epoch):\n"
+            "        self._write_commit(epoch)\n"
+        )
+        assert lint_at(
+            "mmlspark_tpu/serving/q.py", src, select=["wal-before-commit"]
+        ) == []
+
+
+class TestJournalBeforeStore:
+    PATH = "mmlspark_tpu/streaming/s.py"
+
+    def test_store_commit_without_journal(self):
+        src = (
+            "class Sink:\n"
+            "    def flush(self, text):\n"
+            "        self._store.commit(text)\n"
+        )
+        found = lint_at(self.PATH, src, select=["journal-before-store"])
+        assert rules_of(found) == ["journal-before-store"]
+
+    def test_journal_record_first_clean(self):
+        src = (
+            "class Sink:\n"
+            "    def flush(self, epoch, text):\n"
+            "        self._journal.record(epoch)\n"
+            "        self._store.commit(text)\n"
+        )
+        assert lint_at(self.PATH, src, select=["journal-before-store"]) == []
+
+    def test_caller_records_clean(self):
+        # the journal write may live in a same-class caller of the
+        # commit helper (the ModelCommitSink split)
+        src = (
+            "class Sink:\n"
+            "    def run(self, epoch, text):\n"
+            "        self._journal.record(epoch)\n"
+            "        self._commit(text)\n"
+            "\n"
+            "    def _commit(self, text):\n"
+            "        self._store.commit(text)\n"
+        )
+        assert lint_at(self.PATH, src, select=["journal-before-store"]) == []
+
+
+class TestTmpRenameAtomicity:
+    PATH = "mmlspark_tpu/streaming/ckpt.py"
+
+    def test_bare_open_w_flagged(self):
+        src = (
+            "def save(path, data):\n"
+            "    with open(path, 'w') as fh:\n"
+            "        fh.write(data)\n"
+        )
+        found = lint_at(self.PATH, src, select=["tmp-rename-atomicity"])
+        assert rules_of(found) == ["tmp-rename-atomicity"]
+
+    def test_write_text_flagged(self):
+        src = (
+            "def save(path, data):\n"
+            "    path.write_text(data)\n"
+        )
+        found = lint_at(self.PATH, src, select=["tmp-rename-atomicity"])
+        assert rules_of(found) == ["tmp-rename-atomicity"]
+
+    def test_renaming_writer_exempt(self):
+        src = (
+            "import os\n"
+            "def save(path, data):\n"
+            "    tmp = path + '.tmp'\n"
+            "    with open(tmp, 'w') as fh:\n"
+            "        fh.write(data)\n"
+            "    os.replace(tmp, path)\n"
+        )
+        assert lint_at(self.PATH, src, select=["tmp-rename-atomicity"]) == []
+
+    def test_atomic_named_writer_exempt(self):
+        src = (
+            "def _atomic_write(path, data):\n"
+            "    with open(path, 'w') as fh:\n"
+            "        fh.write(data)\n"
+        )
+        assert lint_at(self.PATH, src, select=["tmp-rename-atomicity"]) == []
+
+    def test_append_mode_clean(self):
+        src = (
+            "def log(path, line):\n"
+            "    with open(path, 'a') as fh:\n"
+            "        fh.write(line)\n"
+        )
+        assert lint_at(self.PATH, src, select=["tmp-rename-atomicity"]) == []
+
+    def test_journal_py_covered(self):
+        src = (
+            "def save(path, data):\n"
+            "    with open(path, 'w') as fh:\n"
+            "        fh.write(data)\n"
+        )
+        found = lint_at(
+            "mmlspark_tpu/runtime/journal.py", src,
+            select=["tmp-rename-atomicity"],
+        )
+        assert rules_of(found) == ["tmp-rename-atomicity"]
+
+
+class TestOnsetRecoveryPairing:
+    def test_onset_without_recovery(self):
+        src = (
+            "def down(bus, name):\n"
+            "    bus.publish(RegistryUnavailable(source=name))\n"
+        )
+        found = lint_source(src, select=["onset-recovery-pairing"])
+        assert rules_of(found) == ["onset-recovery-pairing"]
+        assert "RegistryRecovered" in found[0].message
+
+    def test_paired_recovery_clean(self):
+        src = (
+            "def down(bus, name):\n"
+            "    bus.publish(RegistryUnavailable(source=name))\n"
+            "\n"
+            "def up(bus, name):\n"
+            "    bus.publish(RegistryRecovered(source=name))\n"
+        )
+        assert lint_source(src, select=["onset-recovery-pairing"]) == []
+
+    def test_literal_pressure_without_ok(self):
+        src = (
+            "def warn(bus):\n"
+            "    bus.publish(MemoryPressure(level='critical'))\n"
+        )
+        found = lint_source(src, select=["onset-recovery-pairing"])
+        assert rules_of(found) == ["onset-recovery-pairing"]
+
+    def test_dynamic_pressure_level_clean(self):
+        src = (
+            "def report(bus, level):\n"
+            "    bus.publish(MemoryPressure(level=level))\n"
+        )
+        assert lint_source(src, select=["onset-recovery-pairing"]) == []
+
+    def test_pressure_with_degradation_event_clean(self):
+        src = (
+            "def warn(bus):\n"
+            "    bus.publish(MemoryPressure(level='critical'))\n"
+            "    bus.publish(RequestShed(count=1))\n"
+        )
+        assert lint_source(src, select=["onset-recovery-pairing"]) == []
+
+
+# ---------------------------------------------------------------------------
+# ConcurrencyIndex internals
+# ---------------------------------------------------------------------------
+
+
+class TestConcurrencyIndex:
+    def test_lock_defs_and_edges(self):
+        ctx = FileContext("mmlspark_tpu/runtime/pair.py", ABBA_SRC)
+        index = ConcurrencyIndex([ctx])
+        assert len(index.lock_defs) == 2
+        keys = set(index.lock_defs)
+        assert any(k.endswith("A._a_lock") for k in keys)
+        assert any(k.endswith("B._b_lock") for k in keys)
+        assert len(index.edges) == 2  # A->B and B->A
+        assert len(index.cycles()) == 1
+
+    def test_lock_sites_match_witness_identity(self):
+        ctx = FileContext("mmlspark_tpu/runtime/pair.py", ABBA_SRC)
+        index = ConcurrencyIndex([ctx])
+        sites = index.lock_sites()
+        # LockDef sites are package-relative path:line — the same key
+        # the runtime witness derives from allocation frames
+        assert ("mmlspark_tpu/runtime/pair.py", 5) in sites
+        assert ("mmlspark_tpu/runtime/pair.py", 15) in sites
+
+
+# ---------------------------------------------------------------------------
+# Runtime lock witness
+# ---------------------------------------------------------------------------
+
+
+FIXTURE_MOD = (
+    "import threading\n"
+    "a = threading.Lock()\n"
+    "b = threading.Lock()\n"
+    "with a:\n"
+    "    with b:\n"
+    "        pass\n"
+)
+
+
+class TestLockWitness:
+    def test_install_wraps_package_allocations_only(self):
+        w = LockWitness()
+        w.install()
+        try:
+            # allocation frame inside the package marker -> wrapped
+            exec(compile(FIXTURE_MOD, "mmlspark_tpu/fake/fx.py", "exec"), {})
+            # allocation from this test file (outside the package) -> raw
+            raw = threading.Lock()
+            assert type(raw) is type(_new_raw_lock())
+        finally:
+            w.uninstall()
+        report = w.report()
+        assert report["sites"] == {
+            "mmlspark_tpu/fake/fx.py:2": "lock",
+            "mmlspark_tpu/fake/fx.py:3": "lock",
+        }
+        assert report["edges"] == [{
+            "from": "mmlspark_tpu/fake/fx.py:2",
+            "to": "mmlspark_tpu/fake/fx.py:3",
+            "count": 1,
+        }]
+
+    def test_uninstall_restores_factories(self):
+        w = LockWitness()
+        w.install()
+        w.uninstall()
+        assert threading.Lock is _ORIG_LOCK_REF
+        assert threading.RLock is _ORIG_RLOCK_REF
+
+    def test_rlock_reentry_is_not_an_edge(self):
+        w = LockWitness()
+        w._record_acquire("mmlspark_tpu/x.py:1", "rlock")
+        w._record_acquire("mmlspark_tpu/x.py:1", "rlock")
+        assert w.report()["edges"] == []
+
+    def test_dump_and_load(self, tmp_path):
+        w = LockWitness()
+        w._record_acquire("mmlspark_tpu/x.py:1", "lock")
+        w._record_acquire("mmlspark_tpu/y.py:2", "lock")
+        out = tmp_path / "lockwitness-1.json"
+        w.dump(str(out))
+        assert not list(tmp_path.glob("*.tmp.*"))  # tmp+rename, no litter
+        reports = load_reports([str(tmp_path)])
+        assert len(reports) == 1
+        assert reports[0]["edges"][0]["from"] == "mmlspark_tpu/x.py:1"
+
+    def test_install_from_env_requires_flag(self, monkeypatch):
+        from mmlspark_tpu.analysis import witness as wmod
+
+        monkeypatch.delenv("MMLSPARK_TPU_LOCKCHECK", raising=False)
+        monkeypatch.setattr(wmod, "_ACTIVE", None)
+        assert install_from_env() is None
+
+
+class TestWitnessCheck:
+    @staticmethod
+    def _static_ab_context():
+        # static graph: one edge A._a_lock -> B._b_lock
+        src = ABBA_SRC.replace(
+            "        with self._b_lock:\n"
+            "            with self.a._a_lock:\n",
+            "        with self.a._a_lock:\n"
+            "            with self._b_lock:\n",
+        )
+        return FileContext("mmlspark_tpu/runtime/pair.py", src)
+
+    def test_runtime_inversion_of_static_edge(self):
+        ctx = self._static_ab_context()
+        report = {
+            "version": 1,
+            "sites": {
+                "mmlspark_tpu/runtime/pair.py:5": "lock",
+                "mmlspark_tpu/runtime/pair.py:15": "lock",
+            },
+            "edges": [{
+                # witnessed B -> A, inverting the static A -> B
+                "from": "mmlspark_tpu/runtime/pair.py:15",
+                "to": "mmlspark_tpu/runtime/pair.py:5",
+                "count": 3,
+            }],
+        }
+        found = check_witness([report], [ctx])
+        assert rules_of(found) == [WITNESS_RULE]
+        assert "static" in found[0].message
+
+    def test_direct_runtime_inversion(self):
+        ctx = self._static_ab_context()
+        edges = [
+            {"from": "mmlspark_tpu/io/h.py:10",
+             "to": "mmlspark_tpu/io/h.py:20", "count": 1},
+            {"from": "mmlspark_tpu/io/h.py:20",
+             "to": "mmlspark_tpu/io/h.py:10", "count": 1},
+        ]
+        report = {"version": 1, "sites": {}, "edges": edges}
+        found = check_witness([report], [ctx])
+        assert rules_of(found) == [WITNESS_RULE]
+        assert "runtime lock-order inversion" in found[0].message
+
+    def test_consistent_witness_clean(self):
+        ctx = self._static_ab_context()
+        report = {
+            "version": 1,
+            "sites": {},
+            "edges": [{
+                # same order as the static edge: consistent
+                "from": "mmlspark_tpu/runtime/pair.py:5",
+                "to": "mmlspark_tpu/runtime/pair.py:15",
+                "count": 7,
+            }],
+        }
+        assert check_witness([report], [ctx]) == []
+
+
+# ---------------------------------------------------------------------------
+# Self-scan: the repo must be clean under the full v2 rule set
+# ---------------------------------------------------------------------------
+
+
+class TestSelfScan:
+    def test_new_rules_registered(self):
+        names = set(all_rules())
+        assert {
+            "lock-order", "lock-blocking", "collective-deadline",
+            "collective-rank-branch", "wal-before-commit",
+            "journal-before-store", "tmp-rename-atomicity",
+            "onset-recovery-pairing",
+        } <= names
+
+    def test_repo_clean_under_full_rule_set(self):
+        from mmlspark_tpu.analysis.lint import lint_paths
+
+        pkg = os.path.join(os.path.dirname(__file__), "..", "mmlspark_tpu")
+        violations, _, errors = lint_paths([os.path.normpath(pkg)])
+        assert errors == []
+        assert violations == [], "\n".join(v.render() for v in violations)
+
+
+_ORIG_LOCK_REF = threading.Lock
+_ORIG_RLOCK_REF = threading.RLock
+
+
+def _new_raw_lock():
+    return _ORIG_LOCK_REF()

@@ -480,12 +480,22 @@ class TestSocketDeadlinePolicy:
 class TestDriver:
     def test_all_builtin_rules_registered(self):
         assert set(all_rules()) == {
+            # v1: framework contracts
             "jit-purity",
             "numpy-in-traced-code",
             "pallas-tile-alignment",
             "lock-discipline",
             "bare-except-policy",
             "socket-deadline-policy",
+            # v2: concurrency & distributed protocols
+            "lock-order",
+            "lock-blocking",
+            "collective-deadline",
+            "collective-rank-branch",
+            "wal-before-commit",
+            "journal-before-store",
+            "tmp-rename-atomicity",
+            "onset-recovery-pairing",
         }
 
     def test_bare_disable_silences_all(self):
