@@ -479,6 +479,8 @@ _EVENT_PAIRS: Dict[str, Set[str]] = {
     "ProcessLost": {"GroupReformed", "ProcessStarted"},
     "NetworkPartitioned": {"GroupReformed"},
     "RegistryUnavailable": {"RegistryRecovered"},
+    "DriftDetected": {"DriftCleared"},
+    "AlertFired": {"AlertResolved"},
 }
 #: level-carrying events: a literal warn/critical onset needs a literal
 #: "ok" publish, a variable level (covers both), or a degradation event

@@ -9,6 +9,7 @@ dispatching heavy compute to jitted JAX programs on the TPU mesh.
 from __future__ import annotations
 
 import itertools
+import os
 import threading
 import time
 from typing import Any, Dict, List, Optional
@@ -39,6 +40,21 @@ def _tracer():
 
         _TRACER = get_tracer()
     return _TRACER
+
+
+_GET_QMONITOR = None
+
+
+def _quality_monitor():
+    # same ambient-gate pattern as _tracer: the accessor is cached so an
+    # unconfigured transform pays one env lookup, and the quality plane
+    # only materializes when MMLSPARK_TPU_QUALITY_STORE is set
+    global _GET_QMONITOR
+    if _GET_QMONITOR is None:
+        from mmlspark_tpu.observability.quality import get_monitor
+
+        _GET_QMONITOR = get_monitor
+    return _GET_QMONITOR()
 
 
 class PipelineStage(Params):
@@ -177,6 +193,15 @@ class Pipeline(Estimator):
                 model=type(model).__name__, version=fit_id,
                 detail=f"{len(fitted)} stages",
             ))
+        # quality plane (env-gated): profile the training columns + the
+        # fitted scores and commit the reference artifact next to the
+        # model version, so live serving has something to drift against
+        if os.environ.get("MMLSPARK_TPU_QUALITY_STORE"):
+            from mmlspark_tpu.observability.quality import (
+                capture_pipeline_reference,
+            )
+
+            capture_pipeline_reference(model, table, version_hint=fit_id)
         return model
 
 
@@ -187,15 +212,27 @@ class PipelineModel(Model):
         # stage spans open only when an ambient span exists to join (a
         # serving request's apply span, a fit span, an explicit
         # tracer.span(...) around the call) — a bare untraced transform
-        # pays one contextvar read, nothing more
+        # pays one contextvar read, nothing more. The quality gate is the
+        # same posture: one env lookup when unconfigured; the serving
+        # batch loop suppresses this hook because it sketches the batch
+        # itself (a request must not count twice).
+        monitor = _quality_monitor()
+        observe = monitor is not None and not monitor.transform_suppressed
+        if observe:
+            in_cols = set(table.columns)
+            monitor.observe_columns({c: table.column(c) for c in in_cols})
         tracer = _tracer()
         if tracer.current() is None:
             for stage in self.getStages():
                 table = stage.transform(table)
-            return table
-        for i, stage in enumerate(self.getStages()):
-            with tracer.span(f"transform:{type(stage).__name__}", stage=i):
-                table = stage.transform(table)
+        else:
+            for i, stage in enumerate(self.getStages()):
+                with tracer.span(f"transform:{type(stage).__name__}", stage=i):
+                    table = stage.transform(table)
+        if observe:
+            monitor.observe_columns({
+                c: table.column(c) for c in table.columns if c not in in_cols
+            })
         return table
 
     def transform_schema(self, schema: Dict[str, Any]) -> Dict[str, Any]:

@@ -38,10 +38,15 @@ Quick start::
     print(obs.get_registry().exposition())
 """
 
+from mmlspark_tpu.observability.alerts import AlertEvaluator
 from mmlspark_tpu.observability.events import (
+    AlertFired,
+    AlertResolved,
     BatchFormed,
     BreakerTripped,
     CandidateBatchFitted,
+    DriftCleared,
+    DriftDetected,
     Event,
     EventBus,
     EventLogSink,
@@ -102,10 +107,21 @@ from mmlspark_tpu.observability.incidents import (
     maybe_record,
 )
 from mmlspark_tpu.observability.profiler import (
+    DevicePeaks,
     DeviceProfiler,
     FunctionProfile,
+    UNKNOWN_PLATFORM,
     device_peaks,
     get_profiler,
+)
+from mmlspark_tpu.observability.quality import (
+    QualityMonitor,
+    ReferenceProfile,
+    capture_pipeline_reference,
+    drift_table_from_summary,
+    get_monitor,
+    install_monitor,
+    load_profile,
 )
 from mmlspark_tpu.observability.registry import (
     DEFAULT_BUCKETS,
@@ -115,6 +131,13 @@ from mmlspark_tpu.observability.registry import (
     Histogram,
     MetricsRegistry,
     get_registry,
+)
+from mmlspark_tpu.observability.sketches import (
+    ColumnSketch,
+    QuantileCompactor,
+    ks_statistic,
+    merge_all,
+    psi,
 )
 from mmlspark_tpu.observability.slo import SLOReport, SLOTargets, fleet_summary
 from mmlspark_tpu.observability.tracing import (
@@ -137,12 +160,19 @@ def __getattr__(name):
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 __all__ = [
+    "AlertEvaluator",
+    "AlertFired",
+    "AlertResolved",
     "BatchFormed",
     "BreakerTripped",
     "CandidateBatchFitted",
+    "ColumnSketch",
     "Counter",
     "DEFAULT_BUCKETS",
+    "DevicePeaks",
     "DeviceProfiler",
+    "DriftCleared",
+    "DriftDetected",
     "Event",
     "EventBus",
     "EventLogSink",
@@ -169,6 +199,9 @@ __all__ = [
     "ProcessStarted",
     "ProfileCompiled",
     "ProfileExecuted",
+    "QualityMonitor",
+    "QuantileCompactor",
+    "ReferenceProfile",
     "RegistryRecovered",
     "RegistryUnavailable",
     "RequestRouted",
@@ -193,24 +226,33 @@ __all__ = [
     "TaskSpeculated",
     "TraceContext",
     "Tracer",
+    "UNKNOWN_PLATFORM",
     "WorkerParoled",
     "WorkerQuarantined",
+    "capture_pipeline_reference",
     "collect",
     "device_peaks",
+    "drift_table_from_summary",
     "fleet_summary",
     "format_timeline",
     "from_record",
     "get_bus",
+    "get_monitor",
     "get_profiler",
     "get_recorder",
     "get_registry",
     "get_tracer",
+    "install_monitor",
+    "ks_statistic",
+    "load_profile",
     "log_segments",
     "maybe_record",
     "merge",
+    "merge_all",
     "parse_exposition",
     "process_label",
     "process_log_path",
+    "psi",
     "render_report",
     "replay",
     "timeline",

@@ -612,6 +612,70 @@ class DiskPressure(Event):
     total_bytes: float
 
 
+# -- model quality -----------------------------------------------------------
+
+
+@_event
+class DriftDetected(Event):
+    """A live-traffic drift statistic for one feature (or the score
+    column) crossed its threshold against the served version's reference
+    profile. Every onset pairs with a later :class:`DriftCleared` for the
+    same feature once the rolling window recovers
+    (``check_eventlog.py --quality``)."""
+
+    feature: str
+    stat: str  # "psi" | "ks"
+    value: float
+    threshold: float
+    model: str = ""
+    version: int = 0
+
+
+@_event
+class DriftCleared(Event):
+    """The drift statistic for ``feature`` fell back under threshold —
+    the recovery edge of :class:`DriftDetected`."""
+
+    feature: str
+    stat: str
+    value: float
+    threshold: float
+    model: str = ""
+    version: int = 0
+
+
+@_event
+class AlertFired(Event):
+    """The multi-window burn-rate evaluator fired: the SLO named by
+    ``alert`` is burning its error budget faster than ``threshold``x in
+    BOTH windows. Pairs with a later :class:`AlertResolved` once the
+    short window recovers."""
+
+    alert: str  # "availability" | "latency"
+    slo: str  # the judged objective, e.g. "p99<=50ms"
+    burn_short: float
+    burn_long: float
+    window_short_s: float
+    window_long_s: float
+    threshold: float = 1.0
+    detail: str = ""
+
+
+@_event
+class AlertResolved(Event):
+    """The short-window burn rate for ``alert`` dropped back under
+    threshold — the recovery edge of :class:`AlertFired`."""
+
+    alert: str
+    slo: str
+    burn_short: float
+    burn_long: float
+    window_short_s: float
+    window_long_s: float
+    threshold: float = 1.0
+    detail: str = ""
+
+
 # -- resilience --------------------------------------------------------------
 
 
@@ -1048,6 +1112,12 @@ def timeline(events: Iterable[Event]) -> Dict[str, Any]:
     #: kept separate from `degradations` so incident bundles distinguish
     #: a configured byte-saving path from an emergency pressure response
     hist_optimizations: List[Dict[str, Any]] = []
+    #: drift onsets/clears per feature (the model-quality plane)
+    quality = {"detected": 0, "cleared": 0}
+    drift_features: Dict[str, Dict[str, int]] = {}
+    #: burn-rate alert history, in stream order
+    alerts = {"fired": 0, "resolved": 0}
+    alert_history: List[Dict[str, Any]] = []
     #: events per federation process label ("" = untagged single-process log)
     by_process: Dict[str, int] = {}
     for ev in events:
@@ -1165,6 +1235,22 @@ def timeline(events: Iterable[Event]) -> Dict[str, Any]:
                 "chunk_rows": ev.chunk_rows, "num_chunks": ev.num_chunks,
                 "acc_dtype": ev.acc_dtype, "bytes_saved": ev.bytes_saved,
             })
+        elif isinstance(ev, (DriftDetected, DriftCleared)):
+            detected = isinstance(ev, DriftDetected)
+            quality["detected" if detected else "cleared"] += 1
+            rec = drift_features.setdefault(
+                ev.feature, {"detected": 0, "cleared": 0}
+            )
+            rec["detected" if detected else "cleared"] += 1
+        elif isinstance(ev, (AlertFired, AlertResolved)):
+            fired = isinstance(ev, AlertFired)
+            alerts["fired" if fired else "resolved"] += 1
+            alert_history.append({
+                "alert": ev.alert, "slo": ev.slo,
+                "state": "fired" if fired else "resolved",
+                "burn_short": ev.burn_short, "burn_long": ev.burn_long,
+                "t": ev.t,
+            })
         elif isinstance(ev, (ProfileCompiled, ProfileExecuted)):
             rec = profiler.setdefault(ev.name, {
                 "compiles": 0, "compile_seconds": 0.0,
@@ -1210,6 +1296,8 @@ def timeline(events: Iterable[Event]) -> Dict[str, Any]:
         "pressure": pressure,
         "degradations": degradations,
         "hist_optimizations": hist_optimizations,
+        "quality": dict(quality, features=drift_features),
+        "alerts": dict(alerts, history=alert_history),
         "by_process": by_process,
     }
 
@@ -1345,6 +1433,28 @@ def format_timeline(summary: Dict[str, Any]) -> str:
                     f"{o['chunk_rows']} acc={o['acc_dtype']} "
                     f"saves={o['bytes_saved']}B"
                 )
+    quality = summary.get("quality") or {}
+    if quality.get("detected") or quality.get("cleared"):
+        lines.append(
+            f"== quality == drift detected={quality['detected']} "
+            f"cleared={quality['cleared']}"
+            + (" (" + ", ".join(
+                f"{feat} x{c['detected']}"
+                for feat, c in sorted((quality.get("features") or {}).items())
+                if c["detected"]
+            ) + ")" if quality.get("features") else "")
+        )
+    alerts = summary.get("alerts") or {}
+    if alerts.get("fired") or alerts.get("resolved"):
+        lines.append(
+            f"== alerts == fired={alerts['fired']} "
+            f"resolved={alerts['resolved']}"
+        )
+        for a in alerts.get("history") or []:
+            lines.append(
+                f"   {a['alert']} [{a['slo']}] {a['state']} "
+                f"burn short={a['burn_short']:.2f} long={a['burn_long']:.2f}"
+            )
     by_process = summary.get("by_process") or {}
     if by_process:
         lines.append("== fleet log == " + ", ".join(

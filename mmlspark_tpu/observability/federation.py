@@ -170,7 +170,13 @@ class MetricsFederator:
         """The registry's ``GET /services`` list (empty on error)."""
         try:
             body = self._fetch(self.registry_url + "/services", self.timeout_s)
-            services = json.loads(body).get("services", [])
+            payload = json.loads(body)
+            # the registration service serves a bare JSON list; accept the
+            # {"services": [...]} envelope too for other control planes
+            services = (
+                payload.get("services", [])
+                if isinstance(payload, dict) else payload
+            )
             return [s for s in services if s.get("host") and s.get("port")]
         except Exception as e:  # noqa: BLE001 - control plane may be mid-restart
             logger.debug("federator: /services unreadable: %s", e)

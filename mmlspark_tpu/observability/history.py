@@ -135,7 +135,8 @@ def _attempts_table(tasks: Dict[str, Any]) -> str:
 def _roofline_table(profiler: Dict[str, Dict[str, Any]]) -> str:
     if not profiler:
         return '<p class="muted">no profiler events (set MMLSPARK_TPU_PROFILE=1)</p>'
-    peak_f, peak_b = device_peaks()
+    peaks = device_peaks()
+    peak_f, peak_b = peaks
     rows = []
     for name in sorted(profiler):
         p = profiler[name]
@@ -148,7 +149,7 @@ def _roofline_table(profiler: Dict[str, Dict[str, Any]]) -> str:
             flops=float(p.get("flops", 0.0)),
             bytes_accessed=float(p.get("bytes_accessed", 0.0)),
         )
-        r = fp.roofline(peak_f, peak_b)
+        r = fp.roofline(peak_f, peak_b, platform=peaks.platform)
         rows.append([
             _esc(name),
             f"{fp.compiles} ({fp.compile_seconds:.3f} s)",
@@ -161,7 +162,12 @@ def _roofline_table(profiler: Dict[str, Dict[str, Any]]) -> str:
             f"{r['hbm_frac']:.1%}" if r["hbm_frac"] is not None else "&mdash;",
             _esc(r["bound"]),
         ])
-    return _table(
+    provenance = (
+        f'<p class="muted">peaks: {_esc(peaks.platform)}'
+        + ("" if peaks.known else " &mdash; bound classification skipped")
+        + "</p>"
+    )
+    return provenance + _table(
         ["function", "compiles", "execs", "mean", "flops",
          "FLOP/s", "bytes/s", "MXU %", "HBM %", "bound"],
         rows,
@@ -349,6 +355,12 @@ def render_report(
         cards.append(_card("fleet processes", len(by_process)))
     if summary.get("incidents"):
         cards.append(_card("incidents", len(summary["incidents"])))
+    quality = summary.get("quality") or {}
+    alerts = summary.get("alerts") or {}
+    if quality.get("detected"):
+        cards.append(_card("drift onsets", quality["detected"]))
+    if alerts.get("fired"):
+        cards.append(_card("alerts fired", alerts["fired"]))
 
     sections = [
         f"<h1>{_esc(title)}</h1>",
@@ -422,6 +434,45 @@ def render_report(
         "<h2>Distributed traces</h2>",
         _trace_waterfall(events),
     ]
+
+    if (
+        quality.get("detected") or quality.get("cleared")
+        or alerts.get("fired") or alerts.get("resolved")
+    ):
+        sections += [
+            "<h2>Model quality</h2>",
+            f"<p>drift detected={quality.get('detected', 0)} "
+            f"cleared={quality.get('cleared', 0)} &middot; "
+            f"alerts fired={alerts.get('fired', 0)} "
+            f"resolved={alerts.get('resolved', 0)}</p>",
+        ]
+        features = quality.get("features") or {}
+        if features:
+            sections.append(_table(
+                ["feature", "drift onsets", "cleared", "status"],
+                [[
+                    _esc(feat),
+                    rec.get("detected", 0),
+                    rec.get("cleared", 0),
+                    '<span class="ok">recovered</span>'
+                    if rec.get("cleared", 0) >= rec.get("detected", 0)
+                    else '<span class="missed">drifting</span>',
+                ] for feat, rec in sorted(features.items())],
+            ))
+        history = alerts.get("history") or []
+        if history:
+            sections.append(_table(
+                ["alert", "slo", "transition", "burn short", "burn long"],
+                [[
+                    _esc(a.get("alert", "")),
+                    _esc(a.get("slo", "")),
+                    '<span class="missed">fired</span>'
+                    if a.get("state") == "fired"
+                    else '<span class="ok">resolved</span>',
+                    f"{a.get('burn_short', 0.0):.2f}x",
+                    f"{a.get('burn_long', 0.0):.2f}x",
+                ] for a in history],
+            ))
 
     if summary.get("incidents"):
         sections += [

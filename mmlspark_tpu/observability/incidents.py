@@ -57,7 +57,9 @@ logger = get_logger("mmlspark_tpu.observability")
 
 #: the tripwire names a bundle's manifest carries
 TRIGGERS = (
+    "alert_fired",
     "breaker_tripped",
+    "drift_detected",
     "gang_failed",
     "slo_budget",
     "worker_quarantined",
@@ -237,9 +239,10 @@ class FlightRecorder:
                       encoding="utf-8") as fh:
                 for rec in records:
                     fh.write(json.dumps(rec) + "\n")
+            metrics = self._metrics_snapshot()
             with open(os.path.join(tmp, "metrics.json"), "w",
                       encoding="utf-8") as fh:
-                json.dump(self._metrics_snapshot(), fh, indent=2,
+                json.dump(metrics, fh, indent=2,
                           sort_keys=True, default=str)
             with open(os.path.join(tmp, "trace.json"), "w",
                       encoding="utf-8") as fh:
@@ -250,6 +253,12 @@ class FlightRecorder:
                 with open(os.path.join(tmp, "profiler.json"), "w",
                           encoding="utf-8") as fh:
                     json.dump(profile, fh, indent=2, default=str)
+            quality = self._quality_snapshot(metrics)
+            if quality is not None:
+                with open(os.path.join(tmp, "quality.json"), "w",
+                          encoding="utf-8") as fh:
+                    json.dump(quality, fh, indent=2, sort_keys=True,
+                              default=str)
             with open(os.path.join(tmp, "manifest.json"), "w",
                       encoding="utf-8") as fh:
                 json.dump({
@@ -275,6 +284,26 @@ class FlightRecorder:
         if not profiler.active:
             return None
         return profiler.snapshot()
+
+    @staticmethod
+    def _quality_snapshot(metrics: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+        """The drift-table evidence (``quality.json``): the live monitor's
+        snapshot when one runs in this process, else the per-feature table
+        rebuilt from the (possibly federated) ``metrics.json`` summary;
+        None when the quality plane left no trace."""
+        from mmlspark_tpu.observability.quality import (
+            drift_table_from_summary,
+            get_monitor,
+        )
+
+        monitor = get_monitor()
+        if monitor is not None:
+            return monitor.snapshot()
+        summary = metrics.get("metrics", {})
+        rows = drift_table_from_summary(summary)
+        if not rows:
+            return None
+        return {"drift": rows}
 
 
 # -- process-global, env-driven recorder --------------------------------------

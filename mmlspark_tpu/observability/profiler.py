@@ -58,8 +58,8 @@ logger = get_logger("mmlspark_tpu.observability")
 #: device_kind substring (lowercased) -> (peak FLOP/s, peak HBM bytes/s).
 #: v5e numbers are the bf16 MXU peak and the HBM bandwidth the round-4
 #: roofline case in docs/perf_histogram.md is argued against (670 GB/s
-#: measured = 83% of peak). Unknown backends report (0, 0) and roofline
-#: fractions stay None.
+#: measured = 83% of peak). Unknown backends report (0, 0) with the
+#: ``unknown-platform`` sentinel and roofline fractions stay None.
 _DEVICE_PEAKS: Tuple[Tuple[str, Tuple[float, float]], ...] = (
     ("v5 lite", (1.97e14, 8.1e11)),
     ("v5e", (1.97e14, 8.1e11)),
@@ -68,27 +68,54 @@ _DEVICE_PEAKS: Tuple[Tuple[str, Tuple[float, float]], ...] = (
     ("v3", (1.23e14, 9.0e11)),
 )
 
+#: the platform label when no peak-table row (and no env override)
+#: matched — CI CPU rigs land here. Bound classification is SKIPPED for
+#: this sentinel: labelling a host CPU "compute-bound" against a TPU
+#: machine-balance ridge is provenance-free noise (ISSUE 18 satellite).
+UNKNOWN_PLATFORM = "unknown-platform"
 
-def device_peaks(device=None) -> Tuple[float, float]:
-    """(peak FLOP/s, peak HBM bytes/s) for ``device`` (default: the first
-    jax device), overridable via ``MMLSPARK_TPU_PEAK_FLOPS`` /
-    ``MMLSPARK_TPU_PEAK_HBM_BYTES`` for rigs the table doesn't know."""
+
+class DevicePeaks(tuple):
+    """``(peak FLOP/s, peak HBM bytes/s)`` that still unpacks like the
+    bare 2-tuple it replaces, plus the ``platform`` label the peaks came
+    from (``v5e``, ``env-override``, or :data:`UNKNOWN_PLATFORM`)."""
+
+    def __new__(
+        cls, peak_flops: float, peak_bw: float, platform: str
+    ) -> "DevicePeaks":
+        self = super().__new__(cls, (float(peak_flops), float(peak_bw)))
+        self.platform = str(platform)
+        return self
+
+    @property
+    def known(self) -> bool:
+        return self.platform != UNKNOWN_PLATFORM
+
+
+def device_peaks(device=None) -> DevicePeaks:
+    """:class:`DevicePeaks` for ``device`` (default: the first jax
+    device), overridable via ``MMLSPARK_TPU_PEAK_FLOPS`` /
+    ``MMLSPARK_TPU_PEAK_HBM_BYTES`` for rigs the table doesn't know.
+    A rig with no table row and no override gets ``(0, 0)`` labelled
+    :data:`UNKNOWN_PLATFORM`, never a silently-zero TPU claim."""
     env_f = os.environ.get("MMLSPARK_TPU_PEAK_FLOPS")
     env_b = os.environ.get("MMLSPARK_TPU_PEAK_HBM_BYTES")
     if env_f or env_b:
-        return float(env_f or 0.0), float(env_b or 0.0)
+        return DevicePeaks(
+            float(env_f or 0.0), float(env_b or 0.0), "env-override"
+        )
     if device is None:
         try:
             import jax
 
             device = jax.devices()[0]
         except Exception:  # noqa: BLE001 - no backend is a valid state
-            return 0.0, 0.0
+            return DevicePeaks(0.0, 0.0, UNKNOWN_PLATFORM)
     kind = str(getattr(device, "device_kind", "")).lower()
     for needle, peaks in _DEVICE_PEAKS:
         if needle in kind:
-            return peaks
-    return 0.0, 0.0
+            return DevicePeaks(peaks[0], peaks[1], needle)
+    return DevicePeaks(0.0, 0.0, UNKNOWN_PLATFORM)
 
 
 @dataclasses.dataclass
@@ -110,12 +137,17 @@ class FunctionProfile:
         return dataclasses.asdict(self)
 
     def roofline(
-        self, peak_flops: float = 0.0, peak_bw: float = 0.0
+        self,
+        peak_flops: float = 0.0,
+        peak_bw: float = 0.0,
+        platform: Optional[str] = None,
     ) -> Dict[str, Any]:
         """Achieved vs peak attribution for this function: FLOP/s and
         bytes/s over the mean execution window, the fraction of the MXU
         and HBM peaks they represent, and which wall the program leans
-        on (``bound``)."""
+        on (``bound``). On an :data:`UNKNOWN_PLATFORM` rig the bound
+        stays ``"unknown"`` — the intensity fallback argues against a
+        TPU machine balance no unknown rig is known to have."""
         row: Dict[str, Any] = {
             "name": self.name,
             "executions": self.executions,
@@ -131,6 +163,8 @@ class FunctionProfile:
             "hbm_frac": None,
             "bound": "unknown",
         }
+        if platform is not None:
+            row["platform"] = platform
         if self.executions and self.device_seconds > 0:
             mean = self.device_seconds / self.executions
             row["achieved_flops_per_s"] = self.flops / mean
@@ -143,9 +177,12 @@ class FunctionProfile:
             row["bound"] = (
                 "memory" if row["hbm_frac"] >= row["mxu_frac"] else "compute"
             )
-        elif self.flops or self.bytes_accessed:
-            # no peak table: still label by arithmetic intensity against
-            # the classic ~10 FLOPs/byte machine-balance ridge
+        elif platform != UNKNOWN_PLATFORM and (
+            self.flops or self.bytes_accessed
+        ):
+            # no peak table but a KNOWN platform: still label by arithmetic
+            # intensity against the classic ~10 FLOPs/byte machine-balance
+            # ridge (division guarded — zero bytes_accessed clamps to 1)
             intensity = self.flops / max(self.bytes_accessed, 1.0)
             row["bound"] = "compute" if intensity > 10.0 else "memory"
         return row
@@ -510,10 +547,13 @@ class DeviceProfiler:
 
     def roofline(self) -> List[Dict[str, Any]]:
         """One attribution row per profiled function, hottest first."""
-        peak_flops, peak_bw = device_peaks()
+        peaks = device_peaks()
         with self._lock:
             profiles = list(self._profiles.values())
-        rows = [p.roofline(peak_flops, peak_bw) for p in profiles]
+        rows = [
+            p.roofline(peaks[0], peaks[1], platform=peaks.platform)
+            for p in profiles
+        ]
         rows.sort(key=lambda r: -(r["mean_ms"] * r["executions"]))
         return rows
 
@@ -532,15 +572,16 @@ class DeviceProfiler:
             }
         except Exception:  # noqa: BLE001
             dev = {"backend": "none", "kind": "", "count": 0}
-        peak_flops, peak_bw = device_peaks()
+        peaks = device_peaks()
         with self._lock:
             functions = {
                 name: p.to_dict() for name, p in self._profiles.items()
             }
         return {
             "device": dev,
-            "peak_flops_per_s": peak_flops,
-            "peak_hbm_bytes_per_s": peak_bw,
+            "platform": peaks.platform,
+            "peak_flops_per_s": peaks[0],
+            "peak_hbm_bytes_per_s": peaks[1],
             "functions": functions,
             "roofline": self.roofline(),
             "memory": self.sample_memory(),

@@ -1,0 +1,336 @@
+"""Deterministic, mergeable streaming sketches for model-quality data.
+
+The quality plane (``docs/observability.md`` § Model quality) watches
+what the fleet *predicts*, and the fleet is many processes — so the
+distribution summaries it keeps must federate the way the metrics plane
+does: merge per-replica state into one fleet view with the SAME bytes no
+matter which replica folded first. Floating-point summation is not
+associative, so the mergeable state here is exact by construction:
+
+- **histogram counts** are integers over FIXED bin edges (placed once,
+  at reference-capture time, by the :class:`QuantileCompactor`);
+- **moments** (sum, sum of squares) are :class:`fractions.Fraction` —
+  every float converts to a Fraction exactly, and Fraction addition is
+  exact and associative, so any merge order reproduces the identical
+  state and therefore the identical serialization;
+- **min/max/counts** are order-free by nature.
+
+``merge(a, merge(b, c)) == merge(merge(a, b), c)`` byte-for-byte is
+pinned by ``tests/test_quality.py``; a sketch folded across N replica
+processes equals the single-process sketch over the concatenated stream
+exactly. Drift statistics (PSI over the shared bins, KS over the bin
+CDFs) are derived at read time and never feed back into sketch state.
+"""
+
+from __future__ import annotations
+
+import bisect
+import json
+import math
+from fractions import Fraction
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "ColumnSketch",
+    "DEFAULT_BINS",
+    "QuantileCompactor",
+    "ks_statistic",
+    "merge_all",
+    "psi",
+]
+
+#: default number of (near-equidepth) bins a reference profile places —
+#: the classic PSI bin count.
+DEFAULT_BINS = 10
+
+#: smoothing mass added to every bin before a PSI log-ratio, so an empty
+#: bin on either side stays finite.
+PSI_EPS = 1e-6
+
+
+def _is_missing(value: Any) -> bool:
+    if value is None:
+        return True
+    try:
+        v = float(value)
+    except (TypeError, ValueError):
+        return True
+    return math.isnan(v)
+
+
+class QuantileCompactor:
+    """Deterministic KLL-style quantile compactor for bin-edge placement.
+
+    Fit time streams a column through this to place near-equidepth bin
+    edges without holding the column; live sketches then count into those
+    FIXED edges forever after. The classic KLL sketch flips a coin per
+    compaction; this one alternates the survivor parity deterministically
+    (compaction counter, not RNG), so the same stream always yields the
+    same edges — which is what replay-based tests and journal recovery
+    want. Weighted rank error stays O(1/k) per level, ample for placing
+    ``DEFAULT_BINS`` edges.
+    """
+
+    def __init__(self, k: int = 256) -> None:
+        if k < 8:
+            raise ValueError("compactor capacity k must be >= 8")
+        self.k = int(k)
+        #: level -> buffer of values; an item at level L weighs 2**L
+        self._levels: List[List[float]] = [[]]
+        self._compactions = 0
+        self._count = 0
+        self._min = math.inf
+        self._max = -math.inf
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    def update(self, value: Any) -> None:
+        if _is_missing(value):
+            return
+        v = float(value)
+        self._count += 1
+        self._min = min(self._min, v)
+        self._max = max(self._max, v)
+        self._levels[0].append(v)
+        level = 0
+        while len(self._levels[level]) >= self.k:
+            buf = sorted(self._levels[level])
+            offset = self._compactions % 2
+            self._compactions += 1
+            self._levels[level] = []
+            if level + 1 == len(self._levels):
+                self._levels.append([])
+            self._levels[level + 1].extend(buf[offset::2])
+            level += 1
+
+    def extend(self, values: Iterable[Any]) -> None:
+        for v in values:
+            self.update(v)
+
+    def _weighted_items(self) -> List[Tuple[float, int]]:
+        items: List[Tuple[float, int]] = []
+        for level, buf in enumerate(self._levels):
+            weight = 1 << level
+            items.extend((v, weight) for v in buf)
+        items.sort(key=lambda vw: vw[0])
+        return items
+
+    def edges(self, bins: int = DEFAULT_BINS) -> List[float]:
+        """Strictly-increasing bin edges (length <= bins + 1) placing
+        near-equidepth interior cuts; degenerate streams (constant column,
+        empty column) collapse to a single unit-wide bin."""
+        if bins < 1:
+            raise ValueError("bins must be >= 1")
+        if self._count == 0:
+            return [0.0, 1.0]
+        if self._min == self._max:
+            return [self._min - 0.5, self._min + 0.5]
+        items = self._weighted_items()
+        total = sum(w for _, w in items)
+        edges = [self._min]
+        cum = 0
+        target_idx = 1
+        for v, w in items:
+            cum += w
+            while target_idx < bins and cum >= target_idx * total / bins:
+                if v > edges[-1]:
+                    edges.append(v)
+                target_idx += 1
+        if self._max > edges[-1]:
+            edges.append(self._max)
+        else:
+            edges.append(math.nextafter(edges[-1], math.inf))
+        return edges
+
+
+class ColumnSketch:
+    """Mergeable distribution sketch of one feature (or score) column.
+
+    State: integer counts over fixed ``edges`` (values clamp into the
+    first/last bin, so out-of-reference-range live traffic is visible as
+    edge-bin mass), exact Fraction sum/sumsq, min/max, and a missing
+    counter (None/NaN/unparseable). All of it merges associatively;
+    :meth:`to_json` is canonical (sorted keys, fixed separators), so
+    equal state means equal bytes.
+    """
+
+    def __init__(self, edges: Sequence[float]) -> None:
+        edges = [float(e) for e in edges]
+        if len(edges) < 2 or any(b <= a for a, b in zip(edges, edges[1:])):
+            raise ValueError(f"edges must be strictly increasing, got {edges}")
+        self.edges: Tuple[float, ...] = tuple(edges)
+        self.counts: List[int] = [0] * (len(edges) - 1)
+        self.n = 0
+        self.missing = 0
+        self.sum = Fraction(0)
+        self.sumsq = Fraction(0)
+        self.min = math.inf
+        self.max = -math.inf
+
+    # -- ingest --------------------------------------------------------------
+
+    def observe(self, value: Any) -> None:
+        if _is_missing(value):
+            self.missing += 1
+            return
+        v = float(value)
+        # interior edges only: left of edges[1] -> bin 0, right of
+        # edges[-2] -> last bin (the clamp that keeps shifted traffic
+        # countable against the reference bins)
+        idx = bisect.bisect_right(self.edges, v, 1, len(self.edges) - 1) - 1
+        self.counts[idx] += 1
+        self.n += 1
+        f = Fraction(v)
+        self.sum += f
+        self.sumsq += f * f
+        self.min = min(self.min, v)
+        self.max = max(self.max, v)
+
+    def observe_many(self, values: Iterable[Any]) -> None:
+        for v in values:
+            self.observe(v)
+
+    # -- merge ---------------------------------------------------------------
+
+    def merge(self, other: "ColumnSketch") -> "ColumnSketch":
+        """Pure associative merge: a new sketch whose state is the exact
+        sum of both operands (edges must match)."""
+        if self.edges != other.edges:
+            raise ValueError("cannot merge sketches with different edges")
+        out = ColumnSketch(self.edges)
+        out.counts = [a + b for a, b in zip(self.counts, other.counts)]
+        out.n = self.n + other.n
+        out.missing = self.missing + other.missing
+        out.sum = self.sum + other.sum
+        out.sumsq = self.sumsq + other.sumsq
+        out.min = min(self.min, other.min)
+        out.max = max(self.max, other.max)
+        return out
+
+    # -- derived -------------------------------------------------------------
+
+    def mean(self) -> float:
+        return float(self.sum / self.n) if self.n else 0.0
+
+    def variance(self) -> float:
+        if self.n < 2:
+            return 0.0
+        mean = self.sum / self.n
+        return float(self.sumsq / self.n - mean * mean)
+
+    def missing_rate(self) -> float:
+        total = self.n + self.missing
+        return self.missing / total if total else 0.0
+
+    def probabilities(self, eps: float = 0.0) -> List[float]:
+        """Per-bin mass fractions, optionally eps-smoothed (every bin gets
+        ``eps`` extra mass before normalizing)."""
+        total = self.n + eps * len(self.counts)
+        if total <= 0:
+            return [1.0 / len(self.counts)] * len(self.counts)
+        return [(c + eps) / total for c in self.counts]
+
+    def cdf(self) -> List[float]:
+        """Cumulative mass at each interior edge + the upper edge."""
+        out: List[float] = []
+        cum = 0
+        for c in self.counts:
+            cum += c
+            out.append(cum / self.n if self.n else 0.0)
+        return out
+
+    def quantile(self, q: float) -> float:
+        """Quantile estimate by linear interpolation inside the owning
+        bin (the registry histogram's ``percentile`` posture)."""
+        if not 0.0 <= q <= 1.0:
+            raise ValueError("q must be in [0, 1]")
+        if self.n == 0:
+            return 0.0
+        rank = q * self.n
+        cum = 0
+        for i, c in enumerate(self.counts):
+            prev, cum = cum, cum + c
+            if cum >= rank and c > 0:
+                lo, hi = self.edges[i], self.edges[i + 1]
+                return lo + (hi - lo) * (rank - prev) / c
+        return self.edges[-1]
+
+    # -- serialization (canonical; byte-stable across merge orders) ----------
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "edges": list(self.edges),
+            "counts": list(self.counts),
+            "n": self.n,
+            "missing": self.missing,
+            # Fractions serialize exactly as "numerator/denominator"
+            "sum": f"{self.sum.numerator}/{self.sum.denominator}",
+            "sumsq": f"{self.sumsq.numerator}/{self.sumsq.denominator}",
+            "min": None if self.n == 0 else self.min,
+            "max": None if self.n == 0 else self.max,
+        }
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict(), sort_keys=True, separators=(",", ":"))
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "ColumnSketch":
+        out = cls(d["edges"])
+        counts = [int(c) for c in d["counts"]]
+        if len(counts) != len(out.counts):
+            raise ValueError("counts length does not match edges")
+        out.counts = counts
+        out.n = int(d["n"])
+        out.missing = int(d["missing"])
+        out.sum = Fraction(d["sum"])
+        out.sumsq = Fraction(d["sumsq"])
+        out.min = math.inf if d.get("min") is None else float(d["min"])
+        out.max = -math.inf if d.get("max") is None else float(d["max"])
+        return out
+
+
+# -- drift statistics (reference vs live, shared edges) ----------------------
+
+
+def psi(
+    reference: ColumnSketch,
+    live: ColumnSketch,
+    eps: float = PSI_EPS,
+) -> float:
+    """Population Stability Index over the shared bins:
+    ``sum((q_i - p_i) * ln(q_i / p_i))`` with eps-smoothed masses so an
+    empty bin on either side stays finite. Conventional reading: < 0.1
+    stable, 0.1-0.2 moderate shift, > 0.2 significant shift."""
+    if reference.edges != live.edges:
+        raise ValueError("PSI requires sketches over the same edges")
+    p = reference.probabilities(eps=eps)
+    q = live.probabilities(eps=eps)
+    return float(sum((qi - pi) * math.log(qi / pi) for pi, qi in zip(p, q)))
+
+
+def ks_statistic(reference: ColumnSketch, live: ColumnSketch) -> float:
+    """Two-sample Kolmogorov-Smirnov statistic evaluated at the bin
+    edges: ``max_i |CDF_ref(e_i) - CDF_live(e_i)|``. A lower bound on the
+    exact-sample KS (the CDFs are only compared where the bins cut), which
+    is the right bias for an alerting statistic over fixed bins."""
+    if reference.edges != live.edges:
+        raise ValueError("KS requires sketches over the same edges")
+    return float(
+        max(
+            (abs(a - b) for a, b in zip(reference.cdf(), live.cdf())),
+            default=0.0,
+        )
+    )
+
+
+def merge_all(sketches: Sequence[ColumnSketch]) -> Optional[ColumnSketch]:
+    """Left fold of :meth:`ColumnSketch.merge` (associative, so any fold
+    shape gives the same bytes); None for an empty sequence."""
+    if not sketches:
+        return None
+    out = sketches[0]
+    for s in sketches[1:]:
+        out = out.merge(s)
+    return out

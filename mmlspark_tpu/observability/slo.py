@@ -25,7 +25,15 @@ import dataclasses
 import json
 from typing import Any, Dict, Iterable, List, Optional, Union
 
-from mmlspark_tpu.observability.events import Event, RequestServed, RequestShed
+from mmlspark_tpu.observability.events import (
+    AlertFired,
+    AlertResolved,
+    DriftCleared,
+    DriftDetected,
+    Event,
+    RequestServed,
+    RequestShed,
+)
 from mmlspark_tpu.observability.registry import MetricsRegistry
 
 
@@ -120,6 +128,10 @@ class SLOReport:
     #: per-stage summaries (count/sum/p50/p95/p99, seconds)
     stages: Dict[str, Dict[str, float]]
     batches: float = 0.0
+    #: model-quality section (ISSUE 18): the per-feature drift table
+    #: rebuilt from the ``quality_*`` gauges, the live ``alerts_active``
+    #: gauge, and the drift/alert transition history from the event log
+    quality: Dict[str, Any] = dataclasses.field(default_factory=dict)
 
     # -- derived -------------------------------------------------------------
 
@@ -189,6 +201,8 @@ class SLOReport:
         errors = 0.0
         ev_served = 0.0
         ev_shed = 0.0
+        drift_events: List[Dict[str, Any]] = []
+        alert_history: List[Dict[str, Any]] = []
         for ev in events or ():
             if isinstance(ev, RequestServed):
                 ev_served += 1
@@ -197,10 +211,35 @@ class SLOReport:
                     errors += 1
             elif isinstance(ev, RequestShed):
                 ev_shed += 1
+            elif isinstance(ev, (DriftDetected, DriftCleared)):
+                drift_events.append({
+                    "event": type(ev).__name__,
+                    "feature": ev.feature,
+                    "stat": ev.stat,
+                    "value": float(ev.value),
+                    "threshold": float(ev.threshold),
+                })
+            elif isinstance(ev, (AlertFired, AlertResolved)):
+                alert_history.append({
+                    "event": type(ev).__name__,
+                    "alert": ev.alert,
+                    "slo": ev.slo,
+                    "burn_short": float(ev.burn_short),
+                    "burn_long": float(ev.burn_long),
+                })
         if requests == 0.0:
             requests = ev_served
         if shed == 0.0:
             shed = ev_shed
+
+        from mmlspark_tpu.observability.quality import drift_table_from_summary
+
+        quality = {
+            "drift": drift_table_from_summary(summary),
+            "alerts_active": _scalar(summary, "alerts_active"),
+            "drift_events": drift_events,
+            "alert_history": alert_history,
+        }
 
         latencies.sort()
         e2e = {
@@ -228,6 +267,7 @@ class SLOReport:
             e2e=e2e,
             stages=stages,
             batches=batches,
+            quality=quality,
         )
 
     @classmethod
@@ -265,6 +305,7 @@ class SLOReport:
             "batches": self.batches,
             "e2e": self.e2e,
             "stages": self.stages,
+            "quality": self.quality,
             "ok": self.ok(),
         }
 
@@ -324,4 +365,34 @@ class SLOReport:
                 f"| {self.e2e['p95'] * 1e3:.2f} ms "
                 f"| {self.e2e['p99'] * 1e3:.2f} ms |"
             )
+        drift = self.quality.get("drift") or []
+        if drift:
+            lines += [
+                "",
+                "Model quality (vs reference profile):",
+                "",
+                "| feature | model | version | PSI | KS | drifted |",
+                "|---|---|---|---|---|---|",
+            ]
+            for row in drift:
+                lines.append(
+                    f"| {row.get('feature', '')} | {row.get('model', '')} "
+                    f"| {row.get('version', '')} | {row.get('psi', 0.0):.3f} "
+                    f"| {row.get('ks', 0.0):.3f} "
+                    f"| {'yes' if row.get('drifted') else 'no'} |"
+                )
+        history = self.quality.get("alert_history") or []
+        if history:
+            lines += [
+                "",
+                "| alert | slo | transition | burn short | burn long |",
+                "|---|---|---|---|---|",
+            ]
+            for rec in history:
+                lines.append(
+                    f"| {rec.get('alert', '')} | {rec.get('slo', '')} "
+                    f"| {rec.get('event', '')} "
+                    f"| {rec.get('burn_short', 0.0):.2f}x "
+                    f"| {rec.get('burn_long', 0.0):.2f}x |"
+                )
         return "\n".join(lines)

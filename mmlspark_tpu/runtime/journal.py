@@ -329,6 +329,48 @@ class ModelStore:
         except (OSError, ValueError, KeyError):
             return None
 
+    def _artifact_name(self, name: str, version: int, kind: str) -> str:
+        if not re.fullmatch(r"[A-Za-z0-9_-]+", kind):
+            raise ValueError(f"artifact kind must be a bare slug, got {kind!r}")
+        return f"{name}-{version:06d}.{kind}.json"
+
+    def commit_artifact(
+        self, name: str, version: int, kind: str, payload: Dict[str, Any]
+    ) -> str:
+        """Commit a JSON artifact riding next to ``<name>-<version>`` —
+        e.g. the quality plane's reference profile (``kind="quality"``).
+        Written with the same tmp+fsync+rename discipline and CRC32
+        sidecar as the model text itself; returns the artifact filename.
+        Artifacts never touch the ``CURRENT`` pointer: a model version is
+        live regardless of which sidecars it carries."""
+        data = json.dumps(payload, sort_keys=True).encode("utf-8")
+        crc = zlib.crc32(data) & 0xFFFFFFFF
+        fname = self._artifact_name(name, version, kind)
+        with self._lock:
+            _atomic_write(os.path.join(self.root, fname), data)
+            _atomic_write(
+                os.path.join(self.root, fname + ".crc32"),
+                f"{crc:08x}".encode(),
+            )
+        return fname
+
+    def read_artifact(
+        self, name: str, version: int, kind: str
+    ) -> Optional[Dict[str, Any]]:
+        """The verified JSON artifact for ``<name>-<version>``, or None
+        when it is absent or fails its sidecar checksum (a torn artifact
+        reads as missing, never as garbage)."""
+        fname = self._artifact_name(name, version, kind)
+        text = self._read_verified(fname)
+        if text is None:
+            return None
+        try:
+            payload = json.loads(text)
+        except ValueError:
+            logger.warning("artifact %s is not valid JSON", fname)
+            return None
+        return payload if isinstance(payload, dict) else None
+
     def latest(self, name: str = "model") -> Optional[Tuple[int, str]]:
         """(version, text) of the last committed model, or None. CURRENT
         is trusted when its target verifies; otherwise scan versions

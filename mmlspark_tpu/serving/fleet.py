@@ -74,6 +74,7 @@ class FleetController:
         down_sustain_s: float = 2.0,
         interval_s: float = 0.5,
         clock: Callable[[], float] = time.monotonic,
+        alert_advisor: Optional[Callable[[], Any]] = None,
     ):
         if registry is None and registry_url is None:
             raise ValueError("need registry= or registry_url=")
@@ -96,6 +97,10 @@ class FleetController:
         self.down_sustain_s = float(down_sustain_s)
         self.interval_s = float(interval_s)
         self.clock = clock
+        #: advisory hook (e.g. ``AlertEvaluator.active_alerts``): while it
+        #: returns a truthy value the fleet is pinned non-idle, so an
+        #: actively-burning SLO defers scale-down until the alert resolves
+        self.alert_advisor = alert_advisor
         self._last_action_at: Optional[float] = None
         self._low_since: Optional[float] = None
         #: (total shed counter, at) from the previous pass — the shed RATE
@@ -190,12 +195,24 @@ class FleetController:
         self._last_shed = (shed_total, now)
         p99 = max((s.p99_ms or 0.0 for s in services), default=0.0)
 
+        alerting = False
+        if self.alert_advisor is not None:
+            try:
+                alerting = bool(self.alert_advisor())
+            except Exception as e:  # noqa: BLE001 - advisory must not blind
+                logger.debug("alert advisor failed: %s", e)
         busy = (
             mean_inflight >= self.scale_up_inflight
             or shed_rate >= self.scale_up_shed_rate
             or (self.p99_up_ms is not None and p99 >= self.p99_up_ms)
         )
-        idle = mean_inflight <= self.scale_down_inflight and shed_rate == 0.0
+        # a firing SLO alert pins the fleet non-idle: retiring capacity
+        # mid-incident can only deepen the burn
+        idle = (
+            mean_inflight <= self.scale_down_inflight
+            and shed_rate == 0.0
+            and not alerting
+        )
         if not idle:
             self._low_since = None
         elif self._low_since is None:

@@ -70,6 +70,20 @@ logger = logging.getLogger("mmlspark_tpu.serving")
 #: every batch in the first bucket
 _BATCH_SIZE_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0, 256.0)
 
+_GET_QMONITOR = None
+
+
+def _quality_monitor():
+    # ambient quality gate, cached like core.pipeline._tracer: the batch
+    # loop is the serving hot path, so an unconfigured process pays one
+    # env lookup per batch and never imports the quality plane
+    global _GET_QMONITOR
+    if _GET_QMONITOR is None:
+        from mmlspark_tpu.observability.quality import get_monitor
+
+        _GET_QMONITOR = get_monitor
+    return _GET_QMONITOR()
+
 
 class _Server(ThreadingHTTPServer):
     # many concurrent clients: deep accept backlog, daemon worker threads
@@ -159,6 +173,10 @@ class _BatchLoop:
         self.model = model
         self.input_col = input_col
         self.output_col = output_col
+        #: ModelStore version of ``model`` (0 = untracked); hot swaps and
+        #: warm restarts refresh it so drift sketches carry the version
+        #: of the model that actually scored each batch
+        self.model_version = 0
         self.max_batch_size = int(max_batch_size)
         self.max_latency_ms = float(max_latency_ms)
         self.max_retries = int(max_retries)
@@ -385,15 +403,31 @@ class _BatchLoop:
                 col = np.stack(payloads)  # rectangular -> fast path
             except (ValueError, TypeError):
                 col = payloads  # ragged payloads stay an object column
+            # drift sketching (quality plane): the loop observes the
+            # batch itself — inputs before apply, scores after — and
+            # suppresses the PipelineModel.transform hook underneath so
+            # a request is never sketched twice
+            monitor = _quality_monitor()
             t0 = time.perf_counter()
             with tracer.span(
                 "serving.batch", parent=parent, epoch=epoch, size=len(batch)
             ):
                 with tracer.span("serving.apply"):
-                    out = self._apply_model(Table({self.input_col: col}))
+                    if monitor is not None:
+                        with monitor.suppress_transform():
+                            out = self._apply_model(
+                                Table({self.input_col: col})
+                            )
+                    else:
+                        out = self._apply_model(Table({self.input_col: col}))
             apply_dt = time.perf_counter() - t0
             self._reg_apply.observe(apply_dt)
             values = out.column(self.output_col)
+            if monitor is not None:
+                monitor.observe_columns(
+                    {self.input_col: col, self.output_col: values},
+                    version=self.model_version,
+                )
             prof = get_profiler()
             if prof.active:
                 prof.note_execute("serving.apply", apply_dt)
@@ -784,6 +818,10 @@ class ServingServer(_ListenerMixin):
                 self.loop.model = model
                 self.model_version = version
                 self.info.model_version = version
+                self.loop.model_version = version
+                monitor = _quality_monitor()
+                if monitor is not None:
+                    monitor.note_version(version)
                 swaps.inc()
                 version_g.set(version)
                 logger.info(
@@ -1402,6 +1440,10 @@ def warm_restart_server(
     server = ServingServer(model, **server_kwargs)
     server.model_version = version
     server.info.model_version = version
+    server.loop.model_version = version
+    monitor = _quality_monitor()
+    if monitor is not None:
+        monitor.note_version(version)
     if watch:
         server.enable_hot_swap(loader, root=root, name=name, poll_s=poll_s)
     return server

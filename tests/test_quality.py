@@ -1,0 +1,502 @@
+"""Model-quality plane tests — deterministic sketches, reference
+profiles, drift monitoring, and burn-rate alerting.
+
+The determinism suite is the load-bearing part: the fleet merges
+per-replica sketch state, so ``merge`` must be exactly associative and
+the canonical serialization byte-stable across every merge order — a
+federated fold must equal the single-process sketch over the
+concatenated stream, not approximate it. Statistics are checked against
+straight numpy golden computations over the same fixed bins.
+
+Monitors and evaluators run against FRESH ``MetricsRegistry`` instances
+and injected clocks/sources so nothing here touches the process-global
+plane or wall time.
+"""
+
+import itertools
+import json
+import math
+import random
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.observability import (
+    AlertEvaluator,
+    ColumnSketch,
+    DriftCleared,
+    DriftDetected,
+    MetricsFederator,
+    MetricsRegistry,
+    QualityMonitor,
+    QuantileCompactor,
+    ReferenceProfile,
+    drift_table_from_summary,
+    get_bus,
+    ks_statistic,
+    load_profile,
+    merge_all,
+    psi,
+)
+from mmlspark_tpu.observability.profiler import (
+    UNKNOWN_PLATFORM,
+    DevicePeaks,
+    FunctionProfile,
+    device_peaks,
+)
+from mmlspark_tpu.observability.slo import SLOTargets
+from mmlspark_tpu.runtime.journal import ModelStore
+
+
+def _stream(seed: int, n: int, mu: float = 0.0, sigma: float = 1.0):
+    rng = random.Random(seed)
+    return [rng.gauss(mu, sigma) for _ in range(n)]
+
+
+def _sketch(edges, values) -> ColumnSketch:
+    s = ColumnSketch(edges)
+    s.observe_many(values)
+    return s
+
+
+class TestSketchDeterminism:
+    def test_shuffled_merge_is_byte_stable(self):
+        """Any shard split + any merge order reproduces the
+        single-process sketch byte-for-byte."""
+        values = _stream(7, 2000)
+        comp = QuantileCompactor()
+        comp.extend(values)
+        edges = comp.edges()
+        whole = _sketch(edges, values)
+        shards = [
+            _sketch(edges, values[i::5]) for i in range(5)
+        ]
+        rng = random.Random(13)
+        for _ in range(8):
+            order = shards[:]
+            rng.shuffle(order)
+            merged = merge_all(order)
+            assert merged.to_json() == whole.to_json()
+
+    def test_merge_is_associative(self):
+        edges = [0.0, 1.0, 2.0, 3.0]
+        a = _sketch(edges, [0.1, 1.5, None, 2.9])
+        b = _sketch(edges, [0.5, 0.6, float("nan"), 2.2])
+        c = _sketch(edges, [1.1, -5.0, 99.0])  # clamps into edge bins
+        left = a.merge(b).merge(c)
+        right = a.merge(b.merge(c))
+        assert left.to_json() == right.to_json()
+
+    def test_federated_equals_single_process(self):
+        """The fleet fold: 3 'replica' sketches over disjoint traffic
+        merge to exactly the sketch of the concatenated stream —
+        counts, moments (Fractions), min/max, and missing all exact."""
+        values = _stream(21, 900, mu=2.0) + [None] * 30
+        random.Random(3).shuffle(values)
+        comp = QuantileCompactor()
+        comp.extend(values)
+        edges = comp.edges()
+        whole = _sketch(edges, values)
+        replicas = [_sketch(edges, values[i::3]) for i in range(3)]
+        merged = merge_all(replicas)
+        assert merged.counts == whole.counts
+        assert merged.sum == whole.sum and merged.sumsq == whole.sumsq
+        assert merged.missing == whole.missing
+        assert merged.to_json() == whole.to_json()
+
+    def test_compactor_is_deterministic(self):
+        values = _stream(42, 5000)
+        edges = []
+        for _ in range(2):
+            comp = QuantileCompactor()
+            comp.extend(values)
+            edges.append(comp.edges())
+        assert edges[0] == edges[1]
+        assert all(b > a for a, b in zip(edges[0], edges[0][1:]))
+
+    def test_compactor_edges_near_equidepth(self):
+        values = _stream(5, 8000)
+        comp = QuantileCompactor()
+        comp.extend(values)
+        edges = comp.edges(10)
+        counts, _ = np.histogram(values, bins=edges)
+        # each of the 10 bins should hold roughly 1/10 of the mass
+        assert counts.min() > 0.04 * len(values)
+        assert counts.max() < 0.25 * len(values)
+
+    def test_serialization_round_trip(self):
+        s = _sketch([0.0, 0.5, 1.0], [0.1, 0.2, 0.7, None, 1.5])
+        back = ColumnSketch.from_dict(json.loads(s.to_json()))
+        assert back.to_json() == s.to_json()
+        assert back.sum == s.sum and back.mean() == s.mean()
+
+    def test_degenerate_streams(self):
+        empty = QuantileCompactor()
+        assert empty.edges() == [0.0, 1.0]
+        const = QuantileCompactor()
+        const.extend([3.0] * 50)
+        edges = const.edges()
+        assert len(edges) == 2 and edges[0] < 3.0 < edges[1]
+
+
+class TestDriftStatistics:
+    def test_psi_golden_vs_numpy(self):
+        """PSI from sketch state must equal the straight numpy
+        computation over the same bins and the same eps smoothing."""
+        ref_vals = _stream(1, 4000)
+        live_vals = _stream(2, 3000, mu=1.0)
+        comp = QuantileCompactor()
+        comp.extend(ref_vals)
+        edges = comp.edges()
+        ref, live = _sketch(edges, ref_vals), _sketch(edges, live_vals)
+
+        eps = 1e-6
+        e = np.asarray(edges)
+        rc, _ = np.histogram(np.clip(ref_vals, e[0], e[-1]), bins=e)
+        lc, _ = np.histogram(np.clip(live_vals, e[0], e[-1]), bins=e)
+        p = (rc + eps) / (rc.sum() + eps * len(rc))
+        q = (lc + eps) / (lc.sum() + eps * len(lc))
+        golden = float(np.sum((q - p) * np.log(q / p)))
+
+        assert psi(ref, live) == pytest.approx(golden, rel=1e-9)
+        # same distribution scores near zero; shifted scores large
+        same = _sketch(edges, _stream(9, 3000))
+        assert psi(ref, same) < 0.05
+        assert psi(ref, live) > 0.2
+
+    def test_ks_golden_vs_numpy(self):
+        ref_vals = _stream(11, 2500)
+        live_vals = _stream(12, 2500, mu=0.8)
+        comp = QuantileCompactor()
+        comp.extend(ref_vals)
+        edges = comp.edges()
+        ref, live = _sketch(edges, ref_vals), _sketch(edges, live_vals)
+
+        e = np.asarray(edges)
+        rc, _ = np.histogram(np.clip(ref_vals, e[0], e[-1]), bins=e)
+        lc, _ = np.histogram(np.clip(live_vals, e[0], e[-1]), bins=e)
+        golden = float(
+            np.max(np.abs(np.cumsum(rc) / rc.sum() - np.cumsum(lc) / lc.sum()))
+        )
+        assert ks_statistic(ref, live) == pytest.approx(golden, rel=1e-9)
+        assert ks_statistic(ref, ref) == 0.0
+
+    def test_mismatched_edges_refused(self):
+        a = ColumnSketch([0.0, 1.0])
+        b = ColumnSketch([0.0, 2.0])
+        with pytest.raises(ValueError):
+            a.merge(b)
+        with pytest.raises(ValueError):
+            psi(a, b)
+        with pytest.raises(ValueError):
+            ks_statistic(a, b)
+
+
+class TestReferenceProfile:
+    def test_store_round_trip(self, tmp_path):
+        store = ModelStore(str(tmp_path))
+        store.commit("model-text", name="m")
+        profile = ReferenceProfile.capture(
+            "m", 1,
+            {"input": [[x, -x] for x in _stream(4, 300)],
+             "prediction": _stream(5, 300)},
+        )
+        # vector column fanned out per index, scalar kept bare
+        assert set(profile.features) == {"input[0]", "input[1]", "prediction"}
+        profile.commit(store)
+        back = load_profile(store, "m", 1)
+        assert back is not None
+        assert back.to_dict() == profile.to_dict()
+
+    def test_corrupt_artifact_reads_as_missing(self, tmp_path):
+        store = ModelStore(str(tmp_path))
+        profile = ReferenceProfile.capture("m", 1, {"x": _stream(6, 100)})
+        fname = profile.commit(store)
+        path = tmp_path / fname
+        path.write_bytes(path.read_bytes()[:-4] + b"!!!!")
+        assert store.read_artifact("m", 1, "quality") is None
+        assert load_profile(store, "m", 1) is None
+
+    def test_capture_is_deterministic(self):
+        cols = {"x": _stream(8, 500)}
+        a = ReferenceProfile.capture("m", 1, cols)
+        b = ReferenceProfile.capture("m", 1, cols)
+        assert json.dumps(a.to_dict(), sort_keys=True) == json.dumps(
+            b.to_dict(), sort_keys=True
+        )
+
+
+class TestQualityMonitor:
+    def _monitor(self, ref_vals, **kw):
+        profile = ReferenceProfile.capture("m", 1, {"x": ref_vals})
+        kw.setdefault("registry", MetricsRegistry())
+        kw.setdefault("window", 256)
+        kw.setdefault("eval_every", 64)
+        kw.setdefault("min_window", 128)
+        return QualityMonitor(profile=profile, **kw)
+
+    def test_detect_then_clear_with_paired_events(self):
+        mon = self._monitor(_stream(30, 2000))
+        seen = []
+        bus = get_bus()
+        bus.add_listener(seen.append)
+        try:
+            # stable traffic: no drift
+            mon.observe_columns({"x": _stream(31, 256)})
+            assert mon.drifted_features() == []
+            # shifted traffic turns the window over: drift fires once
+            mon.observe_columns({"x": _stream(32, 256, mu=4.0)})
+            assert mon.drifted_features() == ["x"]
+            detected = [e for e in seen if isinstance(e, DriftDetected)]
+            assert len(detected) == 1
+            assert detected[0].feature == "x"
+            assert detected[0].value > detected[0].threshold
+            # reverting the traffic clears it (hysteresis satisfied)
+            mon.observe_columns({"x": _stream(33, 512)})
+            assert mon.drifted_features() == []
+            cleared = [e for e in seen if isinstance(e, DriftCleared)]
+            assert len(cleared) == 1 and cleared[0].feature == "x"
+        finally:
+            bus.remove_listener(seen.append)
+
+    def test_gauges_and_snapshot(self):
+        reg = MetricsRegistry()
+        mon = self._monitor(_stream(40, 2000), registry=reg)
+        mon.observe_columns({"x": _stream(41, 256, mu=4.0)})
+        summary = reg.summary()
+        psi_series = summary["quality_psi"]
+        (key,) = psi_series.keys()
+        assert "feature=x" in key and "model=m" in key
+        assert psi_series[key] > 0.2
+        snap = mon.snapshot()
+        assert snap["model"] == "m"
+        (row,) = snap["drift"]
+        assert row["feature"] == "x" and row["drifted"] is True
+        # the federated rebuild agrees with the local snapshot
+        table = drift_table_from_summary(summary)
+        assert len(table) == 1
+        assert table[0]["feature"] == "x" and table[0]["drifted"] is True
+        assert table[0]["psi"] == pytest.approx(row["psi"])
+
+    def test_min_window_blocks_small_sample_psi_bias(self):
+        """A short same-distribution window reads high on PSI by
+        construction (E[PSI] ~ (bins-1)/n) — min_window must keep it
+        from scoring at all."""
+        mon = self._monitor(_stream(50, 2000), min_window=128, eval_every=8)
+        mon.observe_columns({"x": _stream(51, 40)})
+        assert mon.snapshot()["drift"] == []
+        assert mon.drifted_features() == []
+
+    def test_unprofiled_columns_ignored(self):
+        reg = MetricsRegistry()
+        mon = self._monitor(_stream(60, 500), registry=reg)
+        mon.observe_columns({"y": [1.0] * 100})
+        assert reg.summary().get("quality_observations_total", 0) == 0
+
+    def test_suppression_nests(self):
+        mon = self._monitor(_stream(61, 100))
+        assert not mon.transform_suppressed
+        with mon.suppress_transform():
+            with mon.suppress_transform():
+                assert mon.transform_suppressed
+            assert mon.transform_suppressed
+        assert not mon.transform_suppressed
+
+    def test_version_zero_never_reloads(self, tmp_path):
+        store = ModelStore(str(tmp_path))
+        profile = ReferenceProfile.capture("m", 1, {"x": _stream(62, 200)})
+        profile.commit(store)
+        store.commit("text", name="m")
+        mon = QualityMonitor(
+            store=store, model="m", registry=MetricsRegistry()
+        )
+        assert mon.version == 1
+        mon.note_version(0)  # untracked loop: must not reset the profile
+        assert mon.version == 1 and mon.profile is not None
+        # a profile-less new version keeps the reference, relabels only
+        store.commit("text2", name="m")
+        mon.note_version(2)
+        assert mon.version == 2
+        assert mon.profile is not None and mon.profile.version == 1
+
+
+class TestAlertEvaluator:
+    def _run(self, mean_apply_ms):
+        """Drive one evaluator over a scripted metric timeline; returns
+        (evaluator, fired, resolved, registry)."""
+        t = {"now": 0.0}
+        state = {"req": 0.0, "apply_sum": 0.0, "count": 0.0}
+
+        def source():
+            return {
+                "serving_requests_total": state["req"],
+                "serving_replies_failed_total": 0.0,
+                "serving_apply_latency_seconds": {
+                    "sum": state["apply_sum"], "count": state["count"],
+                },
+            }
+
+        reg = MetricsRegistry()
+        ev = AlertEvaluator(
+            targets=SLOTargets(),  # p99 <= 50 ms
+            source=source, registry=reg,
+            windows=(2.0, 8.0), clock=lambda: t["now"],
+        )
+        seen = []
+        bus = get_bus()
+        bus.add_listener(seen.append)
+        try:
+            for step, ms in enumerate(mean_apply_ms):
+                t["now"] = step * 1.0
+                state["req"] += 10
+                state["count"] += 10
+                state["apply_sum"] += 10 * ms / 1e3
+                ev.tick()
+        finally:
+            bus.remove_listener(seen.append)
+        from mmlspark_tpu.observability.events import AlertFired, AlertResolved
+
+        fired = [e for e in seen if isinstance(e, AlertFired)]
+        resolved = [e for e in seen if isinstance(e, AlertResolved)]
+        return ev, fired, resolved, reg
+
+    def test_latency_storm_fires_and_resolves(self):
+        # 10 quiet ticks (ring spans the 8 s window), 12 storm ticks at
+        # 120 ms mean (2.4x the 50 ms budget), then recovery
+        timeline = [5.0] * 10 + [120.0] * 12 + [5.0] * 12
+        ev, fired, resolved, reg = self._run(timeline)
+        assert [e.alert for e in fired] == ["latency"]
+        assert fired[0].burn_short > 1.0 and fired[0].burn_long > 1.0
+        assert fired[0].window_short_s == 2.0
+        assert [e.alert for e in resolved] == ["latency"]
+        assert ev.active_alerts() == ()
+        assert reg.summary()["alerts_active"] == 0.0
+
+    def test_short_blip_does_not_page(self):
+        """The long window is the flap guard: a 2-tick spike burns the
+        short window but never the long one."""
+        timeline = [5.0] * 10 + [120.0] * 2 + [5.0] * 14
+        _, fired, _, _ = self._run(timeline)
+        assert fired == []
+
+    def test_young_ring_never_fires(self):
+        _, fired, _, _ = self._run([500.0] * 5)  # < long window of history
+        assert fired == []
+
+    def test_active_alerts_pins_fleet_controller(self):
+        """The advisory hook: a firing alert blocks the idle scale-down
+        path until it resolves."""
+        from types import SimpleNamespace
+
+        from mmlspark_tpu.serving.fleet import FleetController
+
+        alerts = {"active": ("latency",)}
+        ctl = FleetController(
+            supervisor=SimpleNamespace(live_count=3, _procs={}),
+            registry=SimpleNamespace(services=[]),
+            min_replicas=1, max_replicas=4,
+            cooldown_s=0.0, down_sustain_s=1.0,
+            clock=lambda: 0.0,
+            alert_advisor=lambda: alerts["active"],
+        )
+        idle = []  # no registered replicas -> zero inflight, zero shed
+        for now in (0.0, 2.0, 4.0):
+            assert ctl.decide(idle, now=now) is None
+        alerts["active"] = ()
+        assert ctl.decide(idle, now=5.0) is None  # idle clock restarts
+        decision = ctl.decide(idle, now=7.0)
+        assert decision is not None and decision[0] == "down"
+
+
+class TestRoofline:
+    def test_unknown_platform_skips_bound_classification(self):
+        peaks = DevicePeaks(0.0, 0.0, UNKNOWN_PLATFORM)
+        assert not peaks.known
+        prof = FunctionProfile(
+            name="f", executions=4, device_seconds=0.01,
+            flops=1e9, bytes_accessed=1e6,
+        )
+        row = prof.roofline(*peaks, platform=peaks.platform)
+        assert row["bound"] == "unknown"
+        assert row["mxu_frac"] is None and row["hbm_frac"] is None
+        assert row["platform"] == UNKNOWN_PLATFORM
+
+    def test_zero_execution_profile_never_divides(self):
+        row = FunctionProfile(name="f").roofline(0.0, 0.0, UNKNOWN_PLATFORM)
+        assert row["mean_ms"] == 0.0 and row["bound"] == "unknown"
+
+    def test_unrecognized_device_kind_is_sentinel(self, monkeypatch):
+        from types import SimpleNamespace
+
+        monkeypatch.delenv("MMLSPARK_TPU_PEAK_FLOPS", raising=False)
+        monkeypatch.delenv("MMLSPARK_TPU_PEAK_HBM_BYTES", raising=False)
+        peaks = device_peaks(SimpleNamespace(device_kind="Weird Chip 9000"))
+        assert peaks.platform == UNKNOWN_PLATFORM
+        assert tuple(peaks) == (0.0, 0.0)
+
+    def test_env_override_labels_provenance(self, monkeypatch):
+        from types import SimpleNamespace
+
+        monkeypatch.setenv("MMLSPARK_TPU_PEAK_FLOPS", "1e12")
+        monkeypatch.setenv("MMLSPARK_TPU_PEAK_HBM_BYTES", "1e11")
+        peaks = device_peaks(SimpleNamespace(device_kind="whatever"))
+        assert peaks.platform == "env-override"
+        assert peaks.known and tuple(peaks) == (1e12, 1e11)
+
+
+class TestQualityPairingCheck:
+    def _records(self, *events):
+        return [dict(e) for e in events]
+
+    def test_paired_log_passes(self):
+        from tools.check_eventlog import check_quality_pairing
+
+        records = self._records(
+            {"event": "DriftDetected", "feature": "x", "stat": "psi"},
+            {"event": "AlertFired", "alert": "latency", "slo": "p99"},
+            {"event": "DriftCleared", "feature": "x"},
+            {"event": "AlertResolved", "alert": "latency"},
+        )
+        problems, summary = check_quality_pairing(records)
+        assert problems == []
+        assert "2/2" in summary
+
+    def test_unpaired_onsets_flagged(self):
+        from tools.check_eventlog import check_quality_pairing
+
+        records = self._records(
+            {"event": "DriftDetected", "feature": "x", "stat": "ks"},
+            # a clear on ANOTHER feature must not pair feature x
+            {"event": "DriftCleared", "feature": "y"},
+            {"event": "AlertFired", "alert": "availability", "slo": "a"},
+        )
+        problems, _ = check_quality_pairing(records)
+        assert len(problems) == 2
+        assert any("'x'" in p for p in problems)
+        assert any("availability" in p for p in problems)
+
+    def test_clear_before_onset_does_not_pair(self):
+        from tools.check_eventlog import check_quality_pairing
+
+        records = self._records(
+            {"event": "DriftCleared", "feature": "x"},
+            {"event": "DriftDetected", "feature": "x", "stat": "psi"},
+        )
+        problems, _ = check_quality_pairing(records)
+        assert len(problems) == 1
+
+
+class TestFederatorServices:
+    def test_bare_list_and_envelope_both_parse(self):
+        svc = [{"name": "r0", "host": "127.0.0.1", "port": 9001}]
+        for body in (json.dumps(svc), json.dumps({"services": svc})):
+            fed = MetricsFederator(
+                "http://reg", fetch=lambda url, t, b=body: b
+            )
+            assert fed.services() == svc
+
+    def test_unreachable_registry_is_empty(self):
+        def boom(url, timeout_s):
+            raise OSError("connection refused")
+
+        assert MetricsFederator("http://reg", fetch=boom).services() == []

@@ -36,6 +36,13 @@ every ``NetworkPartitioned`` onset must be followed by a
 without it) — a partition that never re-forms is a hang the collective
 deadline failed to break.
 
+``--quality`` additionally asserts the model-quality contract: every
+``DriftDetected`` onset must be followed by a ``DriftCleared`` for the
+same feature (keyed per feature — drift on ``input[0]`` is not cleared
+by a recovery on ``input[1]``), and every ``AlertFired`` by an
+``AlertResolved`` for the same alert name. An onset that never recovers
+inside the campaign means the storm outlived its injection window.
+
 Exit status 0 with a one-line summary when the log is clean; 1 with one
 diagnostic per bad line otherwise (CI gates on this; see the
 ``observability`` and ``fleet-chaos`` jobs in .github/workflows/ci.yml).
@@ -236,6 +243,55 @@ def check_partition_pairing(
     return problems, summary
 
 
+def check_quality_pairing(
+    records: typing.List[dict],
+) -> typing.Tuple[typing.List[str], str]:
+    """(problems, summary) for the model-quality contract over a decoded
+    record stream: every DriftDetected onset must be followed by a
+    DriftCleared for the SAME feature, and every AlertFired by an
+    AlertResolved for the SAME alert name. Pairing is keyed, not merely
+    ordered — a clear on another feature does not recover this one."""
+    drift_onsets: typing.List[typing.Tuple[int, dict]] = []
+    drift_clears: typing.List[typing.Tuple[int, str]] = []
+    alert_onsets: typing.List[typing.Tuple[int, dict]] = []
+    alert_clears: typing.List[typing.Tuple[int, str]] = []
+    for i, rec in enumerate(records):
+        kind = rec.get("event")
+        if kind == "DriftDetected":
+            drift_onsets.append((i, rec))
+        elif kind == "DriftCleared":
+            drift_clears.append((i, str(rec.get("feature", ""))))
+        elif kind == "AlertFired":
+            alert_onsets.append((i, rec))
+        elif kind == "AlertResolved":
+            alert_clears.append((i, str(rec.get("alert", ""))))
+    problems = []
+    paired = 0
+    for idx, rec in drift_onsets:
+        feature = str(rec.get("feature", ""))
+        if any(j > idx and f == feature for j, f in drift_clears):
+            paired += 1
+        else:
+            problems.append(
+                f"DriftDetected onset (feature={feature!r}, "
+                f"{rec.get('stat')}={rec.get('value')}) has no subsequent "
+                f"DriftCleared for that feature — drift never recovered"
+            )
+    for idx, rec in alert_onsets:
+        alert = str(rec.get("alert", ""))
+        if any(j > idx and a == alert for j, a in alert_clears):
+            paired += 1
+        else:
+            problems.append(
+                f"AlertFired onset (alert={alert!r}, slo={rec.get('slo')!r}) "
+                f"has no subsequent AlertResolved for that alert — the burn "
+                f"never recovered"
+            )
+    onsets = len(drift_onsets) + len(alert_onsets)
+    summary = f"quality pairing: {paired}/{onsets} onsets paired"
+    return problems, summary
+
+
 def main(argv: typing.Optional[typing.List[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="tools/check_eventlog.py",
@@ -258,6 +314,12 @@ def main(argv: typing.Optional[typing.List[str]] = None) -> int:
         "--partition", action="store_true",
         help="also assert every NetworkPartitioned onset pairs with a "
              "later GroupReformed (the gang recovered)",
+    )
+    parser.add_argument(
+        "--quality", action="store_true",
+        help="also assert every DriftDetected pairs with a later "
+             "DriftCleared (same feature) and every AlertFired with a "
+             "later AlertResolved (same alert)",
     )
     args = parser.parse_args(argv)
     path = args.eventlog
@@ -309,6 +371,12 @@ def main(argv: typing.Optional[typing.List[str]] = None) -> int:
         summaries.append(summary)
     if args.partition:
         problems, summary = check_partition_pairing(valid_records)
+        for p in problems:
+            print(f"{path}: {p}", file=sys.stderr)
+        bad += len(problems)
+        summaries.append(summary)
+    if args.quality:
+        problems, summary = check_quality_pairing(valid_records)
         for p in problems:
             print(f"{path}: {p}", file=sys.stderr)
         bad += len(problems)

@@ -36,6 +36,7 @@ Usage:
 import argparse
 import json
 import os
+import random
 import signal
 import sys
 import tempfile
@@ -51,6 +52,10 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 AFFINE_V1 = {"scale": 2.0, "bias": 0.0, "work_ms": 3.0}
 AFFINE_V2 = {"scale": 3.0, "bias": 1.0, "work_ms": 3.0}
+# latency-storm model for the --quality campaign: same affine map (so the
+# prediction DISTRIBUTION is unchanged and only the input shift can drift)
+# but every micro-batch stalls long enough to burn the p99 budget
+QUALITY_SLOW = {"scale": 2.0, "bias": 0.0, "work_ms": 120.0}
 
 
 def _quantile(sorted_vals, q):
@@ -72,6 +77,10 @@ class LoadClients:
         self.deadline_ms = float(deadline_ms)
         self.payload = payload
         self.phase = "idle"
+        #: covariate-shift knob for the "quality" payload: added to the
+        #: FIRST feature only, so drift must land on input[0] and never
+        #: on input[1]
+        self.shift = 0.0
         self.records = []  # (phase, status, latency_s, input, output)
         self._lock = threading.Lock()
         self._workers = []  # (thread, stop_event)
@@ -103,9 +112,14 @@ class LoadClients:
 
     def _worker(self, stop, worker_id):
         i = 0
+        rng = random.Random(9000 + worker_id)  # per-worker, deterministic
         while not stop.is_set():
-            x = float((worker_id * 7 + i) % 10) if self.payload == "affine" \
-                else (worker_id * 7 + i) % 64
+            if self.payload == "quality":
+                x = [rng.gauss(self.shift, 1.0), rng.gauss(0.0, 1.0)]
+            elif self.payload == "affine":
+                x = float((worker_id * 7 + i) % 10)
+            else:
+                x = (worker_id * 7 + i) % 64
             self._one(x)
             i += 1
 
@@ -482,6 +496,343 @@ def run_campaign(args):
     return 0 if ok else 1
 
 
+def measure_bare_overhead(rows=1 << 20, iters=10, repeats=7):
+    """The <5% ambient-gate guard: time ``PipelineModel.transform`` —
+    which carries the tracing AND quality gates — against the raw stage
+    loop (``ml_transform``) over the same table, and return the overhead
+    in percent. Rounds interleave the two paths and each takes its
+    best-of-``repeats`` so scheduler noise cancels instead of landing on
+    one side. Must run before the campaign exports
+    ``MMLSPARK_TPU_QUALITY_*`` so this process measures the bare,
+    unconfigured posture every production transform pays."""
+    import numpy as np
+
+    from mmlspark_tpu.core.pipeline import (
+        Transformer,
+        make_pipeline_model,
+        ml_transform,
+    )
+    from mmlspark_tpu.data.table import Table
+
+    class _Affine(Transformer):
+        def transform(self, table):
+            x = np.asarray(table.column("input"), dtype=np.float64)
+            return Table({"input": x, "prediction": x * 2.0 + 1.0})
+
+    stage = _Affine()
+    model = make_pipeline_model(stage)
+    table = Table({"input": np.arange(rows, dtype=np.float64)})
+
+    def timed(fn):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            fn()
+        return time.perf_counter() - t0
+
+    ml_transform(table, stage)  # warm both paths
+    model.transform(table)
+    bare = gated = float("inf")
+    for _ in range(repeats):
+        bare = min(bare, timed(lambda: ml_transform(table, stage)))
+        gated = min(gated, timed(lambda: model.transform(table)))
+    return max(0.0, (gated - bare) / bare * 100.0)
+
+
+def run_quality_campaign(args):
+    """Model-quality campaign (CI: quality-chaos): the same real-process
+    fleet, judged by the live quality plane end to end. A fit-time
+    reference profile is committed next to model version 1; every replica
+    installs a QualityMonitor from the inherited environment and sketches
+    its own traffic; the driver runs the multi-window burn-rate
+    AlertEvaluator over federated scrapes (wired into the FleetController
+    as the scale-down advisory). The chaos is a seeded covariate-shift
+    storm on input[0] only, then a latency storm hot-swapped in as a
+    slow model version. Verdicts: drift fires on the shifted feature and
+    never the stable one, onset/recovery events pair up, alerts fire in
+    the storm and resolve after it, the incident bundle carries a drift
+    table, and the bare-transform ambient gate stays under 5%."""
+    from mmlspark_tpu import observability as obs
+    from mmlspark_tpu.observability.alerts import AlertEvaluator
+    from mmlspark_tpu.observability.federation import MetricsFederator
+    from mmlspark_tpu.observability.quality import ReferenceProfile
+    from mmlspark_tpu.observability.registry import get_registry
+    from mmlspark_tpu.observability.slo import (
+        SLOReport,
+        SLOTargets,
+        fleet_summary,
+    )
+    from mmlspark_tpu.runtime.journal import ModelStore
+    from mmlspark_tpu.serving.fleet import FleetController
+    from mmlspark_tpu.serving.replicas import ReplicaSupervisor
+    from mmlspark_tpu.serving.router import FleetRouter
+    from mmlspark_tpu.serving.server import RegistrationService
+
+    seed = int(os.environ.get("MMLSPARK_TPU_FAULT_SEED", str(args.seed)))
+    checks = {}
+
+    overhead_pct = measure_bare_overhead()
+    checks["bare_overhead_under_5pct"] = overhead_pct < 5.0
+    print(f"bare-transform gate overhead: {overhead_pct:.2f}% (budget 5%)")
+
+    workdir = tempfile.mkdtemp(prefix="mmlspark-tpu-quality-")
+    store = ModelStore(os.path.join(workdir, "models"))
+    store.commit(json.dumps(AFFINE_V1), name="model")  # version 1
+
+    # fit-time reference for version 1: both features standard normal,
+    # predictions the committed affine map of them
+    rng = random.Random(seed)
+    ref_rows = [[rng.gauss(0.0, 1.0), rng.gauss(0.0, 1.0)]
+                for _ in range(768)]
+    ref_preds = [
+        [AFFINE_V1["scale"] * a + AFFINE_V1["bias"],
+         AFFINE_V1["scale"] * b + AFFINE_V1["bias"]]
+        for a, b in ref_rows
+    ]
+    ReferenceProfile.capture(
+        "model", 1, {"input": ref_rows, "prediction": ref_preds}
+    ).commit(store)
+
+    # exported BEFORE the supervisor snapshots its spawn environment:
+    # every replica self-installs a monitor against the shared store.
+    # CI-sized window so the campaign turns it over within seconds; the
+    # min-window floor keeps small-sample PSI noise from false-firing.
+    os.environ["MMLSPARK_TPU_QUALITY_STORE"] = os.path.join(workdir, "models")
+    os.environ["MMLSPARK_TPU_QUALITY_MODEL"] = "model"
+    os.environ["MMLSPARK_TPU_QUALITY_WINDOW"] = "256"
+    os.environ["MMLSPARK_TPU_QUALITY_EVAL_EVERY"] = "32"
+    os.environ["MMLSPARK_TPU_QUALITY_MIN_WINDOW"] = "192"
+
+    min_replicas, max_replicas = 2, 3
+    registry_svc = RegistrationService(ttl_s=2.0).start()
+    sup = ReplicaSupervisor(
+        "mmlspark_tpu.serving.fleet:store_model_factory",
+        num_replicas=min_replicas,
+        workdir=os.path.join(workdir, "replicas"),
+        seed=seed,
+        heartbeat_timeout_s=5.0,
+        registry_url=registry_svc.info.url,
+        registry_heartbeat_s=0.2,
+        hot_swap={
+            "loader": "mmlspark_tpu.serving.fleet:store_model_loader",
+            "root": workdir, "name": "model", "poll_s": 0.2,
+        },
+        server_options={
+            "max_batch_size": 8, "max_latency_ms": 1.0,
+            "max_pending": 32, "shed_retry_after_s": 0.05,
+        },
+    )
+    sup.start()
+    deadline = time.monotonic() + 30.0
+    while len(registry_svc.services) < min_replicas:
+        if time.monotonic() > deadline:
+            raise TimeoutError("replicas never registered")
+        time.sleep(0.1)
+
+    router = FleetRouter(
+        registry_url=registry_svc.info.url, policy=args.policy,
+        discovery_interval_s=0.1, hop_timeout_s=2.0,
+    ).start()
+    federator = MetricsFederator(registry_svc.info.url)
+    recorder = obs.get_recorder()
+    if recorder is not None:
+        recorder.federator = federator
+    # the live alerting edge, on CI timescales (2 s / 8 s windows)
+    targets = SLOTargets()
+    evaluator = AlertEvaluator(
+        targets=targets,
+        source=lambda: fleet_summary(federator.scrape()),
+        windows=(2.0, 8.0), threshold=1.0,
+    ).start(interval_s=0.5)
+    controller = FleetController(
+        sup, registry_url=registry_svc.info.url, federator=federator,
+        min_replicas=min_replicas, max_replicas=max_replicas,
+        scale_up_inflight=4.0, scale_down_inflight=0.5,
+        scale_up_shed_rate=4.0, cooldown_s=1.0,
+        down_sustain_s=1.5, interval_s=0.2,
+        alert_advisor=evaluator.active_alerts,
+    ).start()
+
+    clients = LoadClients(router.url, payload="quality")
+
+    def quality_events(kind):
+        return [e for e in obs.merge(event_log_path())
+                if type(e).__name__ == kind]
+
+    def wait_for(predicate, timeout_s, what):
+        stop_at = time.monotonic() + timeout_s
+        while time.monotonic() < stop_at:
+            if predicate():
+                return True
+            time.sleep(0.5)
+        print(f"timeout waiting for {what}")
+        return False
+
+    def all_cleared():
+        det = {e.feature for e in quality_events("DriftDetected")}
+        clr = {e.feature for e in quality_events("DriftCleared")}
+        return bool(det) and det <= clr
+
+    try:
+        # -- warmup: correctness probe, then span the long alert window -----
+        clients.phase = "warmup"
+        status, out = clients._one([1.0, -1.0])
+        assert status == 200, f"warmup request failed: {status}"
+        want = [AFFINE_V1["scale"] * 1.0 + AFFINE_V1["bias"],
+                AFFINE_V1["scale"] * -1.0 + AFFINE_V1["bias"]]
+        assert out == want, f"expected {want}, got {out}"
+        clients.set_concurrency(2)
+        time.sleep(9.0)
+        checks["no_false_drift"] = not quality_events("DriftDetected")
+        checks["no_false_alert"] = not evaluator.active_alerts()
+        print(f"warmup: fleet={sup.live_count}, reply {out}, "
+              f"false drift/alerts: none" if checks["no_false_drift"]
+              else f"warmup: FALSE drift {quality_events('DriftDetected')}")
+
+        # -- shift: seeded covariate storm on input[0] only ------------------
+        clients.phase = "shift"
+        clients.shift = 4.0
+        clients.set_concurrency(4)
+        checks["drift_detected_on_shifted"] = wait_for(
+            lambda: any(e.feature == "input[0]"
+                        for e in quality_events("DriftDetected")),
+            30.0, "DriftDetected(input[0])",
+        )
+        print("shift: drift onsets on "
+              f"{sorted({e.feature for e in quality_events('DriftDetected')})}")
+
+        # -- storm: slow model hot-swapped in burns the latency budget -------
+        clients.phase = "storm"
+        store.commit(json.dumps(QUALITY_SLOW), name="model")  # version 2
+        checks["alert_fired_in_storm"] = wait_for(
+            lambda: "latency" in evaluator.active_alerts(),
+            25.0, "AlertFired(latency)",
+        )
+        print(f"storm: active alerts {evaluator.active_alerts()}")
+
+        # -- recover: fast model back, shift off; every onset must pair ------
+        clients.phase = "recover"
+        store.commit(json.dumps(AFFINE_V1), name="model")  # version 3
+        clients.shift = 0.0
+        checks["alert_resolved"] = (
+            checks["alert_fired_in_storm"]
+            and wait_for(lambda: not evaluator.active_alerts(),
+                         30.0, "AlertResolved")
+        )
+        checks["drift_cleared"] = (
+            checks["drift_detected_on_shifted"]
+            and wait_for(all_cleared, 60.0, "DriftCleared for every onset")
+        )
+        time.sleep(2.0)  # settle: no late re-fire may leave an open pair
+        checks["drift_cleared"] = checks["drift_cleared"] and all_cleared()
+
+        # -- drain -----------------------------------------------------------
+        clients.phase = "drain"
+        clients.set_concurrency(0)
+        time.sleep(1.0)
+    finally:
+        clients.stop()
+        controller.stop()
+        evaluator.stop()
+        router.stop()
+        sup.stop()
+        registry_svc.stop()
+
+    # -- fold ----------------------------------------------------------------
+    merged_path = os.path.join(args.out, "quality-events.jsonl")
+    merged_count = obs.write_merged(event_log_path(), merged_path)
+    events = obs.merge(event_log_path())
+    segments = obs.collect(event_log_path())
+    print(f"quality log: {merged_count} events from "
+          f"{len(segments)} processes -> {merged_path}")
+    report = SLOReport.fold(None, events=events, targets=targets)
+    phases = clients.phase_stats()
+    non_shed_5xx = sum(s["errors_5xx"] for s in phases.values())
+    transport = sum(s["transport"] for s in phases.values())
+    checks["zero_non_shed_5xx"] = non_shed_5xx == 0 and transport == 0
+
+    detected = sorted({
+        e.feature for e in events if type(e).__name__ == "DriftDetected"
+    })
+    checks["no_drift_on_stable"] = not any(
+        f in ("input[1]", "prediction[1]") for f in detected
+    )
+    checks["alert_events_paired"] = (
+        any(type(e).__name__ == "AlertFired" for e in events)
+        and any(type(e).__name__ == "AlertResolved" for e in events)
+    )
+
+    incident_dir = os.environ.get("MMLSPARK_TPU_INCIDENT_DIR", "")
+    bundles = sorted(
+        d for d in (os.listdir(incident_dir) if os.path.isdir(incident_dir)
+                    else [])
+        if not d.startswith(".")
+    )
+    quality_bundles = []
+    for b in bundles:
+        try:
+            with open(os.path.join(incident_dir, b, "quality.json")) as fh:
+                if json.load(fh).get("drift"):
+                    quality_bundles.append(b)
+        except (OSError, ValueError):
+            continue
+    checks["bundle_has_drift_table"] = bool(quality_bundles)
+    print(f"incidents: {len(bundles)} bundle(s), "
+          f"{len(quality_bundles)} with a drift table")
+    ok = all(v for v in checks.values() if v is not None)
+
+    campaign = {
+        "seed": seed,
+        "payload": "quality",
+        "policy": args.policy,
+        "min_replicas": min_replicas,
+        "max_replicas": max_replicas,
+        "bare_overhead_pct": round(overhead_pct, 3),
+        "drifted_features": detected,
+        "active_alerts_at_exit": list(evaluator.active_alerts()),
+        "non_shed_5xx": non_shed_5xx,
+        "router_transport_failures": transport,
+        "merged_events": merged_count,
+        "processes": sorted(segments),
+        "incident_bundles": bundles,
+        "quality_bundles": quality_bundles,
+        "phases": phases,
+        "checks": checks,
+        "ok": ok,
+    }
+
+    os.makedirs(args.out, exist_ok=True)
+    with open(os.path.join(args.out, "slo.json"), "w") as fh:
+        json.dump({"slo": report.to_dict(), "campaign": campaign}, fh,
+                  indent=2, sort_keys=True)
+    md = [
+        f"Model-quality campaign: seed={seed} fleet {min_replicas}"
+        f"..{max_replicas}, shift storm on input[0], "
+        f"latency storm work_ms={QUALITY_SLOW['work_ms']:g}.",
+        "",
+        report.to_markdown(),
+        "",
+        "| check | result |",
+        "|---|---|",
+    ]
+    md += [
+        f"| {name} | {'pass' if v else 'FAIL'} |"
+        for name, v in checks.items() if v is not None
+    ]
+    with open(os.path.join(args.out, "slo.md"), "w") as fh:
+        fh.write("\n".join(md) + "\n")
+    from mmlspark_tpu.observability.history import render_report
+
+    with open(os.path.join(args.out, "report.html"), "w") as fh:
+        fh.write(render_report(
+            events, metrics=get_registry().summary(),
+            title="model-quality chaos campaign",
+        ))
+
+    print("\n".join(md))
+    print(f"\ncampaign {'OK' if ok else 'FAILED'}; artifacts in {args.out}")
+    return 0 if ok else 1
+
+
 def event_log_path():
     return os.environ["MMLSPARK_TPU_EVENT_LOG"]
 
@@ -508,6 +859,10 @@ def main(argv=None):
                              "SLO target for affine, 250 for sar)")
     parser.add_argument("--short", action="store_true",
                         help="CI-sized campaign (~30 s)")
+    parser.add_argument("--quality", action="store_true",
+                        help="model-quality campaign instead: covariate-"
+                             "shift + latency storms judged by the "
+                             "drift/alert plane (CI: quality-chaos)")
     args = parser.parse_args(argv)
     os.makedirs(args.out, exist_ok=True)
     # shared across the router, the controller, and every replica process;
@@ -525,6 +880,8 @@ def main(argv=None):
         "MMLSPARK_TPU_INCIDENT_DIR",
         os.path.abspath(os.path.join(args.out, "incidents")),
     )
+    if args.quality:
+        return run_quality_campaign(args)
     return run_campaign(args)
 
 
