@@ -44,7 +44,7 @@ def _in_parts(ctx: FileContext, parts: Tuple[str, ...]) -> bool:
 
 _CONCURRENT_PARTS = (
     "runtime", "serving", "streaming", "observability", "resilience",
-    "sweep", "lightgbm",
+    "sweep", "lightgbm", "dataguard",
 )
 
 
@@ -410,16 +410,21 @@ _RENAME_ATTRS = {"replace", "rename", "renames"}
 class TmpRenameAtomicityRule(Rule):
     name = "tmp-rename-atomicity"
     description = (
-        "Checkpoint/WAL state in streaming/ and runtime/journal.py must "
-        "be written tmp+rename (_atomic_write): a bare open(path, 'w') or "
-        "write_text leaves a torn file when the process dies mid-write, "
-        "and recovery then reads garbage. Functions that os.replace/"
-        "rename are exempt (they ARE the atomic writer)."
+        "Checkpoint/WAL state in streaming/, dataguard/ and "
+        "runtime/journal.py must be written tmp+rename (_atomic_write): a "
+        "bare open(path, 'w') or write_text leaves a torn file when the "
+        "process dies mid-write, and recovery then reads garbage. "
+        "Functions that os.replace/rename are exempt (they ARE the atomic "
+        "writer)."
     )
 
     def _applies(self, ctx: FileContext) -> bool:
         parts = _path_parts(ctx)
-        return "streaming" in parts or parts[-1] == "journal.py"
+        return (
+            "streaming" in parts
+            or "dataguard" in parts
+            or parts[-1] == "journal.py"
+        )
 
     def check(self, ctx: FileContext) -> Iterator[Violation]:
         if not self._applies(ctx):

@@ -245,14 +245,14 @@ class LockDisciplineRule(Rule):
     description = (
         "No blocking call (thread join, sleep, queue get/put, network I/O) "
         "while holding a threading.Lock/RLock in runtime/, serving/, "
-        "streaming/, observability/, resilience/ or sweep/: the lock "
-        "serializes every heartbeat, reply, epoch-commit, breaker-decision "
-        "and metrics-scrape path behind the wait."
+        "streaming/, observability/, resilience/, sweep/ or dataguard/: "
+        "the lock serializes every heartbeat, reply, epoch-commit, "
+        "breaker-decision and metrics-scrape path behind the wait."
     )
 
     _PATH_PARTS = (
         "runtime", "serving", "streaming", "observability", "resilience",
-        "sweep",
+        "sweep", "dataguard",
     )
     _NETWORK_PREFIXES = (
         "urllib.request.urlopen", "urlopen", "requests.", "socket.",

@@ -127,9 +127,21 @@ class Pipeline(Estimator):
     through — identical semantics to SparkML ``Pipeline.fit``, including the
     up-front ``transformSchema`` pass: :meth:`validate` threads the column
     schema through every stage before anything executes, so a mis-wired
-    graph fails in milliseconds instead of after the first TPU compile."""
+    graph fails in milliseconds instead of after the first TPU compile.
+
+    ``invalidDataPolicy`` arms the dataguard fit guard: with ``"fail"``,
+    ``"drop"`` or ``"impute"``, every float column is scanned for
+    NaN/Inf (and the label column of a classifier stage for domain
+    violations) before any stage runs — see
+    :mod:`mmlspark_tpu.dataguard.guards`. The default ``""`` skips the
+    scan entirely (the pre-dataguard behavior)."""
 
     stages = Param("The chain of pipeline stages", default=[], is_complex=True)
+    invalidDataPolicy = Param(
+        "NaN/Inf/label-domain handling at fit: '' (no scan), 'fail', "
+        "'drop', or 'impute'",
+        default="",
+    )
 
     def validate(self, table_or_schema: Any) -> Dict[str, Any]:
         """Statically propagate a schema (or a Table's schema) through the
@@ -150,9 +162,24 @@ class Pipeline(Estimator):
         self.validate(table)
         bus, tracer = get_bus(), get_tracer()
         fit_id = _next_fit_id()
+        stages = self.getStages()
+        policy = self.getInvalidDataPolicy()
+        if policy:
+            from mmlspark_tpu.dataguard.guards import guard_table
+            from mmlspark_tpu.observability.events import RecordsDeadLettered
+
+            label_col, label_domain = _label_contract(stages)
+            table, report = guard_table(
+                table, policy=policy, label_col=label_col,
+                label_domain=label_domain, name=f"pipeline.fit:{fit_id}",
+            )
+            if report.rows_dropped and bus.active:
+                bus.publish(RecordsDeadLettered(
+                    source="pipeline.fit", epoch=fit_id,
+                    count=report.rows_dropped, reasons=report.summary(),
+                ))
         fitted: List[Transformer] = []
         cur = table
-        stages = self.getStages()
         for i, stage in enumerate(stages):
             name = type(stage).__name__
             if bus.active:
@@ -237,6 +264,26 @@ class PipelineModel(Model):
 
     def transform_schema(self, schema: Dict[str, Any]) -> Dict[str, Any]:
         return _chain_schema(self.getStages(), schema)
+
+
+def _label_contract(stages: List[PipelineStage]) -> tuple:
+    """Best-effort (label column, label domain) for the fit guard: the
+    last estimator stage exposing ``getLabelCol`` names the label, and a
+    class name carrying ``Classifier`` pins the non-negative-integer
+    domain. Unknown graphs guard features only."""
+    label_col, domain = None, None
+    for stage in stages:
+        if not isinstance(stage, Estimator):
+            continue
+        getter = getattr(stage, "getLabelCol", None)
+        if getter is None:
+            continue
+        try:
+            label_col = getter()
+        except (AttributeError, KeyError, ValueError):
+            continue
+        domain = "classifier" if "Classifier" in type(stage).__name__ else None
+    return label_col, domain
 
 
 def _chain_schema(stages: List[PipelineStage], source: Any) -> Dict[str, Any]:

@@ -26,6 +26,17 @@ when present and a mismatch raises
 fault-tolerant scheduler that routes the shard through
 ``Lineage.recompute`` (a fresh read of the source file), so a torn or
 bit-rotted read is retried instead of silently binning garbage.
+
+Corrupt-record read modes (Spark's ``mode`` option — dataguard):
+``ShardedDataset(paths, mode="permissive", bad_records_path=...)``
+quarantines torn/CRC-mismatched/undecodable shards to a dead-letter
+store and streams the survivors in path order (deterministic: a fit
+over the corrupted input is byte-identical to a fit over the clean
+complement); ``dropmalformed`` drops and counts; ``failfast`` (default)
+keeps the raise-on-first-corruption behavior above.
+``ignore_corrupt_files=True`` is the
+``spark.sql.files.ignoreCorruptFiles`` analogue — file-level corruption
+is skipped even under ``failfast``.
 """
 
 from __future__ import annotations
@@ -33,13 +44,32 @@ from __future__ import annotations
 import dataclasses
 import os
 import tempfile
+import zipfile
 import zlib
 from typing import Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from mmlspark_tpu.dataguard.modes import (
+    FAILFAST,
+    PERMISSIVE,
+    BadRecordsError,
+    CorruptRecord,
+    normalize_mode,
+)
 from mmlspark_tpu.lightgbm.binning import BinMapper, apply_bins, fit_bin_mapper
+from mmlspark_tpu.runtime.faults import CorruptShardError, check_record
 from mmlspark_tpu.runtime.lineage import PartitionLostError
+
+#: error classes a corrupt shard file can surface as at decode time
+_CORRUPT_ERRORS = (
+    CorruptShardError,
+    PartitionLostError,
+    zipfile.BadZipFile,
+    ValueError,
+    KeyError,
+    OSError,
+)
 
 
 def _file_crc32(path: str) -> int:
@@ -99,12 +129,38 @@ def _npy_header_shape(fh) -> Tuple[int, ...]:
 
 class ShardedDataset:
     """Lazy view over shard files; at most one shard's float data is
-    resident at a time."""
+    resident at a time.
 
-    def __init__(self, shards: Sequence[str]):
+    ``mode`` is Spark's corrupt-record option (``permissive`` /
+    ``dropmalformed`` / ``failfast``, case-insensitive). Under the
+    non-failfast modes the scan pass verifies every shard *eagerly*
+    (fault gate, CRC sidecar, header decode) so the corrupt set is
+    known before anything is sized over the survivors — row offsets,
+    samples, and memmap extents all see the same deterministic
+    survivor list. ``bad_records_path`` dead-letters the quarantined
+    shards (``permissive`` only); ``ignore_corrupt_files`` skips
+    corrupt files even under ``failfast``, like
+    ``spark.sql.files.ignoreCorruptFiles``.
+    """
+
+    def __init__(
+        self,
+        shards: Sequence[str],
+        mode: str = FAILFAST,
+        bad_records_path: Optional[str] = None,
+        ignore_corrupt_files: bool = False,
+    ):
         if not shards:
             raise ValueError("no shard files given")
         self.paths = list(shards)
+        self.mode = normalize_mode(mode)
+        if ignore_corrupt_files and self.mode == FAILFAST:
+            # ignoreCorruptFiles is file-level tolerance regardless of
+            # mode; a whole-shard quarantine IS the file level here
+            self.mode = "dropmalformed"
+        self.bad_records_path = bad_records_path
+        #: CorruptRecords quarantined by the eager scan (non-failfast)
+        self.quarantined: List[CorruptRecord] = []
         self._infos: Optional[List[ShardInfo]] = None
         self._num_features: Optional[int] = None
 
@@ -139,6 +195,7 @@ class ShardedDataset:
 
     @staticmethod
     def _load(path: str) -> Tuple[np.ndarray, Optional[np.ndarray], Optional[np.ndarray]]:
+        check_record(path)
         _verify_shard(path)
         if path.endswith(".npz"):
             with np.load(path, allow_pickle=False) as z:
@@ -168,6 +225,7 @@ class ShardedDataset:
         stores members uncompressed, so the seek is a file seek, not a
         decompress-and-discard); parquet has no streamable row access and
         falls back to a full decode plus slice."""
+        check_record(path)
         _verify_shard(path)
         lo, hi = int(lo), int(hi)
         if path.endswith(".npy"):
@@ -217,6 +275,10 @@ class ShardedDataset:
         )
 
     def iter_shards(self) -> Iterator[Tuple[np.ndarray, Optional[np.ndarray], Optional[np.ndarray]]]:
+        # scan first: under permissive/dropmalformed the scan prunes
+        # self.paths to the survivor list, so iteration (and everything
+        # built on it — sampling, binning) never touches a corrupt shard
+        self._scan()
         for p in self.paths:
             yield self._load(p)
 
@@ -249,16 +311,51 @@ class ShardedDataset:
         if self._infos is not None:
             return
         infos = []
+        survivors = []
+        bad: List[CorruptRecord] = []
         f = None
         for p in self.paths:
-            info = self._shard_info(p)
+            if self.mode != FAILFAST:
+                # Eager verification: surface torn files / stale CRC
+                # sidecars NOW, so every downstream sizing decision
+                # (row offsets, memmap extent, samples) is computed over
+                # the final survivor list and row order is deterministic.
+                try:
+                    check_record(p)
+                    _verify_shard(p)
+                    info = self._shard_info(p)
+                except _CORRUPT_ERRORS as e:
+                    bad.append(CorruptRecord.from_error(p, e))
+                    continue
+            else:
+                info = self._shard_info(p)
             if f is None:
                 f = info.num_features
             elif info.num_features != f:
+                if self.mode != FAILFAST:
+                    bad.append(CorruptRecord(
+                        source=p, index=-1, reason="feature-count-mismatch",
+                        detail=f"has {info.num_features} features, expected {f}",
+                    ))
+                    continue
                 raise ValueError(
                     f"shard {p} has {info.num_features} features, expected {f}"
                 )
+            survivors.append(p)
             infos.append(info)
+        if bad:
+            self.quarantined = bad
+            self.paths = survivors
+            if not survivors:
+                raise BadRecordsError(
+                    f"all {len(bad)} shard(s) are corrupt", records=bad,
+                )
+            if self.mode == PERMISSIVE and self.bad_records_path:
+                from mmlspark_tpu.dataguard.dlq import DeadLetterStore
+
+                DeadLetterStore(
+                    self.bad_records_path, name="sharded"
+                ).letter(bad)
         # weights must be all-or-none: a missing 'w' in one shard silently
         # training unweighted would be a data-loss bug, not a default
         ws = {i.has_w for i in infos}

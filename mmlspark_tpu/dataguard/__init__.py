@@ -1,0 +1,60 @@
+"""dataguard — the poison-tolerant data plane.
+
+The last failure domain the resilience stack covers: *the data itself*.
+Four pieces, spanning ingest → fit → streaming → serving:
+
+- :mod:`mmlspark_tpu.dataguard.modes` — Spark's corrupt-record read
+  modes (``PERMISSIVE``/``DROPMALFORMED``/``FAILFAST``) consumed by
+  :class:`~mmlspark_tpu.data.sharded.ShardedDataset` and
+  :class:`~mmlspark_tpu.streaming.source.FileStreamSource`;
+- :mod:`mmlspark_tpu.dataguard.dlq` — the epoch-keyed, CRC-sidecar'd
+  dead-letter store (``badRecordsPath`` with a replay API and
+  exactly-once semantics under the streaming WAL);
+- :mod:`mmlspark_tpu.dataguard.guards` — NaN/Inf/label-domain fit
+  guards with fail/drop/impute policies (``Pipeline.setInvalidDataPolicy``);
+- :mod:`mmlspark_tpu.dataguard.requestguard` — serving-edge request
+  validation and the per-client malformed-rate breaker.
+
+Chaos coverage: ``FaultPlan.corrupt_record`` / ``truncate_shard`` /
+``malformed_request`` (:mod:`mmlspark_tpu.runtime.faults`), the CI
+corruption storm in ``tools/data_chaos_smoke.py``, and the
+``--malformed`` loadgen phase. Cookbook: docs/resilience.md "Bad data".
+"""
+
+from mmlspark_tpu.dataguard.dlq import DeadLetterStore
+from mmlspark_tpu.dataguard.guards import (
+    GuardReport,
+    guard_arrays,
+    guard_table,
+    normalize_policy,
+)
+from mmlspark_tpu.dataguard.modes import (
+    DROPMALFORMED,
+    FAILFAST,
+    PERMISSIVE,
+    BadRecordsError,
+    CorruptRecord,
+    normalize_mode,
+    summarize_reasons,
+)
+from mmlspark_tpu.dataguard.requestguard import (
+    MalformedRateBreaker,
+    RequestValidator,
+)
+
+__all__ = [
+    "PERMISSIVE",
+    "DROPMALFORMED",
+    "FAILFAST",
+    "normalize_mode",
+    "BadRecordsError",
+    "CorruptRecord",
+    "summarize_reasons",
+    "DeadLetterStore",
+    "GuardReport",
+    "guard_arrays",
+    "guard_table",
+    "normalize_policy",
+    "RequestValidator",
+    "MalformedRateBreaker",
+]

@@ -1,0 +1,242 @@
+"""Serving-edge request validation and the poison-client breaker.
+
+Two pieces, both consulted by the HTTP handler *before* a request is
+enqueued for the batch loop:
+
+- :class:`RequestValidator` — structural validation of the decoded
+  payload against what the model's ``transform_schema`` admits: the
+  input column must be present, element types must be numeric-or-text,
+  numeric values must be finite, and (when the model's feature width is
+  known) vectors must match it. A failing payload becomes a structured
+  400 at the edge instead of an exception inside the batch loop, where
+  it would poison every co-batched request.
+
+- :class:`MalformedRateBreaker` — a per-client rolling-window counter.
+  A client whose malformed-request rate crosses the threshold is shed
+  with 429s for ``reset_s`` (the body is still drained so keep-alive
+  survives); healthy clients on the same replica are unaffected, and —
+  unlike the replica :class:`~mmlspark_tpu.resilience.breaker.CircuitBreaker`,
+  which counts 408/5xx — 400s never trip fleet routing away from a
+  healthy replica that happens to face a poison flood.
+
+Both take injectable clocks; events are published outside locks (the
+graftlint lock-discipline rule covers ``dataguard/``).
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Deque, Dict, Optional, Tuple
+
+from mmlspark_tpu.core.profiling import get_logger
+
+logger = get_logger("mmlspark_tpu.dataguard")
+
+#: (kind, detail) tuple describing why a payload was rejected
+Rejection = Tuple[str, str]
+
+
+def _check_numbers(value: Any, path: str) -> Optional[Rejection]:
+    """Recursively reject None / non-finite numbers inside a payload
+    element. Strings and bools pass through (text models take strings)."""
+    if value is None:
+        return ("null-value", f"{path} is null")
+    if isinstance(value, bool):
+        return None
+    if isinstance(value, (int, float)):
+        if not math.isfinite(value):
+            return ("non-finite-value", f"{path} is {value!r}")
+        return None
+    if isinstance(value, (list, tuple)):
+        for i, item in enumerate(value):
+            bad = _check_numbers(item, f"{path}[{i}]")
+            if bad is not None:
+                return bad
+        return None
+    if isinstance(value, dict):
+        return ("invalid-type", f"{path} is an object, expected scalar/array")
+    return None  # strings and anything exotic: the model's problem
+
+
+class RequestValidator:
+    """Structural pre-admission validation for one serving endpoint.
+
+    ``width`` pins the expected feature-vector length when known (see
+    :meth:`for_model`); ``None`` skips the shape check. ``enabled=False``
+    turns the validator into a pass-through (the pre-dataguard edge).
+    """
+
+    def __init__(
+        self,
+        input_col: str = "input",
+        width: Optional[int] = None,
+        enabled: bool = True,
+    ):
+        self.input_col = input_col
+        self.width = int(width) if width else None
+        self.enabled = enabled
+
+    @classmethod
+    def for_model(cls, model: Any, input_col: str = "input") -> "RequestValidator":
+        """Best-effort width inference from the model: booster feature
+        count or an explicit ``num_features``. Unknown models validate
+        structure only — inference must never block serving startup."""
+        width: Optional[int] = None
+        for probe in (
+            lambda m: m.num_features,
+            lambda m: m.booster.num_features,
+            lambda m: m.getNumFeatures(),
+        ):
+            try:
+                got = probe(model)
+                if got:
+                    width = int(got)
+                    break
+            except Exception:  # noqa: BLE001 - probing, any failure means "unknown"
+                continue
+        return cls(input_col=input_col, width=width)
+
+    def check_payload(self, payload: Any) -> Optional[Rejection]:
+        """Validate a decoded JSON payload (the whole request body).
+        Returns None when admissible, else a (kind, detail) rejection."""
+        if not self.enabled:
+            return None
+        if payload is None:
+            return ("empty-payload", "request body is empty")
+        if isinstance(payload, dict) and self.input_col not in payload:
+            return (
+                "missing-input-col",
+                f"payload object lacks required key {self.input_col!r}",
+            )
+        value = payload[self.input_col] if isinstance(payload, dict) else payload
+        return self.check_value(value)
+
+    def check_value(self, value: Any) -> Optional[Rejection]:
+        """Validate the unwrapped input value itself."""
+        if not self.enabled:
+            return None
+        bad = _check_numbers(value, self.input_col)
+        if bad is not None:
+            return bad
+        if self.width is not None and isinstance(value, (list, tuple)):
+            rows = value if value and isinstance(value[0], (list, tuple)) else [value]
+            for i, row in enumerate(rows):
+                if isinstance(row, (list, tuple)) and len(row) != self.width:
+                    return (
+                        "shape-mismatch",
+                        f"{self.input_col}[{i}] has {len(row)} feature(s), "
+                        f"model expects {self.width}",
+                    )
+        return None
+
+
+class MalformedRateBreaker:
+    """Per-client malformed-request breaker with a rolling window.
+
+    ``record_malformed(client)`` books one malformed request; once a
+    client accumulates ``threshold`` of them within ``window_s`` it is
+    blocked for ``reset_s`` (checked by ``blocked(client)``), then
+    released on its next probe. Trips publish
+    :class:`~mmlspark_tpu.observability.events.PoisonClientBlocked`,
+    releases :class:`~mmlspark_tpu.observability.events.PoisonClientReleased`
+    — both outside the lock.
+    """
+
+    def __init__(
+        self,
+        threshold: int = 16,
+        window_s: float = 5.0,
+        reset_s: float = 2.0,
+        clock: Callable[[], float] = time.monotonic,
+        registry=None,
+    ):
+        self.threshold = max(1, int(threshold))
+        self.window_s = float(window_s)
+        self.reset_s = float(reset_s)
+        self.clock = clock
+        self._lock = threading.Lock()
+        self._events: Dict[str, Deque[float]] = {}
+        self._blocked_at: Dict[str, float] = {}
+        if registry is None:
+            from mmlspark_tpu.observability.registry import get_registry
+
+            registry = get_registry()
+        self._reg_malformed = registry.counter(
+            "dataguard_malformed_requests_total",
+            "Malformed serving requests rejected before admission",
+        )
+        self._reg_shed = registry.counter(
+            "dataguard_poison_shed_total",
+            "Requests shed because the client tripped the malformed-rate breaker",
+        )
+
+    def record_malformed(self, client: str, kind: str = "unknown") -> bool:
+        """Book one malformed request for ``client``; returns True when
+        this request tripped the breaker (client newly blocked)."""
+        self._reg_malformed.labels(kind=kind).inc()
+        now = self.clock()
+        tripped = False
+        with self._lock:
+            dq = self._events.setdefault(client, deque())
+            dq.append(now)
+            while dq and dq[0] < now - self.window_s:
+                dq.popleft()
+            if len(dq) >= self.threshold and client not in self._blocked_at:
+                self._blocked_at[client] = now
+                dq.clear()
+                tripped = True
+        if tripped:
+            self._publish_tripped(client)
+        return tripped
+
+    def blocked(self, client: str) -> bool:
+        """True while ``client`` is being shed; releases (and publishes)
+        once ``reset_s`` has elapsed since the trip."""
+        now = self.clock()
+        released_after: Optional[float] = None
+        with self._lock:
+            at = self._blocked_at.get(client)
+            if at is None:
+                return False
+            if now - at < self.reset_s:
+                blocked = True
+            else:
+                del self._blocked_at[client]
+                released_after = now - at
+                blocked = False
+        if released_after is not None:
+            self._publish_released(client, released_after)
+        return blocked
+
+    def note_shed(self, client: str) -> None:
+        """Book one request shed while blocked (metrics only)."""
+        self._reg_shed.labels(client=client).inc()
+
+    # -- events (always outside the lock) ------------------------------------
+
+    def _publish_tripped(self, client: str) -> None:
+        from mmlspark_tpu.observability.events import PoisonClientBlocked, get_bus
+
+        bus = get_bus()
+        if bus.active:
+            bus.publish(PoisonClientBlocked(
+                client=client, malformed=self.threshold,
+                window_s=self.window_s,
+            ))
+        logger.warning(
+            "poison breaker: client %s blocked (%d malformed in %.1fs)",
+            client, self.threshold, self.window_s,
+        )
+
+    def _publish_released(self, client: str, blocked_s: float) -> None:
+        from mmlspark_tpu.observability.events import PoisonClientReleased, get_bus
+
+        bus = get_bus()
+        if bus.active:
+            bus.publish(PoisonClientReleased(client=client, blocked_s=blocked_s))
+        logger.info(
+            "poison breaker: client %s released after %.2fs", client, blocked_s
+        )

@@ -700,6 +700,44 @@ class RequestShed(Event):
     rid: str = ""
 
 
+# -- dataguard ---------------------------------------------------------------
+
+
+@_event
+class RecordsDeadLettered(Event):
+    """A read under ``mode=permissive`` (or a ``drop``-policy fit guard)
+    quarantined ``count`` corrupt records into the dead-letter store for
+    ``source`` under ``epoch``. Exactly one event per committed epoch —
+    a replayed streaming epoch finds its DLQ manifest already present
+    and publishes nothing (``check_eventlog.py --dataguard`` enforces
+    the no-duplicate invariant)."""
+
+    source: str
+    epoch: int
+    count: int
+    reasons: str = ""
+
+
+@_event
+class PoisonClientBlocked(Event):
+    """The per-client malformed-rate breaker tripped: ``client`` sent
+    ``malformed`` malformed requests inside ``window_s`` seconds and is
+    now shed with 429s. Pairs with a later :class:`PoisonClientReleased`."""
+
+    client: str
+    malformed: int
+    window_s: float
+
+
+@_event
+class PoisonClientReleased(Event):
+    """The poison breaker released ``client`` after ``blocked_s`` seconds
+    — the recovery edge of :class:`PoisonClientBlocked`."""
+
+    client: str
+    blocked_s: float
+
+
 # -- bus ---------------------------------------------------------------------
 
 

@@ -97,6 +97,26 @@ the class of fault the pressure watchdog and degradation ladders
   FlightRecorder) consults :func:`check_write` first, so the injection
   lands at the exact byte-never-written point of each plane.
 
+The data plane (``mmlspark_tpu.dataguard``) injects *poison* instead of
+failures — the bytes arrive, but they are wrong, the class of fault the
+corrupt-record read modes and dead-letter store exist to absorb:
+
+- ``truncate_shard(substr, n)`` — the next ``n`` guarded shard reads
+  whose path contains ``substr`` see a torn file: the reader's gate
+  (:func:`check_record`) raises :class:`CorruptShardError` at the exact
+  point a truncated npz/CRC-mismatched sidecar would surface, so under
+  ``mode=permissive`` the whole shard quarantines and under
+  ``failfast`` the read dies like before;
+- ``corrupt_record(substr, index, n)`` — record ``index`` of a matching
+  jsonl/json source is garbled in flight (:func:`corrupt_record_bytes`
+  flips its bytes after the file read), exercising the *per-record*
+  quarantine path rather than the whole-file one;
+- ``malformed_request(n, kind)`` — loadgen's ``--malformed`` phase pops
+  these (:meth:`FaultPlan.take_malformed`) to emit seeded poison
+  payloads (``"json"`` garbage bytes, ``"schema"`` wrong-width vectors,
+  ``"nan"`` non-finite features) against a serving endpoint, proving
+  the edge 400s them and the poison breaker sheds the flood.
+
 Each registered fault fires at most once; ``plan.fired`` records what
 actually triggered, so tests assert the fault happened AND was survived.
 ``kill_random_task`` draws its victim from the plan's seeded RNG — the
@@ -125,6 +145,14 @@ class DeviceOomError(RuntimeError):
     ``RESOURCE_EXHAUSTED`` marker XLA's allocator uses, so every catch
     site that classifies by :func:`is_oom_error` treats an injected
     device OOM exactly like the real ``XlaRuntimeError``."""
+
+
+class CorruptShardError(RuntimeError):
+    """Simulated shard corruption: the guarded read gate
+    (:func:`check_record`) raises this where a torn npz / stale CRC
+    sidecar would surface, so read-mode handling is exercised at the
+    real catch site (``PartitionLostError`` and decode errors take the
+    same permissive/dropmalformed/failfast paths)."""
 
 
 class FaultPlan:
@@ -163,6 +191,14 @@ class FaultPlan:
         #: ordered disk-full directives, consumed first-match per write
         self._disk_full: List[dict] = []
         self._write_seq = 0
+        #: ordered torn-shard directives, consumed first-match per read
+        self._truncate: List[dict] = []
+        #: ordered per-record corruption directives (jsonl/json sources)
+        self._corrupt_record: List[dict] = []
+        self._record_seq = 0
+        #: ordered malformed-request directives, popped by loadgen
+        self._malformed: List[dict] = []
+        self._malformed_seq = 0
         self._lock = threading.Lock()
         #: [(kind, task_index, attempt)] in fire order
         self.fired: List[Tuple[str, int, int]] = []
@@ -512,6 +548,112 @@ class FaultPlan:
         self._disk_full.append({"substr": str(path_substr), "n": int(count)})
         return self
 
+    def truncate_shard(self, path_substr: str, count: int = 1) -> "FaultPlan":
+        """The next ``count`` guarded shard reads whose path contains
+        ``path_substr`` raise :class:`CorruptShardError` — a torn file /
+        stale CRC sidecar, surfaced at the read gate
+        (:func:`check_record`) before any byte is decoded. Under
+        ``mode=permissive`` the shard quarantines to the dead-letter
+        store; under ``failfast`` the read dies exactly like a real
+        ``PartitionLostError``."""
+        self._truncate.append({"substr": str(path_substr), "n": int(count)})
+        return self
+
+    def corrupt_record(
+        self, path_substr: str, index: int = 0, count: int = 1
+    ) -> "FaultPlan":
+        """Record ``index`` of the next ``count`` matching record-oriented
+        sources (jsonl/json) is garbled after the file read
+        (:func:`corrupt_record_bytes` flips its bytes), so the decode
+        fails for *that record only* — the per-record quarantine path,
+        as opposed to :meth:`truncate_shard`'s whole-file path."""
+        self._corrupt_record.append({
+            "substr": str(path_substr), "index": int(index), "n": int(count),
+        })
+        return self
+
+    def malformed_request(self, count: int = 1, kind: str = "json") -> "FaultPlan":
+        """Loadgen's ``--malformed`` phase pops the next directive per
+        poison request (:meth:`take_malformed`) and emits the matching
+        payload class: ``"json"`` (undecodable bytes), ``"schema"``
+        (wrong-width feature vector), ``"nan"`` (non-finite features).
+        The serving edge must answer structured 400s and the per-client
+        breaker must shed the flood — never a batch-loop exception."""
+        if kind not in ("json", "schema", "nan"):
+            raise ValueError(
+                f"unknown malformed-request kind {kind!r} "
+                "(expected 'json', 'schema' or 'nan')"
+            )
+        self._malformed.append({"kind": str(kind), "n": int(count)})
+        return self
+
+    def apply_on_record(self, path: str) -> None:
+        """Pop the first registered ``truncate_shard`` directive matching
+        ``path`` and raise :class:`CorruptShardError`. Called by shard
+        readers (via :func:`check_record`) right before decoding a file,
+        so the injected corruption surfaces exactly where a real torn
+        file would. Directives are consumed in order, one per read."""
+        with self._lock:
+            matched = None
+            for d in self._truncate:
+                if d["n"] > 0 and d["substr"] in str(path):
+                    d["n"] -= 1
+                    matched = d
+                    break
+            if matched is None:
+                return
+            self._truncate = [d for d in self._truncate if d["n"] > 0]
+            seq = self._record_seq
+            self._record_seq += 1
+        self.fired.append(("truncate_shard", seq, 0))
+        raise CorruptShardError(
+            f"truncated shard (injected): {path}"
+        )
+
+    def apply_on_record_bytes(self, path: str, index: int, data: bytes) -> bytes:
+        """Pop the first registered ``corrupt_record`` directive matching
+        (``path``, ``index``) and return a garbled copy of ``data`` (the
+        raw bytes of that one record); unmatched reads get ``data`` back
+        untouched. Called by record-oriented loaders per record."""
+        with self._lock:
+            matched = None
+            for d in self._corrupt_record:
+                if (
+                    d["n"] > 0 and d["substr"] in str(path)
+                    and d["index"] == int(index)
+                ):
+                    d["n"] -= 1
+                    matched = d
+                    break
+            if matched is None:
+                return data
+            self._corrupt_record = [
+                d for d in self._corrupt_record if d["n"] > 0
+            ]
+        self.fired.append(("corrupt_record", int(index), 0))
+        # Prefix with bytes no JSON decoder accepts, keeping the original
+        # visible for debugging quarantined records.
+        return b"\xff\xfe<corrupt>" + bytes(data)
+
+    def take_malformed(self) -> Optional[str]:
+        """Pop one malformed-request directive and return its kind
+        (``"json"``/``"schema"``/``"nan"``), or None when the storm is
+        exhausted. Booked in ``fired`` as ``("malformed_request", seq, 0)``."""
+        with self._lock:
+            directive = None
+            for d in self._malformed:
+                if d["n"] > 0:
+                    d["n"] -= 1
+                    directive = d
+                    break
+            if directive is None:
+                return None
+            self._malformed = [d for d in self._malformed if d["n"] > 0]
+            seq = self._malformed_seq
+            self._malformed_seq += 1
+        self.fired.append(("malformed_request", seq, 0))
+        return directive["kind"]
+
     def will_corrupt(self, index: int, attempt: int) -> bool:
         """True while a ``corrupt_result`` fault is registered for this
         (task, attempt) — the executor checks this to know it must take
@@ -529,6 +671,9 @@ class FaultPlan:
                 + sum(d["n"] for d in self._http)
                 + len(self._oom)
                 + sum(d["n"] for d in self._disk_full)
+                + sum(d["n"] for d in self._truncate)
+                + sum(d["n"] for d in self._corrupt_record)
+                + sum(d["n"] for d in self._malformed)
                 + sum(
                     d["n"] if d["target"] == "http" else 1
                     for d in self._net
@@ -735,6 +880,30 @@ def check_write(path: str) -> None:
     plan = current_faults()
     if plan is not None:
         plan.apply_on_write(path)
+
+
+def check_record(path: str) -> None:
+    """Guarded-read gate: shard/file readers call this with the source
+    path before decoding it. Raises :class:`CorruptShardError` when the
+    ambient plan holds a matching :meth:`FaultPlan.truncate_shard`
+    directive; no-op otherwise. The raise lands where a real torn file
+    would, so read-mode handling (permissive quarantine vs failfast
+    death) is exercised at the genuine catch site."""
+    plan = current_faults()
+    if plan is not None:
+        plan.apply_on_record(path)
+
+
+def corrupt_record_bytes(path: str, index: int, data: bytes) -> bytes:
+    """Per-record corruption gate: record-oriented loaders (jsonl/json)
+    pass each record's raw bytes through here after the file read. A
+    matching :meth:`FaultPlan.corrupt_record` directive garbles the
+    bytes so only that record fails to decode; otherwise ``data`` is
+    returned untouched."""
+    plan = current_faults()
+    if plan is None:
+        return data
+    return plan.apply_on_record_bytes(path, index, data)
 
 
 def check_net(url: str) -> Optional[dict]:

@@ -457,6 +457,14 @@ class FleetRouter:
             extra.update(trace.to_headers())
         elif headers.get(TRACE_HEADER):
             extra[TRACE_HEADER] = headers[TRACE_HEADER]
+        # the per-client identity must survive the proxy hop: the replica's
+        # malformed-rate breaker keys on X-Client-Id, and without it every
+        # routed request would collapse onto the router's address — one
+        # poison client would shed the whole fleet's healthy traffic
+        for k, v in headers.items():
+            if k.lower() == "x-client-id":
+                extra["X-Client-Id"] = v
+                break
         if deadline is not None:
             # forward the REMAINING budget; never wait on the socket
             # longer than the caller will wait for us

@@ -51,6 +51,7 @@ from mmlspark_tpu.observability.events import (
     LeaseRecovered,
     ModelSwapped,
     RequestServed,
+    RequestShed,
     get_bus,
 )
 from mmlspark_tpu.observability.profiler import get_profiler
@@ -606,17 +607,103 @@ class _ListenerMixin:
                     if admission is not None:
                         admission.release()
 
+            def _client_id(self) -> str:
+                """Poison-breaker key: an explicit X-Client-Id beats the
+                peer address (routers/proxies collapse many clients onto
+                one address; the header keeps the breaker per-tenant)."""
+                return (
+                    self.headers.get("X-Client-Id")
+                    or self.client_address[0]
+                )
+
+            def _reject(self, span, rid: str, client: str,
+                        kind: str, detail: str) -> None:
+                """Answer a malformed request with a structured 400 that
+                still carries the trace id, book it against the client's
+                malformed-rate budget, and keep it OUT of the batch loop
+                (a bad payload must never poison co-batched requests)."""
+                tracer = get_tracer()
+                breaker = server.malformed_breaker
+                if breaker is not None:
+                    breaker.record_malformed(client, kind=kind)
+                data = json.dumps({
+                    "error": {"kind": kind, "detail": detail, "rid": rid},
+                }).encode()
+                try:
+                    self._reply_bytes(
+                        400, data,
+                        extra_headers={TRACE_HEADER: span.trace_id},
+                    )
+                except OSError:
+                    tracer.finish(span, status="disconnect")
+                    return
+                tracer.finish(span, status="400")
+                bus = get_bus()
+                if bus.active:
+                    bus.publish(RequestServed(
+                        rid=rid, status=400, latency=0.0,
+                        trace_id=span.trace_id,
+                    ))
+
             def _handle_admitted(self) -> None:
+                rid = uuid.uuid4().hex
+                tracer = get_tracer()
+                # the span opens BEFORE the body is parsed: every answer —
+                # including a malformed-payload 400 — carries X-Trace-Id,
+                # so a client can always hand support a correlatable id
+                #
+                # listener threads carry no ambient span; a wire-propagated
+                # TraceContext (the router's hop) is adopted so this
+                # request->batch->apply chain parents under the router's
+                # span in the merged fleet trace — otherwise the request
+                # mints the trace root itself
+                span = tracer.start_span(
+                    "serving.request", rid=rid,
+                    context=TraceContext.from_headers(self.headers),
+                )
+                client = self._client_id()
+                # body is ALWAYS read before any reply — a keep-alive
+                # connection with an unconsumed body desyncs on the next
+                # request — so even the poison-shed path drains it first
                 length = int(self.headers.get("Content-Length", 0))
                 body = self.rfile.read(length)
+                breaker = server.malformed_breaker
+                if breaker is not None and breaker.blocked(client):
+                    breaker.note_shed(client)
+                    retry_after = f"{breaker.reset_s:g}"
+                    self._reply_bytes(
+                        429, json.dumps({
+                            "error": {"kind": "malformed-rate",
+                                      "detail": "client shed by the poison "
+                                                "breaker", "rid": rid},
+                        }).encode(),
+                        extra_headers={
+                            "Retry-After": retry_after,
+                            TRACE_HEADER: span.trace_id,
+                        },
+                    )
+                    tracer.finish(span, status="429")
+                    bus = get_bus()
+                    if bus.active:
+                        bus.publish(RequestShed(
+                            reason="malformed_rate", queue_depth=0,
+                            retry_after=breaker.reset_s, rid=rid,
+                        ))
+                    return
                 try:
                     payload = json.loads(body) if body else None
-                except json.JSONDecodeError:
-                    self._reply_bytes(400, b'{"error": "invalid json"}')
+                except json.JSONDecodeError as e:
+                    self._reject(span, rid, client, "invalid-json", str(e))
                     return
+                validator = server.request_validator
+                if validator is not None:
+                    rejection = validator.check_payload(payload)
+                    if rejection is not None:
+                        self._reject(span, rid, client, *rejection)
+                        return
                 if isinstance(payload, dict) and input_col in payload:
                     payload = payload[input_col]
-                req = _PendingRequest(rid=uuid.uuid4().hex, payload=payload)
+                req = _PendingRequest(rid=rid, payload=payload)
                 # deadline propagation: a caller-supplied X-Deadline-Ms wins;
                 # otherwise the server's default request budget (if any)
                 req.deadline = Deadline.from_header(
@@ -624,16 +711,6 @@ class _ListenerMixin:
                 )
                 if req.deadline is None and server.request_deadline_s:
                     req.deadline = Deadline.after(server.request_deadline_s)
-                tracer = get_tracer()
-                # listener threads carry no ambient span; a wire-propagated
-                # TraceContext (the router's hop) is adopted so this
-                # request->batch->apply chain parents under the router's
-                # span in the merged fleet trace — otherwise the request
-                # mints the trace root itself
-                span = tracer.start_span(
-                    "serving.request", rid=req.rid,
-                    context=TraceContext.from_headers(self.headers),
-                )
                 req.span, req.trace_id = span, span.trace_id
                 loop.submit(req)
                 wait_s = server.reply_timeout_s
@@ -703,7 +780,17 @@ class ServingServer(_ListenerMixin):
         shed_retry_after_s: float = 1.0,
         request_deadline_s: Optional[float] = None,
         drain_timeout_s: float = 5.0,
+        request_validator: Any = None,
+        malformed_breaker: Any = None,
+        malformed_threshold: int = 16,
+        malformed_window_s: float = 5.0,
+        malformed_reset_s: float = 2.0,
     ):
+        from mmlspark_tpu.dataguard.requestguard import (
+            MalformedRateBreaker,
+            RequestValidator,
+        )
+
         self.input_col = input_col
         self.output_col = output_col
         self.name = name
@@ -715,6 +802,23 @@ class ServingServer(_ListenerMixin):
         #: default per-request budget when the caller sends no X-Deadline-Ms
         self.request_deadline_s = request_deadline_s
         self.drain_timeout_s = float(drain_timeout_s)
+        # pre-admission hardening (dataguard): payloads are validated
+        # against the model's input contract before they can reach the
+        # batch loop, and clients flooding malformed requests are shed
+        # per-client — pass request_validator="off" to disable, or an
+        # explicit RequestValidator to pin the contract
+        if request_validator == "off":
+            self.request_validator = None
+        elif request_validator is None:
+            self.request_validator = RequestValidator.for_model(
+                model, input_col=input_col
+            )
+        else:
+            self.request_validator = request_validator
+        self.malformed_breaker = malformed_breaker or MalformedRateBreaker(
+            threshold=malformed_threshold, window_s=malformed_window_s,
+            reset_s=malformed_reset_s, registry=registry,
+        )
         self.loop = loop or _BatchLoop(
             model, input_col, output_col, max_batch_size, max_latency_ms,
             max_retries, registry=registry,
@@ -1241,6 +1345,12 @@ class DistributedServingServer:
                 name=name,
             ),
         )
+        # ONE poison breaker too: a flooding client spraying its malformed
+        # requests across listeners must still accumulate into one budget
+        if "malformed_breaker" not in kwargs:
+            from mmlspark_tpu.dataguard.requestguard import MalformedRateBreaker
+
+            kwargs["malformed_breaker"] = MalformedRateBreaker()
         # base_port > 0: listeners bind base_port, base_port+1, ... (the
         # deployable layout — k8s Services need declared ports); 0 keeps
         # OS-assigned ephemeral ports for tests.

@@ -9,6 +9,10 @@ logs the commit. The two logs live under the checkpoint location:
                                           {"epoch", "start", "end", "manifest"}
     <checkpoint>/commits/<epoch>.json   — written AFTER the sink returns:
                                           {"epoch", "start", "end", "rows"}
+    <checkpoint>/deadletter/            — epoch-keyed dead-letter store for
+                                          records quarantined by a
+                                          permissive/dropmalformed source
+                                          (see mmlspark_tpu.dataguard.dlq)
 
 Restart contract (the ``checkpointLocation`` semantics):
 
@@ -136,9 +140,19 @@ class StreamingQuery:
         self._reg_offset = reg.gauge(
             "streaming_offset", "Committed source offset"
         ).labels(**labels)
+        #: dead-letter store for source quarantines (checkpointed only):
+        #: epoch-keyed under the WAL epoch, so a replayed epoch that
+        #: re-quarantines the same corrupt records letters them once
+        self.dead_letters = None
         if self.checkpoint_dir is not None:
             os.makedirs(os.path.join(self.checkpoint_dir, "offsets"), exist_ok=True)
             os.makedirs(os.path.join(self.checkpoint_dir, "commits"), exist_ok=True)
+            from mmlspark_tpu.dataguard.dlq import DeadLetterStore
+
+            self.dead_letters = DeadLetterStore(
+                os.path.join(self.checkpoint_dir, "deadletter"),
+                name=name, registry=reg,
+            )
             self._restore()
 
     # -- checkpoint ----------------------------------------------------------
@@ -265,6 +279,12 @@ class StreamingQuery:
             ))
         self._maybe_die(epoch, "post_wal")
         table = self.source.load_batch(manifest)
+        quarantined = list(getattr(self.source, "last_quarantined", ()))
+        if quarantined and self.dead_letters is not None:
+            # Before the sink, after the WAL: a pre_commit SIGKILL replays
+            # the epoch, re-quarantines the same records, and commit_epoch
+            # finds the manifest already present — exactly-once either way.
+            self.dead_letters.commit_epoch(epoch, quarantined)
         self.sink.process_batch(epoch, table)
         self._maybe_die(epoch, "pre_commit")
         rows = table.num_rows

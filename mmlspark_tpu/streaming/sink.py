@@ -180,6 +180,17 @@ class ModelCommitSink(Sink):
                 epoch,
             )
             return self._ensure_store(epoch, self._committed[epoch])
+        if table.num_rows == 0:
+            # every record of the epoch quarantined (permissive source over
+            # a fully-corrupt batch): fitting zero rows would either fail
+            # or commit a spurious ensemble delta — skip, so the model
+            # stays byte-identical to a fit over the clean complement
+            logger.info(
+                "streaming sink: epoch %d has no surviving rows; skipping fit",
+                epoch,
+            )
+            latest = self.store.latest(self.name)
+            return latest[0] if latest is not None else -1
         merged_text = self._fit_epoch(epoch, table)
         self._journal.record(epoch, merged_text)
         self._committed[epoch] = merged_text

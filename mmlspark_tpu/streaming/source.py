@@ -23,6 +23,17 @@ contract for the TPU framework:
 ``max_per_trigger`` is the ``maxFilesPerTrigger`` rate limit: the query
 caps each epoch at that many new units so a backlog drains as several
 bounded micro-batches instead of one giant one.
+
+Corrupt-record read modes (dataguard):
+``FileStreamSource(..., mode="permissive")`` turns a torn npz, a stale
+CRC sidecar, or an undecodable jsonl line into a quarantine instead of
+an epoch-killing exception — whole-file failures quarantine the file
+(``index`` -1), jsonl decode failures quarantine the single line and
+keep the rest. The quarantines of the most recent ``load_batch`` are
+exposed as ``last_quarantined``, which
+:class:`~mmlspark_tpu.streaming.query.StreamingQuery` commits to the
+epoch-keyed dead-letter store under its WAL. ``dropmalformed`` drops
+and counts; ``failfast`` (default) re-raises like before.
 """
 
 from __future__ import annotations
@@ -30,14 +41,36 @@ from __future__ import annotations
 import fnmatch
 import json
 import os
+import zipfile
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
 from mmlspark_tpu.core.profiling import get_logger
 from mmlspark_tpu.data.table import Table
+from mmlspark_tpu.dataguard.modes import (
+    FAILFAST,
+    CorruptRecord,
+    normalize_mode,
+)
+from mmlspark_tpu.runtime.faults import (
+    CorruptShardError,
+    check_record,
+    corrupt_record_bytes,
+)
+from mmlspark_tpu.runtime.lineage import PartitionLostError
 
 logger = get_logger("mmlspark_tpu.streaming")
+
+#: error classes a corrupt stream file can surface as at decode time
+_RECORD_ERRORS = (
+    CorruptShardError,
+    PartitionLostError,
+    zipfile.BadZipFile,
+    ValueError,  # includes json.JSONDecodeError and UnicodeDecodeError
+    KeyError,
+    OSError,
+)
 
 
 class StreamSource:
@@ -65,18 +98,50 @@ class StreamSource:
 
 
 def _load_npz(path: str) -> Table:
+    check_record(path)
+    _verify_sidecar(path)
     with np.load(path, allow_pickle=False) as npz:
         return Table({name: npz[name] for name in npz.files})
 
 
-def _load_json_rows(path: str) -> Table:
+def _verify_sidecar(path: str) -> None:
+    """CRC-check ``path`` against a ``<path>.crc32`` sidecar when one
+    exists (producers that write sidecars get end-to-end integrity on
+    the streaming path too; a mismatch raises PartitionLostError)."""
+    if os.path.exists(path + ".crc32"):
+        from mmlspark_tpu.data.sharded import _verify_shard
+
+        _verify_shard(path)
+
+
+def _load_json_rows(
+    path: str,
+    mode: str = FAILFAST,
+    quarantined: Optional[List[CorruptRecord]] = None,
+) -> Table:
+    """Load a json/jsonl file as row objects. Under a non-failfast
+    ``mode`` an undecodable jsonl *line* quarantines (appended to
+    ``quarantined`` with its line index) and the rest of the file
+    survives — the per-record path; array-form ``.json`` files decode
+    all-or-nothing, so their failures quarantine the whole file."""
+    check_record(path)
     rows: List[Dict[str, Any]] = []
-    with open(path, "r", encoding="utf-8") as fh:
-        text = fh.read().strip()
+    with open(path, "rb") as fh:
+        raw = fh.read()
+    text = raw.decode("utf-8").strip()
     if text.startswith("["):
         rows = json.loads(text)
-    else:
-        rows = [json.loads(line) for line in text.splitlines() if line.strip()]
+        return Table.from_rows(rows)
+    for i, line in enumerate(text.splitlines()):
+        if not line.strip():
+            continue
+        data = corrupt_record_bytes(path, i, line.encode("utf-8"))
+        try:
+            rows.append(json.loads(data.decode("utf-8")))
+        except ValueError as e:  # JSONDecodeError and UnicodeDecodeError
+            if mode == FAILFAST or quarantined is None:
+                raise
+            quarantined.append(CorruptRecord.from_error(path, e, index=i))
     return Table.from_rows(rows)
 
 
@@ -104,11 +169,16 @@ class FileStreamSource(StreamSource):
         pattern: str = "*",
         loader: Optional[Callable[[str], Table]] = None,
         max_per_trigger: Optional[int] = None,
+        mode: str = FAILFAST,
     ):
         self.path = path
         self.pattern = pattern
         self._loader = loader
         self.max_per_trigger = max_per_trigger
+        self.mode = normalize_mode(mode)
+        #: quarantines from the most recent ``load_batch`` — the query
+        #: dead-letters these under its WAL epoch
+        self.last_quarantined: List[CorruptRecord] = []
         #: ordered names already exposed through ``latest_offset`` — a name
         #: never moves once listed, so offsets stay stable across rescans
         self._files: List[str] = []
@@ -145,7 +215,20 @@ class FileStreamSource(StreamSource):
         return list(self._files[start:end])
 
     def load_batch(self, manifest: Sequence[str]) -> Table:
-        tables = [self._load_one(name) for name in manifest]
+        self.last_quarantined = []
+        tables = []
+        for name in manifest:
+            try:
+                tables.append(self._load_one(name))
+            except _RECORD_ERRORS as e:
+                if self.mode == FAILFAST:
+                    raise
+                full = os.path.join(self.path, name)
+                self.last_quarantined.append(CorruptRecord.from_error(full, e))
+                logger.warning(
+                    "stream source %s: quarantined %s (%s: %s)",
+                    self.path, name, type(e).__name__, e,
+                )
         if not tables:
             return Table({})
         return Table.concat(tables)
@@ -153,8 +236,15 @@ class FileStreamSource(StreamSource):
     def _load_one(self, name: str) -> Table:
         full = os.path.join(self.path, name)
         if self._loader is not None:
+            check_record(full)
             return self._loader(full)
         ext = os.path.splitext(name)[1].lower()
+        if ext in (".json", ".jsonl"):
+            # per-record tolerance: line failures land in last_quarantined,
+            # whole-file failures propagate to load_batch's handler
+            return _load_json_rows(
+                full, mode=self.mode, quarantined=self.last_quarantined
+            )
         loader = _LOADERS.get(ext)
         if loader is None:
             raise ValueError(

@@ -476,6 +476,53 @@ class TestTmpRenameAtomicity:
         )
         assert rules_of(found) == ["tmp-rename-atomicity"]
 
+    def test_dataguard_covered(self):
+        # the dead-letter store is durable state: a torn manifest would
+        # break the exactly-once contract, so dataguard/ is in scope
+        src = (
+            "def save_manifest(path, data):\n"
+            "    with open(path, 'w') as fh:\n"
+            "        fh.write(data)\n"
+        )
+        found = lint_at(
+            "mmlspark_tpu/dataguard/dlq.py", src,
+            select=["tmp-rename-atomicity"],
+        )
+        assert rules_of(found) == ["tmp-rename-atomicity"]
+
+    def test_real_dlq_writer_passes(self):
+        # the shipped DeadLetterStore must satisfy its own lint: every
+        # durable write goes through _atomic_write (tmp + rename)
+        import mmlspark_tpu.dataguard.dlq as dlq_mod
+
+        with open(dlq_mod.__file__, "r", encoding="utf-8") as fh:
+            src = fh.read()
+        assert lint_at(
+            "mmlspark_tpu/dataguard/dlq.py", src,
+            select=["tmp-rename-atomicity"],
+        ) == []
+
+    def test_real_dataguard_package_passes_lock_rules(self):
+        import glob as _glob
+        import os as _os
+
+        import mmlspark_tpu.dataguard as pkg
+
+        pkg_dir = _os.path.dirname(pkg.__file__)
+        contexts = []
+        for path in sorted(_glob.glob(_os.path.join(pkg_dir, "*.py"))):
+            rel = _os.path.join(
+                "mmlspark_tpu", "dataguard", _os.path.basename(path)
+            )
+            with open(path, "r", encoding="utf-8") as fh:
+                contexts.append(FileContext(rel, fh.read()))
+        violations, _ = lint_contexts(
+            contexts,
+            select=["lock-discipline", "lock-blocking", "lock-order",
+                    "tmp-rename-atomicity"],
+        )
+        assert violations == []
+
 
 class TestOnsetRecoveryPairing:
     def test_onset_without_recovery(self):

@@ -1,0 +1,528 @@
+"""dataguard/ tests — corrupt-record read modes (Spark's ``mode`` /
+``badRecordsPath`` / ``ignoreCorruptFiles`` analogues), the epoch-keyed
+dead-letter store, fit-time NaN/Inf guards, and the malformed-request
+serving edge (structured traced 400s + the poison-client breaker)."""
+
+import json
+import time
+import urllib.error
+import urllib.request
+import zipfile
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.data.sharded import ShardedDataset, fit_gbdt_sharded
+from mmlspark_tpu.data.table import Table
+from mmlspark_tpu.dataguard import (
+    BadRecordsError,
+    CorruptRecord,
+    DeadLetterStore,
+    MalformedRateBreaker,
+    RequestValidator,
+    guard_arrays,
+    guard_table,
+    normalize_mode,
+)
+from mmlspark_tpu.lightgbm import LightGBMClassifier
+from mmlspark_tpu.runtime.lineage import PartitionLostError
+
+NUM_SHARDS = 6
+ROWS = 50
+TORN, STALE = 1, 4  # corruption styles per shard index
+
+
+def _make_shards(out_dir, seed=3, num_features=5):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(NUM_SHARDS * ROWS, num_features))
+    y = (X[:, 0] - 0.5 * X[:, 2] > 0).astype(np.float64)
+    ds = ShardedDataset.write_shards(str(out_dir), X, y, rows_per_shard=ROWS)
+    return list(ds.paths)
+
+
+def _corrupt(paths):
+    """Tear shard TORN's bytes; stale-sidecar shard STALE."""
+    with open(paths[TORN], "rb+") as fh:
+        fh.truncate(200)
+    with open(paths[STALE] + ".crc32", "w") as fh:
+        fh.write("deadbeef")
+    return [p for i, p in enumerate(paths) if i not in (TORN, STALE)]
+
+
+class TestReadModes:
+    def test_failfast_is_default_and_raises(self, tmp_path):
+        paths = _make_shards(tmp_path)
+        _corrupt(paths)
+        ds = ShardedDataset(paths)
+        assert ds.mode == "failfast"
+        with pytest.raises((PartitionLostError, zipfile.BadZipFile, ValueError)):
+            ds.num_rows  # noqa: B018 - property triggers the scan
+
+    def test_failfast_stale_sidecar_raises_on_load(self, tmp_path):
+        paths = _make_shards(tmp_path)
+        _corrupt(paths)
+        # the stale-sidecar shard has intact headers, so the scan passes;
+        # the CRC check at decode time must still kill a FAILFAST read
+        ds = ShardedDataset([paths[STALE]])
+        with pytest.raises(PartitionLostError):
+            list(ds.iter_shards())
+
+    def test_permissive_quarantines_and_letters(self, tmp_path):
+        paths = _make_shards(tmp_path)
+        clean = _corrupt(paths)
+        dlq_root = str(tmp_path / "bad")
+        ds = ShardedDataset(
+            paths, mode="PERMISSIVE", bad_records_path=dlq_root
+        )
+        assert ds.num_rows == len(clean) * ROWS
+        assert sorted(r.source for r in ds.quarantined) == sorted(
+            [paths[TORN], paths[STALE]]
+        )
+        assert ds.paths == clean  # survivor order is listing order
+        dlq = DeadLetterStore(dlq_root, name="sharded")
+        assert dlq.epochs() == [0]
+        assert dlq.manifest()[0]["count"] == 2
+        assert sorted(r.source for r in dlq.replay()) == sorted(
+            [paths[TORN], paths[STALE]]
+        )
+
+    def test_dropmalformed_counts_without_lettering(self, tmp_path):
+        paths = _make_shards(tmp_path)
+        _corrupt(paths)
+        dlq_root = str(tmp_path / "bad")
+        ds = ShardedDataset(
+            paths, mode="dropmalformed", bad_records_path=dlq_root
+        )
+        assert ds.num_rows == (NUM_SHARDS - 2) * ROWS
+        assert len(ds.quarantined) == 2
+        # dropmalformed drops and counts — it never writes the DLQ
+        assert DeadLetterStore(dlq_root).epochs() == []
+
+    def test_ignore_corrupt_files_upgrades_failfast(self, tmp_path):
+        paths = _make_shards(tmp_path)
+        _corrupt(paths)
+        ds = ShardedDataset(paths, ignore_corrupt_files=True)
+        assert ds.mode == "dropmalformed"
+        assert ds.num_rows == (NUM_SHARDS - 2) * ROWS
+
+    def test_all_corrupt_raises_bad_records(self, tmp_path):
+        paths = _make_shards(tmp_path)
+        for p in paths:
+            with open(p + ".crc32", "w") as fh:
+                fh.write("deadbeef")
+        with pytest.raises(BadRecordsError) as ei:
+            ShardedDataset(paths, mode="permissive").num_rows  # noqa: B018
+        assert len(ei.value.records) == NUM_SHARDS
+
+    def test_normalize_mode(self):
+        assert normalize_mode("PERMISSIVE") == "permissive"
+        assert normalize_mode(" FailFast ") == "failfast"
+        with pytest.raises(ValueError, match="unknown read mode"):
+            normalize_mode("lenient")
+
+
+class TestQuarantineByteIdentity:
+    """The tentpole property: quarantining a seeded K-shard subset yields
+    the same model bytes as fitting the clean complement — on the
+    quantized out-of-core path (bin mapper + uint8 memmap)."""
+
+    def test_permissive_fit_equals_clean_complement(self, tmp_path):
+        paths = _make_shards(tmp_path, seed=11)
+        import os
+
+        seed = int(os.environ.get("MMLSPARK_TPU_FAULT_SEED", "23"))
+        rng = np.random.default_rng(seed)
+        k_bad = sorted(rng.choice(NUM_SHARDS, size=2, replace=False).tolist())
+        for i in k_bad:
+            with open(paths[i] + ".crc32", "w") as fh:
+                fh.write("00000000")
+        clean = [p for i, p in enumerate(paths) if i not in k_bad]
+
+        def est():
+            return LightGBMClassifier(numIterations=5, numLeaves=7, seed=9)
+
+        ref = fit_gbdt_sharded(est(), ShardedDataset(clean))
+        got = fit_gbdt_sharded(
+            est(), ShardedDataset(paths, mode="permissive")
+        )
+        assert got.booster.model_to_string() == ref.booster.model_to_string()
+
+
+class TestDeadLetterStore:
+    REC = CorruptRecord(source="s.npz", index=-1, reason="torn", detail="x")
+
+    def test_commit_replay_roundtrip(self, tmp_path):
+        dlq = DeadLetterStore(str(tmp_path), name="t")
+        assert dlq.commit_epoch(3, [self.REC]) is True
+        assert dlq.has_epoch(3) and dlq.epochs() == [3]
+        (rec,) = dlq.replay(3)
+        assert (rec.source, rec.index, rec.reason) == ("s.npz", -1, "torn")
+        assert dlq.count() == 1
+
+    def test_commit_is_epoch_idempotent(self, tmp_path):
+        dlq = DeadLetterStore(str(tmp_path))
+        assert dlq.commit_epoch(1, [self.REC]) is True
+        # the replayed epoch (WAL'd, SIGKILL'd before its commit log)
+        # re-quarantines identical records: nothing may be written twice
+        other = CorruptRecord(source="other", index=0, reason="torn")
+        assert dlq.commit_epoch(1, [self.REC, other]) is False
+        assert dlq.manifest()[1]["count"] == 1
+
+    def test_empty_commit_is_a_noop(self, tmp_path):
+        dlq = DeadLetterStore(str(tmp_path))
+        assert dlq.commit_epoch(0, []) is False
+        assert dlq.letter([]) is None
+        assert dlq.epochs() == []
+
+    def test_letter_allocates_next_epoch(self, tmp_path):
+        dlq = DeadLetterStore(str(tmp_path))
+        assert dlq.letter([self.REC]) == 0
+        assert dlq.letter([self.REC]) == 1
+        assert dlq.epochs() == [0, 1]
+
+    def test_replay_verifies_crc(self, tmp_path):
+        dlq = DeadLetterStore(str(tmp_path))
+        dlq.commit_epoch(0, [self.REC])
+        path = dlq._records_path(0)
+        with open(path, "ab") as fh:
+            fh.write(b'{"source": "injected", "index": 0}\n')
+        with pytest.raises(ValueError, match="CRC"):
+            dlq.replay(0)
+
+    def test_dict_records_coerce(self, tmp_path):
+        dlq = DeadLetterStore(str(tmp_path))
+        dlq.commit_epoch(0, [{"source": "a", "index": 2, "reason": "bad"}])
+        (rec,) = dlq.replay()
+        assert rec.index == 2 and rec.reason == "bad"
+
+
+class TestJsonlQuarantine:
+    def test_bad_line_quarantines_under_permissive(self, tmp_path):
+        from mmlspark_tpu.streaming.source import _load_json_rows
+
+        path = tmp_path / "rows.jsonl"
+        path.write_text(
+            '{"a": 1.0}\n{"a": not json\n{"a": 3.0}\n'
+        )
+        quarantined = []
+        table = _load_json_rows(
+            str(path), mode="permissive", quarantined=quarantined
+        )
+        assert table.num_rows == 2
+        assert np.allclose(table.column("a"), [1.0, 3.0])
+        (rec,) = quarantined
+        assert rec.index == 1 and rec.source == str(path)
+
+    def test_bad_line_raises_under_failfast(self, tmp_path):
+        from mmlspark_tpu.streaming.source import _load_json_rows
+
+        path = tmp_path / "rows.jsonl"
+        path.write_text('{"a": 1.0}\nnope\n')
+        with pytest.raises(ValueError):
+            _load_json_rows(str(path), mode="failfast", quarantined=[])
+
+
+class TestFitGuards:
+    def _dirty(self):
+        X = np.array([
+            [1.0, 2.0], [np.nan, 4.0], [5.0, 6.0], [7.0, np.inf],
+        ])
+        y = np.array([0.0, 1.0, np.nan, 1.0])
+        return X, y
+
+    def test_fail_policy_raises_naming_columns(self):
+        X, y = self._dirty()
+        with pytest.raises(BadRecordsError) as ei:
+            guard_arrays(X, y, policy="fail")
+        cols = {r.detail.split(":")[0] for r in ei.value.records}
+        assert cols == {"f0", "f1", "label"}
+
+    def test_drop_policy_keeps_clean_complement(self):
+        X, y = self._dirty()
+        Xg, yg, _, report = guard_arrays(X, y, policy="drop")
+        np.testing.assert_array_equal(Xg, [[1.0, 2.0]])
+        np.testing.assert_array_equal(yg, [0.0])
+        assert report.rows_dropped == 3
+
+    def test_impute_fills_features_but_drops_bad_labels(self):
+        X, y = self._dirty()
+        Xg, yg, _, report = guard_arrays(X, y, policy="impute")
+        # row 2 (NaN label) is dropped — a label cannot be conjured
+        assert len(Xg) == 3 and report.rows_dropped == 1
+        assert report.values_imputed == 2
+        assert np.isfinite(Xg).all()
+        # the NaN in f0 became the mean of f0's finite entries
+        finite_f0 = [1.0, 5.0, 7.0]
+        assert Xg[1, 0] == pytest.approx(np.mean(finite_f0))
+
+    def test_classifier_label_domain(self):
+        X = np.ones((3, 2))
+        y = np.array([0.0, 1.0, 0.5])
+        with pytest.raises(BadRecordsError):
+            guard_arrays(X, y, policy="fail", label_domain="classifier")
+        Xg, yg, _, rep = guard_arrays(
+            X, y, policy="drop", label_domain="classifier"
+        )
+        assert len(Xg) == 2 and rep.bad_label_rows == 1
+
+    def test_weight_column_guarded(self):
+        X = np.ones((3, 2))
+        y = np.zeros(3)
+        w = np.array([1.0, np.nan, 1.0])
+        Xg, yg, wg, rep = guard_arrays(X, y, w, policy="drop")
+        assert len(Xg) == 2 and np.isfinite(wg).all()
+
+    def test_guard_table_drop_and_impute(self):
+        t = Table({
+            "features": np.array([[1.0, 2.0], [np.nan, 4.0], [5.0, 6.0]]),
+            "label": np.array([0.0, 1.0, 1.0]),
+            "name": np.array(["a", "b", "c"], dtype=object),
+        })
+        out, rep = guard_table(t, policy="drop", label_col="label")
+        assert out.num_rows == 2 and rep.rows_dropped == 1
+        out, rep = guard_table(t, policy="impute", label_col="label")
+        assert out.num_rows == 3 and rep.values_imputed == 1
+        assert np.isfinite(out.column("features")).all()
+
+    def test_clean_input_passes_untouched(self):
+        X = np.ones((4, 2))
+        Xg, yg, _, rep = guard_arrays(X, np.zeros(4), policy="fail")
+        assert rep.clean and Xg is X
+
+
+class TestPipelineGuard:
+    def _table(self):
+        rng = np.random.default_rng(5)
+        X = rng.normal(size=(80, 4))
+        y = (X[:, 0] > 0).astype(np.float64)
+        return X, y
+
+    def test_fail_policy_raises_at_fit(self):
+        from mmlspark_tpu.core.pipeline import Pipeline
+
+        X, y = self._table()
+        X[3, 1] = np.nan
+        pipe = Pipeline(
+            stages=[LightGBMClassifier(numIterations=3, numLeaves=7)],
+            invalidDataPolicy="fail",
+        )
+        with pytest.raises(BadRecordsError):
+            pipe.fit(Table({"features": X, "label": y}))
+
+    def test_drop_policy_matches_clean_complement_fit(self):
+        from mmlspark_tpu.core.pipeline import Pipeline
+
+        X, y = self._table()
+        Xd = X.copy()
+        Xd[7, 2] = np.inf
+        yd = y.copy()
+        yd[11] = np.nan
+
+        def pipe(policy=""):
+            return Pipeline(
+                stages=[LightGBMClassifier(
+                    numIterations=4, numLeaves=7, seed=2,
+                )],
+                invalidDataPolicy=policy,
+            )
+
+        keep = np.ones(len(X), dtype=bool)
+        keep[[7, 11]] = False
+        ref = pipe().fit(Table({"features": X[keep], "label": y[keep]}))
+        got = pipe("drop").fit(Table({"features": Xd, "label": yd}))
+        assert got.getStages()[-1].booster.model_to_string() == \
+            ref.getStages()[-1].booster.model_to_string()
+
+    def test_classifier_stage_pins_label_domain(self):
+        from mmlspark_tpu.core.pipeline import Pipeline
+
+        X, y = self._table()
+        y[0] = 0.5  # finite, but not a class id
+        pipe = Pipeline(
+            stages=[LightGBMClassifier(numIterations=3, numLeaves=7)],
+            invalidDataPolicy="fail",
+        )
+        with pytest.raises(BadRecordsError):
+            pipe.fit(Table({"features": X, "label": y}))
+
+
+class TestRequestValidator:
+    def test_structural_rejections(self):
+        v = RequestValidator(input_col="input", width=3)
+        assert v.check_payload(None) == (
+            "empty-payload", "request body is empty"
+        )
+        assert v.check_payload({"other": 1})[0] == "missing-input-col"
+        assert v.check_payload({"input": float("nan")})[0] == \
+            "non-finite-value"
+        assert v.check_payload({"input": [1.0, None, 2.0]})[0] == "null-value"
+        assert v.check_payload({"input": [1.0, 2.0]})[0] == "shape-mismatch"
+        assert v.check_payload({"input": [[1.0, 2.0, 3.0], [1.0, 2.0]]})[0] \
+            == "shape-mismatch"
+        assert v.check_payload({"input": [1.0, 2.0, 3.0]}) is None
+        assert v.check_payload({"input": "some text"}) is None
+
+    def test_for_model_infers_booster_width(self, tmp_path):
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(60, 4))
+        y = (X[:, 0] > 0).astype(np.float64)
+        model = LightGBMClassifier(numIterations=2, numLeaves=7).fit(
+            Table({"features": X, "label": y})
+        )
+        v = RequestValidator.for_model(model, input_col="features")
+        assert v.width == 4
+
+    def test_for_model_unknown_width_validates_structure_only(self):
+        v = RequestValidator.for_model(object())
+        assert v.width is None
+        assert v.check_payload({"input": [1.0, 2.0]}) is None
+        assert v.check_payload({"input": float("inf")})[0] == \
+            "non-finite-value"
+
+    def test_disabled_passes_everything(self):
+        v = RequestValidator(enabled=False)
+        assert v.check_payload(None) is None
+
+
+class TestMalformedRateBreaker:
+    def test_trip_and_release_with_injected_clock(self):
+        now = [0.0]
+        b = MalformedRateBreaker(
+            threshold=3, window_s=10.0, reset_s=5.0, clock=lambda: now[0]
+        )
+        assert b.record_malformed("evil") is False
+        assert b.record_malformed("evil") is False
+        assert b.record_malformed("evil") is True  # third one trips
+        assert b.blocked("evil") is True
+        assert b.blocked("innocent") is False  # per-client isolation
+        now[0] = 5.1
+        assert b.blocked("evil") is False  # released after reset_s
+        assert b.record_malformed("evil") is False  # window restarts
+
+    def test_old_events_age_out_of_window(self):
+        now = [0.0]
+        b = MalformedRateBreaker(
+            threshold=3, window_s=2.0, reset_s=1.0, clock=lambda: now[0]
+        )
+        b.record_malformed("c")
+        b.record_malformed("c")
+        now[0] = 3.0  # both events aged out
+        assert b.record_malformed("c") is False
+        assert b.blocked("c") is False
+
+
+def _post_raw(url, data, headers=None, timeout=10):
+    req = urllib.request.Request(
+        url, data=data, method="POST",
+        headers={"Content-Type": "application/json", **(headers or {})},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, r.read(), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, e.read(), dict(e.headers)
+
+
+class TestServingEdge:
+    """Pre-admission hardening: structured, traced 400s and the breaker."""
+
+    def _model(self):
+        rng = np.random.default_rng(1)
+        X = rng.normal(size=(60, 3))
+        y = (X[:, 0] > 0).astype(np.float64)
+        return LightGBMClassifier(numIterations=2, numLeaves=7).fit(
+            Table({"features": X, "label": y})
+        )
+
+    def test_invalid_json_gets_traced_structured_400(self):
+        from mmlspark_tpu.serving import ServingServer
+
+        with ServingServer(self._model(), input_col="features") as srv:
+            status, body, headers = _post_raw(
+                srv.info.url, b'{"features": [1.0, broken'
+            )
+            assert status == 400
+            # the regression this guards: the 400 path must carry the
+            # trace id even though no span existed before the parse
+            assert headers.get("X-Trace-Id")
+            err = json.loads(body)["error"]
+            assert err["kind"] == "invalid-json" and err["rid"]
+
+    def test_schema_violations_get_structured_400(self):
+        from mmlspark_tpu.serving import ServingServer
+
+        with ServingServer(self._model(), input_col="features") as srv:
+            cases = [
+                (json.dumps({"wrong": [1.0]}).encode(), "missing-input-col"),
+                (b'{"features": [1.0, 2.0]}', "shape-mismatch"),
+                (b'{"features": [NaN, 1.0, 2.0]}', "non-finite-value"),
+            ]
+            for payload, kind in cases:
+                status, body, headers = _post_raw(srv.info.url, payload)
+                assert status == 400, (kind, status, body)
+                assert json.loads(body)["error"]["kind"] == kind
+                assert headers.get("X-Trace-Id")
+            # a valid request on the same (kept-alive) endpoint still serves
+            status, _, _ = _post_raw(
+                srv.info.url, json.dumps({"features": [0.1, 0.2, 0.3]}).encode()
+            )
+            assert status == 200
+
+    def test_poison_client_shed_then_released(self):
+        from mmlspark_tpu.serving import ServingServer
+
+        with ServingServer(
+            self._model(), input_col="features",
+            malformed_threshold=3, malformed_window_s=30.0,
+            malformed_reset_s=0.3,
+        ) as srv:
+            poison = {"X-Client-Id": "poison"}
+            for _ in range(3):
+                status, _, _ = _post_raw(
+                    srv.info.url, b'{"features": bad', headers=poison
+                )
+                assert status == 400
+            good = json.dumps({"features": [0.1, 0.2, 0.3]}).encode()
+            status, body, headers = _post_raw(srv.info.url, good, headers=poison)
+            assert status == 429, body
+            assert "Retry-After" in headers
+            assert json.loads(body)["error"]["kind"] == "malformed-rate"
+            # a different client on the same replica is untouched
+            status, _, _ = _post_raw(
+                srv.info.url, good, headers={"X-Client-Id": "healthy"}
+            )
+            assert status == 200
+            time.sleep(0.35)
+            status, _, _ = _post_raw(srv.info.url, good, headers=poison)
+            assert status == 200
+
+    def test_validator_off_restores_old_edge(self):
+        from mmlspark_tpu.serving import ServingServer
+
+        with ServingServer(
+            self._model(), input_col="features", request_validator="off"
+        ) as srv:
+            # shape garbage reaches the model unchecked (the booster
+            # happens to tolerate short rows) — the point is that the
+            # edge no longer pre-rejects: opt-out is explicit
+            status, _, _ = _post_raw(srv.info.url, b'{"features": [1.0]}')
+            assert status != 400
+
+
+class TestFaultPlanMalformed:
+    def test_take_malformed_drains_in_order(self):
+        from mmlspark_tpu.runtime.faults import FaultPlan
+
+        plan = FaultPlan(seed=1)
+        plan.malformed_request(count=2, kind="json")
+        plan.malformed_request(count=1, kind="nan")
+        kinds = [plan.take_malformed() for _ in range(4)]
+        assert kinds == ["json", "json", "nan", None]
+        fired = [f for f in plan.fired if f[0] == "malformed_request"]
+        assert len(fired) == 3
+
+    def test_unknown_kind_rejected(self):
+        from mmlspark_tpu.runtime.faults import FaultPlan
+
+        with pytest.raises(ValueError, match="malformed-request kind"):
+            FaultPlan(seed=1).malformed_request(kind="gibberish")

@@ -43,6 +43,13 @@ by a recovery on ``input[1]``), and every ``AlertFired`` by an
 ``AlertResolved`` for the same alert name. An onset that never recovers
 inside the campaign means the storm outlived its injection window.
 
+``--dataguard`` additionally asserts the poison-tolerance contract:
+``RecordsDeadLettered`` must be exactly-once per (source, epoch) — a
+duplicate means a replayed streaming epoch double-lettered its
+quarantines past the DLQ manifest guard — and every
+``PoisonClientBlocked`` must be followed by a ``PoisonClientReleased``
+for the same client.
+
 Exit status 0 with a one-line summary when the log is clean; 1 with one
 diagnostic per bad line otherwise (CI gates on this; see the
 ``observability`` and ``fleet-chaos`` jobs in .github/workflows/ci.yml).
@@ -292,6 +299,54 @@ def check_quality_pairing(
     return problems, summary
 
 
+def check_dataguard_pairing(
+    records: typing.List[dict],
+) -> typing.Tuple[typing.List[str], str]:
+    """(problems, summary) for the poison-tolerance contract over a
+    decoded record stream: RecordsDeadLettered must be exactly-once per
+    (source, epoch) — a duplicate means a replayed epoch double-lettered
+    its quarantines past the DLQ manifest guard — and every
+    PoisonClientBlocked must be followed by a PoisonClientReleased for
+    the SAME client (a breaker that never releases starves a client that
+    stopped misbehaving)."""
+    lettered: typing.Dict[typing.Tuple[str, int], int] = {}
+    block_onsets: typing.List[typing.Tuple[int, dict]] = []
+    releases: typing.List[typing.Tuple[int, str]] = []
+    for i, rec in enumerate(records):
+        kind = rec.get("event")
+        if kind == "RecordsDeadLettered":
+            key = (str(rec.get("source", "")), int(rec.get("epoch", -1)))
+            lettered[key] = lettered.get(key, 0) + 1
+        elif kind == "PoisonClientBlocked":
+            block_onsets.append((i, rec))
+        elif kind == "PoisonClientReleased":
+            releases.append((i, str(rec.get("client", ""))))
+    problems = []
+    for (source, epoch), n in sorted(lettered.items()):
+        if n > 1:
+            problems.append(
+                f"RecordsDeadLettered for ({source!r}, epoch {epoch}) "
+                f"appeared {n} times — a replayed epoch double-lettered "
+                f"its quarantines (DLQ exactly-once violated)"
+            )
+    paired = 0
+    for idx, rec in block_onsets:
+        client = str(rec.get("client", ""))
+        if any(j > idx and c == client for j, c in releases):
+            paired += 1
+        else:
+            problems.append(
+                f"PoisonClientBlocked onset (client={client!r}) has no "
+                f"subsequent PoisonClientReleased for that client — the "
+                f"breaker never released"
+            )
+    summary = (
+        f"dataguard pairing: {len(lettered)} dead-letter epoch(s) "
+        f"exactly-once, {paired}/{len(block_onsets)} poison blocks released"
+    )
+    return problems, summary
+
+
 def main(argv: typing.Optional[typing.List[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="tools/check_eventlog.py",
@@ -320,6 +375,12 @@ def main(argv: typing.Optional[typing.List[str]] = None) -> int:
         help="also assert every DriftDetected pairs with a later "
              "DriftCleared (same feature) and every AlertFired with a "
              "later AlertResolved (same alert)",
+    )
+    parser.add_argument(
+        "--dataguard", action="store_true",
+        help="also assert RecordsDeadLettered is exactly-once per "
+             "(source, epoch) and every PoisonClientBlocked pairs with a "
+             "later PoisonClientReleased (same client)",
     )
     args = parser.parse_args(argv)
     path = args.eventlog
@@ -377,6 +438,12 @@ def main(argv: typing.Optional[typing.List[str]] = None) -> int:
         summaries.append(summary)
     if args.quality:
         problems, summary = check_quality_pairing(valid_records)
+        for p in problems:
+            print(f"{path}: {p}", file=sys.stderr)
+        bad += len(problems)
+        summaries.append(summary)
+    if args.dataguard:
+        problems, summary = check_dataguard_pairing(valid_records)
         for p in problems:
             print(f"{path}: {p}", file=sys.stderr)
         bad += len(problems)

@@ -1,0 +1,392 @@
+#!/usr/bin/env python
+"""Corruption-storm smoke for the poison-tolerant data plane (CI:
+data-chaos).
+
+Three acts, one seeded storm (``MMLSPARK_TPU_FAULT_SEED``):
+
+1. **Sharded batch fit** — a shard set is corrupted two ways (torn file
+   bytes, stale CRC sidecar); a ``mode="permissive"`` fit with
+   ``bad_records_path`` must quarantine exactly those shards into the
+   dead-letter store and produce a model **byte-identical** to a fit
+   over the clean complement (deterministic survivor order is the whole
+   point of the eager scan).
+
+2. **Streaming corruption storm + SIGKILL** — a checkpointed
+   :class:`StreamingQuery` over a permissive ``FileStreamSource`` eats
+   the same two corruptions as whole-epoch quarantines while the parent
+   SIGKILLs the child at ``pre_commit`` of one poisoned epoch and
+   ``post_wal`` of the other. The DLQ must hold exactly one manifest per
+   poisoned epoch across every restart (exactly-once under the WAL), and
+   the final model must match an undisturbed run over the clean
+   complement, byte for byte.
+
+3. **Serving malformed storm** — the act-1 model serves over HTTP while
+   a ``FaultPlan.malformed_request``-directed poison client floods it
+   with torn JSON / schema violations / NaN payloads: every reply must
+   be a structured 400 carrying ``X-Trace-Id`` until the per-client
+   breaker sheds with 429s; a healthy client stays at 200 throughout and
+   the poison client is admitted again after the reset window.
+
+The event log (``--out``) is written for ``check_eventlog.py
+--dataguard``: RecordsDeadLettered exactly-once per (source, epoch),
+every PoisonClientBlocked paired with a PoisonClientReleased.
+
+Exit code 0 + "data chaos smoke OK" on success.
+
+Usage: python tools/data_chaos_smoke.py [--out DIR]        # the smoke
+       python tools/data_chaos_smoke.py --child R I [E P]  # victim
+"""
+
+import argparse
+import glob
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.error
+import urllib.request
+import zlib
+
+# runnable both installed (CI) and straight from a checkout
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np
+
+NUM_SHARDS = 6
+NUM_CHUNKS = 6
+CORRUPT = (2, 4)  # index -> torn bytes, index -> stale CRC sidecar
+MODEL = "datachaos"
+
+
+def _seed() -> int:
+    return int(os.environ.get("MMLSPARK_TPU_FAULT_SEED", "23"))
+
+
+def corrupt_torn(path: str) -> None:
+    """Truncate the file to 60% of its bytes — a torn write (the sidecar,
+    if any, no longer matches either)."""
+    size = os.path.getsize(path)
+    with open(path, "rb+") as fh:
+        fh.truncate(max(1, int(size * 0.6)))
+
+
+def corrupt_sidecar(path: str) -> None:
+    """Write a stale ``.crc32`` sidecar: the file is intact but the
+    recorded checksum is wrong — bit-rot as the loader sees it."""
+    with open(path + ".crc32", "w", encoding="utf-8") as fh:
+        fh.write("deadbeef")
+
+
+# -- act 1: sharded batch fit over a corrupted shard set ----------------------
+
+
+def batch_fit_act(work: str):
+    from mmlspark_tpu.data.sharded import ShardedDataset, fit_gbdt_sharded
+    from mmlspark_tpu.dataguard import DeadLetterStore
+    from mmlspark_tpu.lightgbm import LightGBMClassifier
+
+    rng = np.random.default_rng(_seed())
+    X = rng.normal(size=(600, 6))
+    y = (X[:, 0] - 0.5 * X[:, 3] > 0).astype(np.float64)
+    shards_dir = os.path.join(work, "shards")
+    ShardedDataset.write_shards(shards_dir, X, y, rows_per_shard=100)
+    paths = sorted(glob.glob(os.path.join(shards_dir, "shard_*.npz")))
+    assert len(paths) == NUM_SHARDS, paths
+    corrupt_torn(paths[CORRUPT[0]])
+    corrupt_sidecar(paths[CORRUPT[1]])
+
+    def estimator():
+        return LightGBMClassifier(numIterations=8, numLeaves=15, seed=7)
+
+    clean = [p for i, p in enumerate(paths) if i not in CORRUPT]
+    ref = fit_gbdt_sharded(estimator(), ShardedDataset(clean))
+    ref_text = ref.booster.model_to_string()
+
+    dlq_dir = os.path.join(work, "badrecords")
+    ds = ShardedDataset(paths, mode="permissive", bad_records_path=dlq_dir)
+    model = fit_gbdt_sharded(estimator(), ds)
+    text = model.booster.model_to_string()
+
+    assert len(ds.quarantined) == len(CORRUPT), [
+        (r.source, r.reason) for r in ds.quarantined
+    ]
+    quarantined_paths = sorted(r.source for r in ds.quarantined)
+    want_paths = sorted(paths[i] for i in CORRUPT)
+    assert quarantined_paths == want_paths, quarantined_paths
+    assert text == ref_text, (
+        "permissive fit diverged from the clean-complement fit "
+        f"(crc {zlib.crc32(text.encode()):08x} vs "
+        f"{zlib.crc32(ref_text.encode()):08x})"
+    )
+
+    dlq = DeadLetterStore(dlq_dir, name="sharded")
+    manifest = dlq.manifest()
+    assert len(manifest) == 1 and manifest[0]["count"] == len(CORRUPT), manifest
+    replayed = dlq.replay()
+    assert sorted(r.source for r in replayed) == want_paths, replayed
+    print(
+        f"act 1 (batch): {len(ds.quarantined)} shard(s) quarantined "
+        f"({', '.join(sorted(r.reason for r in ds.quarantined))}), model "
+        f"byte-identical to clean complement "
+        f"(crc {zlib.crc32(text.encode()):08x}), DLQ replay ok"
+    )
+    return model
+
+
+# -- act 2: streaming corruption storm under SIGKILL --------------------------
+
+
+def make_chunks(incoming: str) -> None:
+    from mmlspark_tpu.data.sharded import write_shard_sidecar
+
+    rng = np.random.default_rng(_seed() + 1)
+    os.makedirs(incoming, exist_ok=True)
+    for i in range(NUM_CHUNKS):
+        X = rng.normal(size=(60, 4))
+        y = (X[:, 0] - 0.5 * X[:, 2] > 0).astype(np.float64)
+        final = os.path.join(incoming, f"part-{i:05d}.npz")
+        np.savez(final + ".tmp.npz", features=X, label=y)
+        os.rename(final + ".tmp.npz", final)
+        write_shard_sidecar(final)
+
+
+def run_child(root, incoming, kill_epoch=None, kill_point=None) -> None:
+    """One (re)start of the permissive query; dies mid-epoch on a kill."""
+    os.environ["MMLSPARK_TPU_CHECKPOINT_DIR"] = root
+    from mmlspark_tpu.lightgbm import LightGBMClassifier
+    from mmlspark_tpu.runtime.faults import FaultPlan, inject_faults
+    from mmlspark_tpu.streaming import (
+        FileStreamSource,
+        ModelCommitSink,
+        StreamingQuery,
+    )
+
+    source = FileStreamSource(
+        incoming, pattern="part-*.npz", max_per_trigger=1, mode="permissive",
+    )
+    sink = ModelCommitSink(
+        lambda: LightGBMClassifier(numIterations=4, numLeaves=7, seed=5),
+        name=MODEL,
+    )
+    query = StreamingQuery(source, sink, name="datachaos")
+    plan = FaultPlan(seed=_seed())
+    if kill_epoch is not None:
+        plan.kill_stream(int(kill_epoch), kill_point)
+    with inject_faults(plan):
+        query.process_all_available()
+    sink.close()
+
+
+def spawn(root, incoming, kill=None, label="child") -> subprocess.Popen:
+    argv = [sys.executable, os.path.abspath(__file__), "--child", root, incoming]
+    if kill is not None:
+        argv += [str(kill[0]), kill[1]]
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "MMLSPARK_TPU_EVENT_LOG_PROCESS": label}
+    return subprocess.Popen(argv, env=env)
+
+
+def model_state(root):
+    """(version, crc32 of the committed model text)."""
+    from mmlspark_tpu.runtime.journal import ModelStore
+
+    version, text = ModelStore(os.path.join(root, "models")).latest(MODEL)
+    return version, zlib.crc32(text.encode())
+
+
+def streaming_act(work: str) -> None:
+    from mmlspark_tpu.dataguard import DeadLetterStore
+
+    incoming = os.path.join(work, "incoming")
+    make_chunks(incoming)
+    torn = os.path.join(incoming, f"part-{CORRUPT[0]:05d}.npz")
+    stale = os.path.join(incoming, f"part-{CORRUPT[1]:05d}.npz")
+    corrupt_torn(torn)
+    corrupt_sidecar(stale)
+
+    # undisturbed reference: the clean complement only, same file names
+    ref_incoming = os.path.join(work, "incoming-ref")
+    os.makedirs(ref_incoming, exist_ok=True)
+    for i in range(NUM_CHUNKS):
+        if i in CORRUPT:
+            continue
+        name = f"part-{i:05d}.npz"
+        with open(os.path.join(incoming, name), "rb") as src:
+            data = src.read()
+        with open(os.path.join(ref_incoming, name), "wb") as dst:
+            dst.write(data)
+    ref_root = os.path.join(work, "stream-ref")
+    child = spawn(ref_root, ref_incoming, label="streamref")
+    assert child.wait(timeout=600) == 0, "undisturbed run failed"
+    ref_version, ref_crc = model_state(ref_root)
+    print(f"act 2 reference: v{ref_version:06d} crc={ref_crc:08x} "
+          f"({NUM_CHUNKS - len(CORRUPT)} clean chunks)")
+
+    # chaos run: SIGKILL at pre_commit of the torn epoch (the DLQ manifest
+    # is already down — the replay must NOT double-letter) and at post_wal
+    # of the stale-sidecar epoch (nothing lettered yet — the replay must
+    # letter exactly once); finish on the third start
+    chaos_root = os.path.join(work, "stream-chaos")
+    kills = [(CORRUPT[0], "pre_commit"), (CORRUPT[1], "post_wal")]
+    for n, kill in enumerate(kills):
+        child = spawn(chaos_root, incoming, kill=kill, label=f"chaos{n}")
+        child.wait(timeout=600)
+        assert child.returncode == -signal.SIGKILL, (
+            f"expected SIGKILL death at {kill}, got rc={child.returncode}"
+        )
+        print(f"act 2: child SIGKILLed at epoch {kill[0]} ({kill[1]})")
+    child = spawn(chaos_root, incoming, label=f"chaos{len(kills)}")
+    assert child.wait(timeout=600) == 0, "final restart failed"
+
+    version, crc = model_state(chaos_root)
+    print(f"act 2 chaos:     v{version:06d} crc={crc:08x} "
+          f"(2 epochs fully quarantined)")
+    assert (version, crc) == (ref_version, ref_crc), (
+        f"streaming model diverged from the clean-complement run: "
+        f"v{version} crc={crc:08x} != v{ref_version} crc={ref_crc:08x}"
+    )
+
+    dlq = DeadLetterStore(
+        os.path.join(chaos_root, "streaming", "datachaos", "deadletter"),
+        name="datachaos",
+    )
+    assert dlq.epochs() == sorted(CORRUPT), (
+        f"DLQ epochs {dlq.epochs()}, expected {sorted(CORRUPT)}"
+    )
+    for entry in dlq.manifest().values():
+        assert entry["count"] == 1, entry  # one file quarantined per epoch
+    for epoch in CORRUPT:
+        (rec,) = dlq.replay(epoch)
+        assert f"part-{epoch:05d}.npz" in rec.source, rec
+    print(f"act 2: DLQ exactly-once across {len(kills)} SIGKILLs "
+          f"(epochs {dlq.epochs()}, one letter each); replay ok")
+
+
+# -- act 3: serving malformed storm -------------------------------------------
+
+
+def _post(url, data, headers=None, timeout=5.0):
+    req = urllib.request.Request(
+        url, data=data, method="POST",
+        headers={"Content-Type": "application/json", **(headers or {})},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, resp.read(), dict(resp.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, e.read(), dict(e.headers)
+
+
+def _malformed_body(kind: str) -> bytes:
+    if kind == "json":
+        return b'{"features": [1.0, not json'
+    if kind == "schema":
+        return json.dumps({"wrong_col": [1.0] * 6}).encode()
+    return b'{"features": [NaN, 0.0, 0.0, 0.0, 0.0, 0.0]}'
+
+
+def serving_act(model) -> None:
+    from mmlspark_tpu.runtime.faults import FaultPlan
+    from mmlspark_tpu.serving import ServingServer
+
+    plan = FaultPlan(seed=_seed())
+    for kind in ("json", "schema", "nan"):
+        plan.malformed_request(count=4, kind=kind)
+
+    good_row = [0.1, -0.2, 0.3, -0.4, 0.5, -0.6]
+    with ServingServer(
+        model, input_col="features",
+        malformed_threshold=4, malformed_window_s=30.0,
+        malformed_reset_s=0.5,
+    ) as srv:
+        url = srv.info.url
+        status, _, headers = _post(url, json.dumps(
+            {"features": good_row}).encode())
+        assert status == 200, f"warmup serve failed: {status}"
+
+        s400 = s429 = 0
+        while True:
+            kind = plan.take_malformed()
+            if kind is None:
+                break
+            status, body, headers = _post(
+                url, _malformed_body(kind),
+                headers={"X-Client-Id": "poison"},
+            )
+            assert headers.get("X-Trace-Id"), (
+                f"{kind}: reply {status} carries no X-Trace-Id"
+            )
+            if status == 400:
+                err = json.loads(body).get("error")
+                assert isinstance(err, dict) and err.get("kind") \
+                    and err.get("rid"), f"unstructured 400 body: {body!r}"
+                s400 += 1
+            elif status == 429:
+                assert "Retry-After" in headers, headers
+                s429 += 1
+            else:
+                raise AssertionError(
+                    f"malformed {kind} request leaked through: {status}"
+                )
+            # the poison flood never disturbs a healthy client
+            status, _, _ = _post(
+                url, json.dumps({"features": good_row}).encode(),
+                headers={"X-Client-Id": "healthy"},
+            )
+            assert status == 200, f"healthy client failed mid-storm: {status}"
+        assert s400 >= 4 and s429 >= 1, (s400, s429)
+
+        # after the reset window the breaker releases the poison client
+        time.sleep(0.6)
+        status, _, _ = _post(
+            url, json.dumps({"features": good_row}).encode(),
+            headers={"X-Client-Id": "poison"},
+        )
+        assert status == 200, f"poison client never released: {status}"
+    print(f"act 3 (serving): {s400} structured+traced 400s, {s429} shed "
+          f"429s, healthy client unaffected, breaker released")
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="tools/data_chaos_smoke.py",
+        description="Corruption-storm smoke for the poison-tolerant "
+                    "data plane.",
+    )
+    parser.add_argument("--out", default=None,
+                        help="artifact directory (event log lands here; "
+                             "default: the temp workdir)")
+    args = parser.parse_args(argv)
+
+    work = tempfile.mkdtemp(prefix="mmlspark-tpu-datachaos-")
+    out = os.path.abspath(args.out or work)
+    os.makedirs(out, exist_ok=True)
+    log = os.path.join(out, "events.jsonl")
+    open(log, "w").close()
+    for stale in glob.glob(glob.escape(log) + "@*"):
+        os.unlink(stale)
+    os.environ["MMLSPARK_TPU_EVENT_LOG"] = log
+
+    model = batch_fit_act(work)
+    streaming_act(work)
+    serving_act(model)
+    print(f"event log: {log}")
+    print("data chaos smoke OK")
+    return 0
+
+
+if __name__ == "__main__":
+    if len(sys.argv) >= 4 and sys.argv[1] == "--child":
+        kill = sys.argv[4:6]
+        run_child(
+            sys.argv[2], sys.argv[3],
+            kill_epoch=kill[0] if kill else None,
+            kill_point=kill[1] if kill else None,
+        )
+        sys.exit(0)
+    sys.exit(main())

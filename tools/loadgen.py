@@ -19,6 +19,14 @@ the router while the chaos escalates:
            victim's breaker trips; MID-STORM a new model version is
            committed to the shared ModelStore and every replica hot-swaps
            live — observed from the client side as the predictions flip;
+  poison   (``--malformed``) a seeded flood of malformed requests — torn
+           JSON, schema violations, NaN payloads, each directed by a
+           ``FaultPlan.malformed_request`` directive — is thrown at the
+           router as one poison client: every reply must be a structured
+           400 carrying X-Trace-Id until the per-client breaker trips
+           into 429 shedding, healthy clients stay served throughout,
+           and after the reset window the poison client is admitted
+           again (the breaker releases);
   drain    load drops to zero and the autoscaler retires capacity back
            down to the floor, deregistering each victim first.
 
@@ -170,6 +178,81 @@ class LoadClients:
         return out
 
 
+def _malformed_body(kind, i):
+    """One poison payload of the FaultPlan-directed ``kind``: torn JSON,
+    a schema violation (missing input column), or a non-finite value
+    (parses fine; only the pre-admission validator can catch it)."""
+    if kind == "json":
+        return b'{"input": [1.0, not json'
+    if kind == "schema":
+        return json.dumps({"wrong_col": [float(i)]}).encode()
+    return b'{"input": NaN}'
+
+
+def _post_json(url, payload, client_id=None, timeout=5.0):
+    headers = {"Content-Type": "application/json"}
+    if client_id:
+        headers["X-Client-Id"] = client_id
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(), method="POST",
+        headers=headers,
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        e.read()
+        return e.code, None
+    except Exception:
+        return -1, None
+
+
+def _malformed_storm(url, plan, client_id="poison-client", enough_shed=4):
+    """Drain the plan's ``malformed_request`` directives as one poison
+    client: each directive's kind picks the payload shape. Every reply is
+    classified — a structured 400 must carry an error kind + rid in the
+    body AND an X-Trace-Id header; 429s are the per-client breaker
+    shedding us. Stops early once ``enough_shed`` 429s are observed
+    (post-trip requests crawl behind Retry-After honoring)."""
+    stats = {"sent": 0, "accepted": 0, "s400": 0, "s429": 0,
+             "structured_400": 0, "missing_trace": 0, "other": 0}
+    while stats["s429"] < enough_shed:
+        kind = plan.take_malformed()
+        if kind is None:
+            break
+        body = _malformed_body(kind, stats["sent"])
+        req = urllib.request.Request(
+            url, data=body, method="POST",
+            headers={"Content-Type": "application/json",
+                     "X-Client-Id": client_id},
+        )
+        stats["sent"] += 1
+        try:
+            with urllib.request.urlopen(req, timeout=5.0) as resp:
+                resp.read()
+                stats["accepted"] += 1
+        except urllib.error.HTTPError as e:
+            data = e.read()
+            if not e.headers.get("X-Trace-Id"):
+                stats["missing_trace"] += 1
+            if e.code == 400:
+                stats["s400"] += 1
+                try:
+                    err = json.loads(data).get("error")
+                    if isinstance(err, dict) and err.get("kind") \
+                            and err.get("rid"):
+                        stats["structured_400"] += 1
+                except (ValueError, AttributeError):
+                    pass
+            elif e.code == 429:
+                stats["s429"] += 1
+            else:
+                stats["other"] += 1
+        except Exception:
+            stats["other"] += 1
+    return stats
+
+
 def run_campaign(args):
     from mmlspark_tpu import observability as obs
     from mmlspark_tpu.observability.federation import MetricsFederator
@@ -214,6 +297,10 @@ def run_campaign(args):
         server_options={
             "max_batch_size": 8, "max_latency_ms": 1.0,
             "max_pending": 32, "shed_retry_after_s": 0.05,
+            # campaign-sized poison breaker: trips after a handful of
+            # malformed requests, releases fast enough to re-probe
+            "malformed_threshold": 6, "malformed_window_s": 10.0,
+            "malformed_reset_s": 1.0,
         },
     )
     sup.start()
@@ -328,6 +415,59 @@ def run_campaign(args):
         # so the drain tail measures steady state, not cold compiles
         time.sleep(dur(2.0, 3.0))
 
+        # -- poison: seeded malformed-request flood (--malformed) ------------
+        if args.malformed:
+            clients.phase = "poison"
+            poison_plan = FaultPlan(seed=seed)
+            for kind in ("json", "schema", "nan"):
+                poison_plan.malformed_request(count=dur(8, 16), kind=kind)
+            pstats = _malformed_storm(router.url, poison_plan)
+            # a healthy client keeps being served while the poison client
+            # is shed — the breaker is per X-Client-Id, not per replica
+            s_h, _ = clients._one(4.0 if args.payload == "affine" else 4)
+            # every tripped breaker must also RELEASE: after reset_s, a
+            # valid request from the poison client probes each replica
+            # directly (the router would stop at the first) so the
+            # PoisonClientBlocked/Released event pairs all close
+            time.sleep(1.2)
+            released = 0
+            for svc in list(registry.services):
+                s_r, _ = _post_json(
+                    svc.url,
+                    {"input": 4.0 if args.payload == "affine" else 4},
+                    client_id="poison-client",
+                )
+                released += 1 if s_r == 200 else 0
+            checks["malformed_storm_fired"] = any(
+                f[0] == "malformed_request" for f in poison_plan.fired
+            )
+            checks["malformed_none_accepted"] = pstats["accepted"] == 0
+            checks["malformed_400s_structured"] = (
+                pstats["s400"] > 0
+                and pstats["structured_400"] == pstats["s400"]
+                and pstats["missing_trace"] == 0
+            )
+            # the router retries 429s onto untripped replicas (and the
+            # short reset window re-admits the client between hops), so
+            # the CLIENT may never see a 429 even while replicas shed —
+            # count the replica-side RequestShed events as well
+            replica_sheds = sum(
+                1 for e in obs.merge(event_log_path())
+                if type(e).__name__ == "RequestShed"
+                and getattr(e, "reason", "") == "malformed_rate"
+            )
+            checks["poison_breaker_shed"] = (
+                pstats["s429"] + replica_sheds > 0
+            )
+            checks["poison_client_released"] = released > 0
+            checks["healthy_during_poison"] = s_h == 200
+            print(
+                f"poison: {pstats['sent']} malformed sent -> "
+                f"{pstats['s400']} structured 400s, {pstats['s429']} client "
+                f"429s + {replica_sheds} replica shed(s), healthy probe "
+                f"{s_h}, released on {released} replica(s)"
+            )
+
         # -- drain: load off, autoscaler retires back to the floor -----------
         clients.phase = "drain"
         clients.set_concurrency(0)
@@ -368,7 +508,7 @@ def run_campaign(args):
     transport = sum(s["transport"] for s in phases.values())
     steady = sorted(
         lat for phase, status, lat, _, _ in clients.records
-        if status == 200 and phase not in ("kill", "storm")
+        if status == 200 and phase not in ("kill", "storm", "poison")
     )
     steady_p99_ms = _quantile(steady, 0.99) * 1e3
     # the affine payload is judged against the docs/serving_latency.md
@@ -462,7 +602,7 @@ def run_campaign(args):
         "| phase | requests | ok | shed | 5xx | p50 | p99 |",
         "|---|---|---|---|---|---|---|",
     ]
-    for phase in ("warmup", "ramp", "kill", "storm", "drain"):
+    for phase in ("warmup", "ramp", "kill", "storm", "poison", "drain"):
         s = phases.get(phase)
         if s is None:
             continue
@@ -863,6 +1003,13 @@ def main(argv=None):
                         help="model-quality campaign instead: covariate-"
                              "shift + latency storms judged by the "
                              "drift/alert plane (CI: quality-chaos)")
+    parser.add_argument("--malformed", action="store_true",
+                        help="add a poison phase: a seeded malformed-"
+                             "request flood (torn JSON / schema violation "
+                             "/ NaN payloads) that must be answered with "
+                             "structured, traced 400s and per-client 429 "
+                             "shedding while healthy clients keep being "
+                             "served (CI: data-chaos)")
     args = parser.parse_args(argv)
     os.makedirs(args.out, exist_ok=True)
     # shared across the router, the controller, and every replica process;
